@@ -1,0 +1,20 @@
+"""Sharded, epoch-reshuffled, prefetching input pipeline.
+
+Replaces the reference's ``ImageFolder`` + torchvision transforms +
+``DistributedSampler`` + ``DataLoader`` (+ the apex CUDA-stream
+``data_prefetcher``) stack (reference distributed.py:161-195,
+apex_distributed.py:115-169) with a host-side numpy/PIL pipeline feeding
+devices through double-buffered async transfers.
+"""
+
+from pytorch_distributed_tpu.data.sampler import DistributedShardSampler
+from pytorch_distributed_tpu.data.datasets import SyntheticImageDataset, ImageFolder
+from pytorch_distributed_tpu.data.loader import DataLoader, DeviceFeeder
+
+__all__ = [
+    "DistributedShardSampler",
+    "SyntheticImageDataset",
+    "ImageFolder",
+    "DataLoader",
+    "DeviceFeeder",
+]

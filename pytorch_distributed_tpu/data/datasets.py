@@ -1,0 +1,104 @@
+"""Datasets: ImageFolder-compatible directory trees and synthetic data.
+
+``ImageFolder`` has the reference's dataset semantics
+(``datasets.ImageFolder(traindir, transform)``, reference
+distributed.py:163-175): one subdirectory per class, sorted class names →
+contiguous label ids, (image, label) samples.
+
+``SyntheticImageDataset`` is the CI/bench workload the reference lacks
+(SURVEY.md §7.2 step 2 "synthetic-data mode"): deterministic
+pseudo-random images keyed by index, so tests and benchmarks never need
+ImageNet on disk and input IO can be excluded from device benchmarks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp")
+
+
+class ImageFolder:
+    def __init__(self, root: str, transform: Optional[Callable] = None):
+        self.root = root
+        self.transform = transform
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    if f.lower().endswith(IMG_EXTENSIONS):
+                        self.samples.append((os.path.join(dirpath, f), self.class_to_idx[c]))
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def get(self, index: int, rng: Optional[np.random.Generator] = None) -> Tuple[np.ndarray, int]:
+        """Fetch with an explicit augmentation RNG; the loader passes a
+        ``(seed, epoch, index)``-keyed generator so augmentations differ per
+        epoch yet stay reproducible."""
+        from PIL import Image
+
+        path, label = self.samples[index]
+        if rng is None:
+            rng = np.random.default_rng(index)
+        with Image.open(path) as im:
+            img = im.convert("RGB")
+            if self.transform is not None:
+                img = self.transform(rng, img)
+            else:
+                img = np.asarray(img, dtype=np.float32) / 255.0
+        return np.asarray(img, dtype=np.float32), label
+
+    def __getitem__(self, index: int) -> Tuple[np.ndarray, int]:
+        return self.get(index)
+
+
+class SyntheticImageDataset:
+    """Deterministic fake (image, label) pairs, ImageFolder-shaped."""
+
+    def __init__(
+        self,
+        length: int = 1280,
+        num_classes: int = 1000,
+        image_size: int = 224,
+        transform: Optional[Callable] = None,
+        seed: int = 0,
+    ):
+        self.length = length
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self.transform = transform
+        self.seed = seed
+        self.classes = [f"class_{i:04d}" for i in range(num_classes)]
+
+    def __len__(self) -> int:
+        return self.length
+
+    def get(self, index: int, rng: Optional[np.random.Generator] = None) -> Tuple[np.ndarray, int]:
+        # Content is keyed by (seed, index) only — the same sample every
+        # epoch, like files on disk; ``rng`` drives augmentation randomness.
+        content_rng = np.random.default_rng((self.seed, index))
+        img = content_rng.integers(
+            0, 256, size=(self.image_size, self.image_size, 3)
+        ).astype(np.uint8)
+        label = int(content_rng.integers(0, self.num_classes))
+        if rng is None:
+            rng = content_rng
+        if self.transform is not None:
+            img = self.transform(rng, img)
+            return np.asarray(img, dtype=np.float32), label
+        return img.astype(np.float32) / 255.0, label
+
+    def __getitem__(self, index: int) -> Tuple[np.ndarray, int]:
+        return self.get(index)

@@ -1,0 +1,162 @@
+"""Batching loader + double-buffered device feeder.
+
+Replaces the reference's ``DataLoader(num_workers, pin_memory=True)``
+(reference distributed.py:176-180) and the apex CUDA-stream
+``data_prefetcher`` (apex_distributed.py:115-169).  On TPU the prefetcher's
+job — overlap host→device copies with device compute — is done by enqueueing
+the *next* batch's async transfer while the current step runs, from a
+background thread (XLA transfers are async; dispatch is cheap).
+
+Batches have **static shapes** (XLA requirement): the final partial batch is
+zero-padded and carries a 0/1 ``weights`` mask, which the step functions use
+so padding contributes nothing to loss/metrics — this makes evaluation exact
+rather than DistributedSampler-approximate (SURVEY.md §7.4 item 3).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.data.sampler import DistributedShardSampler
+
+Batch = Dict[str, np.ndarray]
+
+
+class DataLoader:
+    """Iterates this rank's shard as padded, masked numpy batches.
+
+    ``batch_size`` here is the *per-process* batch (the harness divides the
+    global batch by process count, mirroring reference distributed.py:146).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        sampler: Optional[DistributedShardSampler] = None,
+        num_workers: int = 2,
+        drop_last: bool = False,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = sampler or DistributedShardSampler(
+            len(dataset), shuffle=False, seed=seed
+        )
+        self.num_workers = max(1, num_workers)
+        self.drop_last = drop_last
+        self.seed = seed
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        n = self.sampler.num_samples
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _fetch(self, index: int, valid: int):
+        if valid:
+            rng = np.random.default_rng((self.seed, self.sampler.epoch, int(index)))
+            if hasattr(self.dataset, "get"):
+                return self.dataset.get(int(index), rng)
+            return self.dataset[int(index)]
+        return None  # padding slot
+
+    def __iter__(self) -> Iterator[Batch]:
+        indices, valid = self.sampler.shard()
+        nb = len(self)
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            for b in range(nb):
+                lo, hi = b * self.batch_size, (b + 1) * self.batch_size
+                idx = indices[lo:hi]
+                val = valid[lo:hi]
+                # Pad the trailing batch to the static batch size.
+                pad = self.batch_size - len(idx)
+                if pad:
+                    idx = np.concatenate([idx, np.zeros(pad, dtype=idx.dtype)])
+                    val = np.concatenate([val, np.zeros(pad, dtype=val.dtype)])
+                samples = list(pool.map(self._fetch, idx, val))
+                proto = next(s for s in samples if s is not None)
+                images = np.zeros((self.batch_size,) + proto[0].shape, dtype=np.float32)
+                labels = np.zeros(self.batch_size, dtype=np.int32)
+                for i, s in enumerate(samples):
+                    if s is not None:
+                        images[i] = s[0]
+                        labels[i] = s[1]
+                yield {
+                    "images": images,
+                    "labels": labels,
+                    "weights": val.astype(np.float32),
+                }
+
+
+class DeviceFeeder:
+    """Async host→device pipeline with prefetch depth ≥ 2.
+
+    Wraps a host-batch iterable; yields global ``jax.Array``s laid out as
+    ``PartitionSpec('data')`` over the mesh's data axis.  In multi-process
+    jobs each process contributes its local shard
+    (``jax.make_array_from_process_local_data``), the TPU-native equivalent of
+    per-rank DistributedSampler shards landing on per-rank GPUs.
+    """
+
+    def __init__(self, mesh: Mesh, data_axis: str = "data", prefetch: int = 2):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.prefetch = max(1, prefetch)
+
+    def _shardings(self) -> Dict[str, NamedSharding]:
+        spec = P(self.data_axis)
+        return {
+            "images": NamedSharding(self.mesh, spec),
+            "labels": NamedSharding(self.mesh, spec),
+            "weights": NamedSharding(self.mesh, spec),
+        }
+
+    def _put(self, batch: Batch) -> Dict[str, jax.Array]:
+        n_shards = self.mesh.shape[self.data_axis]
+        bsz = next(iter(batch.values())).shape[0] * jax.process_count()
+        if bsz % n_shards:
+            raise ValueError(
+                f"global batch {bsz} must divide the '{self.data_axis}' mesh "
+                f"axis ({n_shards} shards); pick a per-process batch that is a "
+                f"multiple of {n_shards // jax.process_count() or 1}"
+            )
+        sh = self._shardings()
+        return {
+            k: jax.make_array_from_process_local_data(sh[k], v)
+            for k, v in batch.items()
+        }
+
+    def __call__(self, host_iter) -> Iterator[Dict[str, jax.Array]]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+
+        def producer():
+            # Exceptions must surface at the consumer, not die in the thread —
+            # otherwise a bad batch silently truncates the epoch.
+            try:
+                for batch in host_iter:
+                    q.put(self._put(batch))
+                q.put(stop)
+            except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+                q.put(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            if isinstance(item, BaseException):
+                t.join()
+                raise item
+            yield item
+        t.join()

@@ -1,0 +1,153 @@
+"""Host-side image transforms (numpy/PIL), torchvision-equivalent.
+
+Capability parity with the reference's transform stacks:
+
+- train: ``RandomResizedCrop(224) → RandomHorizontalFlip → ToTensor →
+  Normalize(mean, std)`` (reference distributed.py:166-173)
+- eval:  ``Resize(256) → CenterCrop(224) → ToTensor → Normalize``
+  (reference distributed.py:182-189)
+
+TPU-first layout delta: output is **NHWC float32 in [0,1] then normalized**
+(channels-last is XLA's preferred conv layout on TPU), where torch uses NCHW.
+Normalization constants are the same ImageNet mean/std.
+
+Each transform is a callable ``(rng, image) -> image`` on numpy arrays or PIL
+images; randomness is an explicit ``np.random.Generator`` so per-epoch
+determinism flows from the sampler seed (reference ``--seed`` semantics,
+distributed.py:116-124).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+
+def _to_pil(img):
+    from PIL import Image
+
+    if isinstance(img, np.ndarray):
+        return Image.fromarray(img)
+    return img
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, rng: np.random.Generator, img):
+        for t in self.transforms:
+            img = t(rng, img)
+        return img
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize — torchvision semantics
+    (scale 0.08-1.0, log-uniform aspect 3/4-4/3, 10 tries then center fallback)."""
+
+    def __init__(self, size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, rng: np.random.Generator, img):
+        img = _to_pil(img)
+        w, h = img.size
+        area = w * h
+        for _ in range(10):
+            target_area = area * rng.uniform(*self.scale)
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            aspect = np.exp(rng.uniform(*log_ratio))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                x = int(rng.integers(0, w - cw + 1))
+                y = int(rng.integers(0, h - ch + 1))
+                img = img.crop((x, y, x + cw, y + ch))
+                return img.resize((self.size, self.size), resample=2)  # BILINEAR
+        # Fallback: center crop of the constrained aspect.
+        in_ratio = w / h
+        if in_ratio < self.ratio[0]:
+            cw, ch = w, int(round(w / self.ratio[0]))
+        elif in_ratio > self.ratio[1]:
+            cw, ch = int(round(h * self.ratio[1])), h
+        else:
+            cw, ch = w, h
+        x, y = (w - cw) // 2, (h - ch) // 2
+        return img.crop((x, y, x + cw, y + ch)).resize(
+            (self.size, self.size), resample=2
+        )
+
+
+class RandomHorizontalFlip:
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, rng: np.random.Generator, img):
+        if rng.random() < self.p:
+            img = _to_pil(img).transpose(0)  # FLIP_LEFT_RIGHT
+        return img
+
+
+class Resize:
+    """Shorter side → ``size`` keeping aspect (torchvision Resize(int))."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, rng: np.random.Generator, img):
+        img = _to_pil(img)
+        w, h = img.size
+        if w <= h:
+            nw, nh = self.size, max(1, int(round(h * self.size / w)))
+        else:
+            nw, nh = max(1, int(round(w * self.size / h))), self.size
+        return img.resize((nw, nh), resample=2)
+
+
+class CenterCrop:
+    def __init__(self, size: int):
+        self.size = size
+
+    def __call__(self, rng: np.random.Generator, img):
+        img = _to_pil(img)
+        w, h = img.size
+        x = max(0, (w - self.size) // 2)
+        y = max(0, (h - self.size) // 2)
+        return img.crop((x, y, x + self.size, y + self.size))
+
+
+class ToArray:
+    """PIL/uint8 → float32 NHWC in [0,1] (torchvision ToTensor, minus the
+    NCHW permute — TPU convs want channels-last)."""
+
+    def __call__(self, rng: np.random.Generator, img):
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = np.stack([arr] * 3, axis=-1)
+        return arr
+
+
+class Normalize:
+    def __init__(self, mean=IMAGENET_MEAN, std=IMAGENET_STD):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+
+    def __call__(self, rng: np.random.Generator, img):
+        return (np.asarray(img, dtype=np.float32) - self.mean) / self.std
+
+
+def train_transform(size: int = 224) -> Compose:
+    """The reference's training stack (distributed.py:166-173)."""
+    return Compose(
+        [RandomResizedCrop(size), RandomHorizontalFlip(), ToArray(), Normalize()]
+    )
+
+
+def eval_transform(size: int = 224, resize: int = 256) -> Compose:
+    """The reference's validation stack (distributed.py:182-189)."""
+    return Compose([Resize(resize), CenterCrop(size), ToArray(), Normalize()])

@@ -1,0 +1,58 @@
+"""Model registry mirroring the torchvision-zoo introspection surface.
+
+The reference picks its architecture by string from the zoo namespace:
+``model_names = sorted(name for name in models.__dict__ if …)`` and
+``models.__dict__[args.arch]()`` (reference distributed.py:21-23,134-139).
+Here the same two gestures are ``model_names()`` and
+``create_model(name, …)``; constructors are also re-exported at module level
+so ``models.__dict__[name]`` works verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    wide_resnet50_2,
+    wide_resnet101_2,
+    resnext50_32x4d,
+    resnext101_32x8d,
+)
+
+_REGISTRY: Dict[str, Callable] = {
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+    "resnet50": resnet50,
+    "resnet101": resnet101,
+    "resnet152": resnet152,
+    "wide_resnet50_2": wide_resnet50_2,
+    "wide_resnet101_2": wide_resnet101_2,
+    "resnext50_32x4d": resnext50_32x4d,
+    "resnext101_32x8d": resnext101_32x8d,
+}
+
+
+def register(name: str, ctor: Callable) -> None:
+    """Add a model family to the registry (used by models/transformer.py)."""
+    _REGISTRY[name] = ctor
+    globals()[name] = ctor
+
+
+def model_names() -> List[str]:
+    """Sorted architecture names (reference distributed.py:21-23)."""
+    return sorted(_REGISTRY)
+
+
+def create_model(name: str, num_classes: int = 1000, dtype: Any = jnp.float32, **kw):
+    """``models.__dict__[arch]()`` equivalent (reference distributed.py:134-139)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown arch {name!r}; choose from {model_names()}")
+    return _REGISTRY[name](num_classes=num_classes, dtype=dtype, **kw)

@@ -1,0 +1,145 @@
+"""ResNet family (flax.linen), TPU-first.
+
+Capability parity with the torchvision zoo the reference instantiates by name
+(``models.__dict__[args.arch]()``, reference distributed.py:21-23,134-139):
+resnet18/34/50/101/152 plus the wide and ResNeXt variants, same
+block/stage/width structure and BatchNorm placement as the torchvision
+definitions, so top-1/top-5 oracles are comparable.
+
+TPU-first choices:
+- **NHWC** layout (XLA's native conv layout on TPU; MXU-friendly).
+- ``dtype`` policy: params live in f32, compute may be bf16 — the
+  apex-AMP-equivalent (SURVEY.md §7.1 "bf16 compute/param policy"); BatchNorm
+  statistics always accumulate in f32.
+- BatchNorm over a data-sharded batch under GSPMD computes *global* batch
+  statistics (XLA inserts the cross-replica mean) — i.e. SyncBN semantics,
+  strictly stronger than torch DDP's local-stats BN; documented delta.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    expansion: int = 1
+    groups: int = 1
+    base_width: int = 64
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides),
+                      padding=[(1, 1), (1, 1)], use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * self.expansion, (1, 1),
+                                 (self.strides, self.strides), use_bias=False)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    filters: int
+    strides: int = 1
+    expansion: int = 4
+    groups: int = 1
+    base_width: int = 64
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        width = int(self.filters * (self.base_width / 64.0)) * self.groups
+        y = self.conv(width, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(width, (3, 3), (self.strides, self.strides),
+                      padding=[(1, 1), (1, 1)], use_bias=False,
+                      feature_group_count=self.groups)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * self.expansion, (1, 1), use_bias=False)(y)
+        # Zero-init the last BN scale so blocks start as identity
+        # (torchvision zero_init_residual analogue; helps large-batch SGD).
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * self.expansion, (1, 1),
+                                 (self.strides, self.strides), use_bias=False)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: Callable
+    num_classes: int = 1000
+    num_filters: int = 64
+    groups: int = 1
+    base_width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,           # torch BatchNorm2d momentum=0.1 ⇒ ema decay 0.9
+            epsilon=1e-5,
+            dtype=jnp.float32,      # stats and affine math in f32 always
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2),
+                 padding=[(3, 3), (3, 3)], use_bias=False, name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    groups=self.groups,
+                    base_width=self.base_width,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+        return x
+
+
+# Stage configurations mirror torchvision's resnet table.
+resnet18 = functools.partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+resnet34 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock)
+resnet50 = functools.partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck)
+resnet101 = functools.partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck)
+resnet152 = functools.partial(ResNet, stage_sizes=[3, 8, 36, 3], block_cls=Bottleneck)
+wide_resnet50_2 = functools.partial(
+    ResNet, stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck, base_width=128
+)
+wide_resnet101_2 = functools.partial(
+    ResNet, stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck, base_width=128
+)
+resnext50_32x4d = functools.partial(
+    ResNet, stage_sizes=[3, 4, 6, 3], block_cls=Bottleneck, groups=32, base_width=4
+)
+resnext101_32x8d = functools.partial(
+    ResNet, stage_sizes=[3, 4, 23, 3], block_cls=Bottleneck, groups=32, base_width=8
+)

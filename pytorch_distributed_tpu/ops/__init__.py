@@ -1,0 +1,6 @@
+"""Loss and metric ops (pure, jit-friendly) plus Pallas TPU kernels."""
+
+from pytorch_distributed_tpu.ops.loss import cross_entropy
+from pytorch_distributed_tpu.ops.metrics import accuracy, topk_correct
+
+__all__ = ["cross_entropy", "accuracy", "topk_correct"]

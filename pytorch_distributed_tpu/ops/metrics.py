@@ -1,0 +1,62 @@
+"""Classification metrics, computed *inside* the compiled step function.
+
+Capability parity with the reference's ``accuracy()`` (reference
+distributed.py:381-395): top-k percentage over a batch for k in (1, 5).
+The reference computes this on device then immediately ``.item()``s the
+result, forcing a host sync per step; here the op is pure and jit-traced so
+metric reduction stays in-graph (SURVEY.md §7.4 item 1).
+
+Design delta (TPU-first): supports an optional per-example ``weights`` mask so
+padded batches (static-shape XLA requirement) contribute zero — this makes
+sharded evaluation *exact* where the reference's DistributedSampler padding
+slightly skews val metrics (SURVEY.md §7.4 item 3).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def topk_correct(logits: jnp.ndarray, labels: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Per-example 0/1 indicator that the true label is within the top-k logits.
+
+    Implemented rank-style (count of strictly-greater logits < k) rather than
+    via ``top_k`` + equality sweep: one vectorized comparison, no gather, maps
+    cleanly onto the VPU, and ties resolve conservatively (a tie on the k-th
+    boundary counts as correct only if strictly fewer than k logits beat the
+    true class — identical to torch.topk semantics for distinct values).
+    """
+    true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)  # [B, 1]
+    rank = jnp.sum(logits > true_logit, axis=-1)  # [B] number of classes beating truth
+    return (rank < k).astype(jnp.float32)
+
+
+def accuracy(
+    logits: jnp.ndarray,
+    labels: jnp.ndarray,
+    topk: Sequence[int] = (1,),
+    weights: jnp.ndarray | None = None,
+) -> Tuple[jnp.ndarray, ...]:
+    """Top-k accuracy in percent over the (possibly weighted) batch.
+
+    Matches reference ``accuracy(output, target, topk=(1, 5))``
+    (distributed.py:381-395): returns one scalar per k, scaled by 100.
+
+    ``weights`` (0/1 per example) masks padding; the denominator is the
+    weight sum, so padded shards still produce exact dataset-level metrics.
+    """
+    if weights is None:
+        denom = jnp.float32(labels.shape[0])
+        results = tuple(
+            jnp.sum(topk_correct(logits, labels, k)) * 100.0 / denom for k in topk
+        )
+    else:
+        weights = weights.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(weights), 1.0)
+        results = tuple(
+            jnp.sum(topk_correct(logits, labels, k) * weights) * 100.0 / denom
+            for k in topk
+        )
+    return results

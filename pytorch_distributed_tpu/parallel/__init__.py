@@ -1,0 +1,35 @@
+"""Device meshes, distributed bootstrap, and collective helpers.
+
+This is the framework's single communication layer (SURVEY.md §5.8): it
+replaces all four of the reference's backend stacks — NCCL via
+``torch.distributed`` (reference distributed.py:132), apex DDP flat-buffer
+allreduce (apex_distributed.py:217), Horovod's MPI ring-allreduce core
+(horovod_distributed.py:125), and the SLURM file-rendezvous
+(distributed_slurm_main.py:137-140) — with ``jax.distributed.initialize``
+plus a ``jax.sharding.Mesh`` over ICI (and DCN for multi-slice), inside
+which XLA emits the collectives.
+"""
+
+from pytorch_distributed_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    data_parallel_mesh,
+    local_device_count,
+)
+from pytorch_distributed_tpu.parallel.dist import (
+    DistContext,
+    initialize,
+    process_count,
+    process_index,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "data_parallel_mesh",
+    "local_device_count",
+    "DistContext",
+    "initialize",
+    "process_count",
+    "process_index",
+]

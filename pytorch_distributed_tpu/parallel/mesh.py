@@ -1,0 +1,82 @@
+"""Device-mesh construction over ICI / DCN.
+
+The mesh is the TPU-native replacement for the reference's process-group +
+device-id bookkeeping (``torch.cuda.set_device`` / ``device_ids=[local_rank]``,
+reference distributed.py:141,147-148).  Axis conventions used throughout the
+framework:
+
+- ``data``  — data parallelism (gradient psum rides ICI; across slices, DCN)
+- ``model`` — tensor parallelism (activations/weights sharded)
+- ``seq``   — sequence/context parallelism (ring attention, parallel/ring.py)
+- ``pipe``  — pipeline stages
+- ``expert`` — expert parallelism (MoE)
+
+Single-axis DP is the reference-parity configuration; the extra axes are
+first-class so long-context / model-parallel training shares one code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape.  ``-1`` for at most one axis means "all remaining
+    devices" (like a reshape wildcard)."""
+
+    axes: Tuple[str, ...] = ("data",)
+    shape: Tuple[int, ...] = (-1,)
+
+    def resolve(self, n_devices: int) -> Tuple[int, ...]:
+        shape = list(self.shape)
+        wild = [i for i, s in enumerate(shape) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {self.shape}")
+        fixed = int(np.prod([s for s in shape if s != -1])) if shape else 1
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}"
+                )
+            shape[wild[0]] = n_devices // fixed
+        if int(np.prod(shape)) != n_devices:
+            raise ValueError(
+                f"mesh shape {tuple(shape)} != device count {n_devices}"
+            )
+        return tuple(shape)
+
+
+def local_device_count() -> int:
+    """Addressable accelerator count — the reference's
+    ``torch.cuda.device_count()`` (distributed.py:114)."""
+    return jax.local_device_count()
+
+
+def build_mesh(
+    spec: MeshSpec = MeshSpec(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` over all (global) devices.
+
+    Device order follows ``jax.devices()``, which on TPU pods is already
+    ICI-topology-aware; the *last* mesh axes are therefore the
+    fastest-varying / most-local, so put the heaviest-communication axis
+    (``model`` or ``seq``) last and ``data`` first — gradient allreduce
+    tolerates DCN, tensor-parallel collectives should ride ICI
+    (scaling-book recipe; SURVEY.md §5.8).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    shape = spec.resolve(len(devs))
+    dev_array = np.asarray(devs).reshape(shape)
+    return Mesh(dev_array, spec.axes)
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The reference-parity 1-D mesh: every device on one ``data`` axis."""
+    return build_mesh(MeshSpec(("data",), (-1,)), devices)

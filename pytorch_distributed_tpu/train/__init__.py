@@ -1,0 +1,17 @@
+"""The canonical training harness (reference distributed.py:228-395 parity).
+
+Meters, LR schedule, SGD with torch-exact update semantics, jitted SPMD
+train/eval steps, checkpoint save/resume, and the epoch driver.
+"""
+
+from pytorch_distributed_tpu.train.meters import AverageMeter, ProgressMeter
+from pytorch_distributed_tpu.train.lr import step_decay_lr
+from pytorch_distributed_tpu.train.optim import sgd_init, sgd_update
+
+__all__ = [
+    "AverageMeter",
+    "ProgressMeter",
+    "step_decay_lr",
+    "sgd_init",
+    "sgd_update",
+]

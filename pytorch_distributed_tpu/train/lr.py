@@ -1,0 +1,25 @@
+"""Learning-rate schedules.
+
+Capability parity with the reference's ``adjust_learning_rate``
+(reference distributed.py:374-378): step decay ``lr0 * 0.1 ** (epoch // 30)``.
+Here the schedule is a pure function whose value is passed into the jitted
+step as a scalar operand, so changing LR never retraces the program.
+"""
+
+from __future__ import annotations
+
+
+def step_decay_lr(
+    base_lr: float,
+    epoch: int,
+    decay_factor: float = 0.1,
+    decay_every: int = 30,
+) -> float:
+    """``lr = base_lr * decay_factor ** (epoch // decay_every)``."""
+    return base_lr * (decay_factor ** (epoch // decay_every))
+
+
+def linear_scaled_lr(base_lr: float, global_batch: int, base_batch: int = 256) -> float:
+    """Linear-scaling rule (Goyal et al.) — optional helper, off by default to
+    preserve the reference's effective-LR semantics (SURVEY.md §7.4 item 2)."""
+    return base_lr * global_batch / base_batch

@@ -1,0 +1,94 @@
+"""Running-average meters and the progress row printer.
+
+Capability parity with the reference's ``AverageMeter`` / ``ProgressMeter``
+(reference distributed.py:333-371): named running val/avg with a format
+string, and a ``[ i/N]``-prefixed, tab-joined progress row.
+
+TPU-first delta: ``update()`` accepts jax scalars lazily — values are only
+converted to Python floats at display/read time, so per-step device→host
+syncs (the reference's three ``.item()`` calls per batch,
+distributed.py:262-264) never happen in the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+
+def _to_float(v) -> float:
+    # jax.Array / numpy scalar / python number all land here; float() blocks
+    # until the value is ready, which is why meters defer it to read time.
+    return float(v)
+
+
+class AverageMeter:
+    """Tracks current value, running sum/count, and average."""
+
+    def __init__(self, name: str, fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self) -> None:
+        self._pending: List[tuple] = []  # (value, n) possibly still on device
+        self._sum = 0.0
+        self._count = 0
+        self._val = 0.0
+
+    def update(self, val, n: int = 1) -> None:
+        """Record a value; ``val`` may be an unready device scalar."""
+        self._pending.append((val, n))
+
+    def _drain(self) -> None:
+        for val, n in self._pending:
+            v = _to_float(val)
+            self._val = v
+            self._sum += v * n
+            self._count += n
+        self._pending.clear()
+
+    @property
+    def val(self) -> float:
+        self._drain()
+        return self._val
+
+    @property
+    def avg(self) -> float:
+        self._drain()
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def sum(self) -> float:
+        self._drain()
+        return self._sum
+
+    @property
+    def count(self) -> int:
+        self._drain()
+        return self._count
+
+    def __str__(self) -> str:
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(name=self.name, val=self.val, avg=self.avg)
+
+
+class ProgressMeter:
+    """Prints ``<prefix>[ i/N]\\t<meter>\\t<meter>…`` rows (reference :358-366)."""
+
+    def __init__(self, num_batches: int, meters: Iterable[AverageMeter], prefix: str = ""):
+        self.batch_fmtstr = self._batch_fmtstr(num_batches)
+        self.meters = list(meters)
+        self.prefix = prefix
+
+    def display(self, batch: int) -> str:
+        entries = [self.prefix + self.batch_fmtstr.format(batch)]
+        entries += [str(m) for m in self.meters]
+        line = "\t".join(entries)
+        print(line, flush=True)
+        return line
+
+    @staticmethod
+    def _batch_fmtstr(num_batches: int) -> str:
+        num_digits = len(str(num_batches // 1))
+        fmt = "{:" + str(num_digits) + "d}"
+        return "[" + fmt + "/" + fmt.format(num_batches) + "]"

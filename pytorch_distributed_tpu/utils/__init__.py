@@ -1,0 +1,6 @@
+"""Out-of-band instrumentation: CSV timers and device telemetry
+(reference statistics.sh / per-epoch CSV parity, SURVEY.md §5.1)."""
+
+from pytorch_distributed_tpu.utils.csvlog import EpochCSVLogger
+
+__all__ = ["EpochCSVLogger"]

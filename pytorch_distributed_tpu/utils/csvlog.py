@@ -1,0 +1,31 @@
+"""Per-epoch wall-clock CSV logging.
+
+Capability parity with the reference's in-loop CSV timer
+(reference dataparallel.py:188,205-213; distributed_slurm_main.py:209,227-235):
+appends ``[timestamp, epoch_seconds]`` rows to ``<recipe>.csv``, the repo's
+de-facto performance oracle (SURVEY.md §4 item 3).
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from typing import Optional
+
+
+class EpochCSVLogger:
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._t0: Optional[float] = None
+
+    def epoch_start(self) -> None:
+        self._t0 = time.time()
+
+    def epoch_end(self) -> float:
+        assert self._t0 is not None, "epoch_end without epoch_start"
+        elapsed = time.time() - self._t0
+        if self.path:
+            with open(self.path, "a+", newline="") as f:
+                csv.writer(f).writerow([time.time(), elapsed])
+        self._t0 = None
+        return elapsed

@@ -1,0 +1,26 @@
+"""Test configuration: simulate an 8-device TPU mesh on CPU.
+
+Multi-device DP semantics (gradient psum, sharded batches, set_epoch
+reshuffle) are testable with no TPU and no cluster via XLA's host-platform
+device-count override — the test strategy SURVEY.md §4 prescribes for the
+framework (the reference itself has no tests).
+
+This container pre-imports jax in every process (a sitecustomize on
+PYTHONPATH registers the tunneled-TPU "axon" PJRT plugin and sets
+JAX_PLATFORMS=axon), so plain env-before-import doesn't work here.  Backends
+initialize lazily, though, so overriding the config *after* import but before
+first device use reliably lands the tests on the simulated CPU mesh.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (may already be imported by sitecustomize)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,73 @@
+"""DataLoader / DeviceFeeder behavior on the simulated 8-device mesh."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.data import (
+    DataLoader,
+    DeviceFeeder,
+    DistributedShardSampler,
+    SyntheticImageDataset,
+)
+from pytorch_distributed_tpu.parallel import data_parallel_mesh
+
+
+def _loader(n=24, bsz=8, **kw):
+    ds = SyntheticImageDataset(length=n, num_classes=5, image_size=8)
+    return DataLoader(ds, batch_size=bsz, sampler=DistributedShardSampler(n, shuffle=False), **kw)
+
+
+def test_feeder_shards_batches_over_data_axis():
+    feeder = DeviceFeeder(data_parallel_mesh())
+    batches = list(feeder(iter(_loader())))
+    assert len(batches) == 3
+    b = batches[0]
+    assert b["images"].shape == (8, 8, 8, 3)
+    assert b["images"].sharding.spec == (("data",) + b["images"].sharding.spec[1:]) or str(
+        b["images"].sharding.spec
+    ).startswith("PartitionSpec('data'")
+
+
+def test_feeder_raises_on_indivisible_batch_in_consumer():
+    """Regression: a producer-thread failure must surface at the consumer,
+    not silently truncate the epoch (found by verification probe)."""
+    feeder = DeviceFeeder(data_parallel_mesh())
+    with pytest.raises(ValueError, match="must divide"):
+        next(iter(feeder(iter(_loader(bsz=12)))))
+
+
+def test_final_batch_padding_and_mask():
+    loader = _loader(n=20, bsz=8)  # 3 batches, last has 4 real samples
+    batches = list(iter(loader))
+    assert len(batches) == 3
+    assert batches[-1]["weights"].tolist() == [1, 1, 1, 1, 0, 0, 0, 0]
+    # Padding slots are zeros, not garbage.
+    assert np.all(batches[-1]["images"][4:] == 0)
+
+
+def test_epoch_changes_augmentation_not_content_order():
+    ds = SyntheticImageDataset(length=8, num_classes=5, image_size=8)
+    sampler = DistributedShardSampler(8, shuffle=False)
+    loader = DataLoader(ds, batch_size=8, sampler=sampler)
+    loader.set_epoch(0)
+    b0 = next(iter(loader))
+    loader.set_epoch(1)
+    b1 = next(iter(loader))
+    # No transform ⇒ identical content regardless of epoch.
+    np.testing.assert_array_equal(b0["images"], b1["images"])
+    np.testing.assert_array_equal(b0["labels"], b1["labels"])
+
+
+def test_transform_rng_varies_by_epoch():
+    from pytorch_distributed_tpu.data.transforms import train_transform
+
+    ds = SyntheticImageDataset(
+        length=8, num_classes=5, image_size=32, transform=train_transform(size=16)
+    )
+    sampler = DistributedShardSampler(8, shuffle=False)
+    loader = DataLoader(ds, batch_size=8, sampler=sampler)
+    loader.set_epoch(0)
+    b0 = next(iter(loader))
+    loader.set_epoch(1)
+    b1 = next(iter(loader))
+    assert not np.array_equal(b0["images"], b1["images"])

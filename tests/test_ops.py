@@ -1,0 +1,90 @@
+"""Unit tests for ops: accuracy (vs torch-semantics oracle) and cross-entropy."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.ops import accuracy, cross_entropy, topk_correct
+
+
+def _np_topk_accuracy(logits, labels, k):
+    """Oracle mirroring reference accuracy() (distributed.py:381-395)."""
+    topk_idx = np.argsort(-logits, axis=-1)[:, :k]
+    correct = (topk_idx == labels[:, None]).any(axis=-1)
+    return correct.mean() * 100.0
+
+
+@pytest.mark.parametrize("k", [1, 5])
+def test_accuracy_matches_numpy_oracle(k):
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 100)).astype(np.float32)
+    labels = rng.integers(0, 100, size=64).astype(np.int32)
+    (got,) = accuracy(jnp.asarray(logits), jnp.asarray(labels), topk=(k,))
+    want = _np_topk_accuracy(logits, labels, k)
+    np.testing.assert_allclose(float(got), want, rtol=1e-6)
+
+
+def test_accuracy_topk_pair():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(32, 10)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 10, size=32).astype(np.int32))
+    top1, top5 = accuracy(logits, labels, topk=(1, 5))
+    assert 0.0 <= float(top1) <= float(top5) <= 100.0
+
+
+def test_accuracy_weights_mask_padding():
+    rng = np.random.default_rng(2)
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=16).astype(np.int32)
+    # Pad with garbage rows carrying weight 0.
+    logits_p = np.concatenate([logits, rng.normal(size=(4, 10)).astype(np.float32)])
+    labels_p = np.concatenate([labels, np.zeros(4, dtype=np.int32)])
+    w = np.concatenate([np.ones(16, np.float32), np.zeros(4, np.float32)])
+    (unpadded,) = accuracy(jnp.asarray(logits), jnp.asarray(labels), topk=(1,))
+    (masked,) = accuracy(
+        jnp.asarray(logits_p), jnp.asarray(labels_p), topk=(1,), weights=jnp.asarray(w)
+    )
+    np.testing.assert_allclose(float(masked), float(unpadded), rtol=1e-6)
+
+
+def test_topk_correct_all_k_equals_one():
+    logits = jnp.asarray(np.eye(8, dtype=np.float32) * 10.0)
+    labels = jnp.arange(8, dtype=jnp.int32)
+    assert float(topk_correct(logits, labels, 1).sum()) == 8.0
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(32, 50)).astype(np.float32)
+    labels = rng.integers(0, 50, size=32).astype(np.int64)
+    want = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits), torch.from_numpy(labels)
+    ).item()
+    got = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels.astype(np.int32))))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_cross_entropy_weighted_padding():
+    rng = np.random.default_rng(4)
+    logits = rng.normal(size=(8, 5)).astype(np.float32)
+    labels = rng.integers(0, 5, size=8).astype(np.int32)
+    base = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    logits_p = np.concatenate([logits, np.ones((2, 5), np.float32)])
+    labels_p = np.concatenate([labels, np.zeros(2, np.int32)])
+    w = np.concatenate([np.ones(8, np.float32), np.zeros(2, np.float32)])
+    got = float(
+        cross_entropy(jnp.asarray(logits_p), jnp.asarray(labels_p), weights=jnp.asarray(w))
+    )
+    np.testing.assert_allclose(got, base, rtol=1e-6)
+
+
+def test_cross_entropy_bf16_logits_close_to_f32():
+    rng = np.random.default_rng(5)
+    logits = rng.normal(size=(64, 100)).astype(np.float32)
+    labels = rng.integers(0, 100, size=64).astype(np.int32)
+    f32 = float(cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    bf16 = float(
+        cross_entropy(jnp.asarray(logits, dtype=jnp.bfloat16), jnp.asarray(labels))
+    )
+    np.testing.assert_allclose(bf16, f32, rtol=2e-2)

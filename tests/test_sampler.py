@@ -1,0 +1,77 @@
+"""Sharding-math tests for DistributedShardSampler (DistributedSampler parity)."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.data import DistributedShardSampler
+
+
+def test_shards_are_disjoint_and_cover_dataset():
+    world, n = 4, 103
+    shards = []
+    for r in range(world):
+        s = DistributedShardSampler(n, num_replicas=world, rank=r, shuffle=True, seed=7)
+        s.set_epoch(0)
+        idx, valid = s.shard()
+        assert len(idx) == s.num_samples == -(-n // world)
+        shards.append(idx[valid.astype(bool)])
+    all_valid = np.concatenate(shards)
+    assert sorted(all_valid.tolist()) == list(range(n))
+
+
+def test_padding_wraps_and_is_marked_invalid():
+    n, world = 10, 4  # total_size 12, 2 pad slots
+    total_valid = 0
+    for r in range(world):
+        s = DistributedShardSampler(n, num_replicas=world, rank=r, shuffle=False)
+        idx, valid = s.shard()
+        assert len(idx) == 3
+        total_valid += int(valid.sum())
+    assert total_valid == n
+
+
+def test_set_epoch_reshuffles_deterministically():
+    s = DistributedShardSampler(64, num_replicas=2, rank=0, shuffle=True, seed=1)
+    s.set_epoch(0)
+    e0 = s.shard()[0].copy()
+    s.set_epoch(1)
+    e1 = s.shard()[0].copy()
+    assert not np.array_equal(e0, e1)
+    s.set_epoch(0)
+    np.testing.assert_array_equal(s.shard()[0], e0)
+
+
+def test_all_ranks_agree_on_global_permutation():
+    perms = []
+    for r in range(4):
+        s = DistributedShardSampler(50, num_replicas=4, rank=r, shuffle=True, seed=3)
+        s.set_epoch(5)
+        perms.append(s.global_indices()[0])
+    for p in perms[1:]:
+        np.testing.assert_array_equal(p, perms[0])
+
+
+def test_matches_torch_distributed_sampler_partition():
+    """Strided rank assignment identical to torch DistributedSampler (no shuffle)."""
+    torch = pytest.importorskip("torch")
+    from torch.utils.data.distributed import DistributedSampler
+
+    class _DS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 21
+
+        def __getitem__(self, i):
+            return i
+
+    for r in range(3):
+        ts = DistributedSampler(_DS(), num_replicas=3, rank=r, shuffle=False)
+        want = list(iter(ts))
+        ours = DistributedShardSampler(21, num_replicas=3, rank=r, shuffle=False)
+        got = list(iter(ours))
+        assert got == want
+
+
+def test_drop_last():
+    s = DistributedShardSampler(10, num_replicas=4, rank=0, shuffle=False, drop_last=True)
+    idx, valid = s.shard()
+    assert len(idx) == 2 and valid.all()
