@@ -1,0 +1,20 @@
+"""The recipe matrix: one entry point per reference script.
+
+The reference ships six ~400-line scripts whose shared ~260-line harness is
+byte-identical and whose real content is a ~40-line strategy delta
+(SURVEY.md §0).  Here the harness lives once in ``train/`` and each recipe is
+*only* its delta — launch shape, mesh, precision, and gradient-sync
+expression:
+
+| recipe | reference script | TPU-native delta |
+|---|---|---|
+| ``dataparallel``                 | dataparallel.py                | single process, all local chips, GSPMD |
+| ``distributed``                  | distributed.py                 | external launcher env bootstrap (PTD_TPU_*) |
+| ``multiprocessing_distributed``  | multiprocessing_distributed.py | self-contained bootstrap, explicit coordinator |
+| ``apex_distributed``             | apex_distributed.py            | bf16 compute policy (AMP slot) |
+| ``horovod_distributed``          | horovod_distributed.py         | explicit shard_map psum + bf16 wire grads |
+| ``distributed_slurm_main``       | distributed_slurm_main.py      | SLURM env → multi-host mesh over DCN |
+| ``tpu_native``                   | (BASELINE.json north star)     | canonical: bf16 + GSPMD + everything on |
+
+Launch commands live in ``start.sh`` (reference start.sh:1-5 parity).
+"""

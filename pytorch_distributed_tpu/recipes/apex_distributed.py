@@ -1,0 +1,30 @@
+"""Recipe 4 — mixed-precision DP (the apex/AMP slot).
+
+Reference: apex_distributed.py (``amp.initialize(model, optimizer)`` O1
+cast-patching + dynamic loss scaling + apex DDP flat-buffer allreduce +
+CUDA-stream ``data_prefetcher``, apex_distributed.py:115-169,216-217,328-329;
+start.sh:3).
+
+TPU-native delta: bf16 keeps fp32's exponent range, so the whole AMP
+apparatus — cast lists, ``scale_loss``, overflow-skip steps — reduces to a
+compute-dtype policy: params stay f32 masters, matmuls/convs run bf16 on the
+MXU, loss and BN statistics accumulate f32 (models/resnet.py).  The
+prefetcher's copy/compute overlap is the DeviceFeeder's background async
+transfers (data/loader.py).  The reference's double-normalize quirk
+(SURVEY.md §7.5: transform Normalize *and* GPU-side sub_/div_ with 0-255
+constants) is documented, not replicated.
+"""
+
+from pytorch_distributed_tpu.recipes._common import run_recipe
+
+
+def main(argv=None) -> float:
+    return run_recipe(
+        "TPU ImageNet Training (bf16 mixed precision DP)",
+        argv,
+        precision_default="bf16",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Recipe 1 — single-process data parallelism over all local chips.
+
+Reference: dataparallel.py (``nn.DataParallel(model, device_ids,
+output_device)``, dataparallel.py:118-119,138; launched as plain ``python
+main.py``, README.md:86).
+
+TPU-native delta: where DataParallel replicates the module and
+scatter/gathers through GPU0 each step (the reference's own docs call it
+"not recommended" — 3.5× slower than DDP, BASELINE.md), one XLA program over
+a local ``data`` mesh is *already* fully parallel: no master device, no
+gather bottleneck, same step math as every other recipe.  The per-epoch CSV
+(dataparallel.py:188,205-213) is on by default, same file name.
+"""
+
+from pytorch_distributed_tpu.recipes._common import run_recipe
+
+
+def main(argv=None) -> float:
+    return run_recipe(
+        "TPU ImageNet Training (single-process data parallel)",
+        argv,
+        epoch_csv_default="dataparallel.csv",
+        bootstrap=False,  # single process drives all local chips
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Recipe 2 — multi-process DP, external launcher.
+
+Reference: distributed.py (``torch.distributed.launch --nproc_per_node=4``
+sets env + ``--local_rank``; ``dist.init_process_group('nccl')``,
+distributed.py:73-76,132; start.sh:2).
+
+TPU-native delta: the launcher contract is environment variables
+(``PTD_TPU_COORDINATOR / PTD_TPU_NUM_PROCESSES / PTD_TPU_PROCESS_ID`` — the
+``env://`` analogue), consumed by ``jax.distributed.initialize``; on a TPU
+pod the runtime metadata supplies them and no launcher is needed at all.
+Gradient sync is GSPMD: XLA fuses the allreduce into the step program where
+DDP hooks it onto backward (distributed.py:147-148).
+"""
+
+from pytorch_distributed_tpu.recipes._common import run_recipe
+
+
+def main(argv=None) -> float:
+    return run_recipe(
+        "TPU ImageNet Training (multi-process DP, external launcher)", argv
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Recipe 6 — multi-node / multi-slice training under SLURM.
+
+Reference: distributed_slurm_main.py (``srun -N2 --gres gpu:4`` launches one
+task per node; rank = ``SLURM_PROCID * ngpus + gpu``; rendezvous via
+``file://<dist_file>.<SLURM_JOBID>`` on a shared FS,
+distributed_slurm_main.py:124-140; start.sh:5).
+
+TPU-native delta: ``parallel/dist.py`` derives coordinator/process-count/
+process-id from the SLURM environment directly — no shared-file store, no
+``mp.spawn`` fan-out (JAX is one process per host) — and *fixes* the
+reference's latent inconsistencies rather than replicating them
+(SURVEY.md §3.5): world size counts processes (not nodes), the global batch
+divides by total world size (not per-node device count,
+distributed_slurm_main.py:154), metrics are globally reduced (the reference
+prints per-rank metrics, :272-275), and only rank 0 checkpoints (the
+reference races, :237-243).  Across slices the mesh's data axis spans DCN;
+within a slice, ICI.  ``--dist-file`` is accepted for launch-line parity but
+unused.  Per-epoch CSV on by default, same name (:209).
+"""
+
+from pytorch_distributed_tpu.recipes._common import run_recipe
+
+
+def main(argv=None) -> float:
+    return run_recipe(
+        "TPU ImageNet Training (multi-node SLURM / multi-slice pod)",
+        argv,
+        epoch_csv_default="distributed.csv",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Recipe 5 — explicit-collective DP with compressed gradient wire format.
+
+Reference: horovod_distributed.py (``hvd.init``; ``hvd.DistributedOptimizer``
+per-parameter ring-allreduce hooks with ``Compression.fp16`` wire
+compression; ``hvd.broadcast_parameters``; allreduce-as-barrier,
+horovod_distributed.py:102-108,125,149,158-164; start.sh:4).
+
+TPU-native delta: the step is expressed with **explicit collectives** —
+``shard_map`` over the data axis with a hand-written ``psum``
+(train/steps.py ``local_step``) — the moral equivalent of Horovod's
+explicit ring allreduce, vs. the GSPMD recipes where XLA infers it.
+Gradients cross the wire in **bf16** (``wire_dtype``), reproducing fp16
+gradient compression with bf16's safer exponent range.  Parameter broadcast
+≙ params born replicated on the mesh; the allreduce-doubles-as-barrier trick
+is moot — XLA steps are bulk-synchronous.  BatchNorm is per-shard (local),
+exactly like the GPU original's unsynced BN (see train/steps.py docstring).
+"""
+
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.recipes._common import run_recipe
+
+
+def main(argv=None) -> float:
+    return run_recipe(
+        "TPU ImageNet Training (explicit collectives + bf16 wire grads)",
+        argv,
+        explicit_collectives=True,
+        wire_dtype=jnp.bfloat16,
+    )
+
+
+if __name__ == "__main__":
+    main()

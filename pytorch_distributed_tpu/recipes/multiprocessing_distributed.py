@@ -1,0 +1,32 @@
+"""Recipe 3 — self-contained multi-process DP (no external launcher).
+
+Reference: multiprocessing_distributed.py (``mp.spawn(main_worker, nprocs)``
+inside the script, explicit ``tcp://127.0.0.1:23456`` rendezvous,
+multiprocessing_distributed.py:114,132-135; start.sh:1).
+
+TPU-native delta: JAX is one process per *host*, with every local chip
+already addressable, so the reference's per-GPU process fan-out collapses
+into the runtime — this recipe is the self-contained shape: plain
+``python -m``, explicit coordinator default (127.0.0.1, the reference's TCP
+address analogue) when ``PTD_TPU_NUM_PROCESSES`` asks for more than one
+process, else single-process over all local chips.  This is the minimum
+end-to-end slice of SURVEY.md §7.3.
+"""
+
+import os
+
+from pytorch_distributed_tpu.recipes._common import run_recipe
+
+
+def main(argv=None) -> float:
+    # Explicit-rendezvous parity: default the coordinator like the
+    # reference's hardcoded tcp://127.0.0.1:23456 when multi-process.
+    if "PTD_TPU_NUM_PROCESSES" in os.environ:
+        os.environ.setdefault("PTD_TPU_COORDINATOR", "127.0.0.1:23456")
+    return run_recipe(
+        "TPU ImageNet Training (self-contained multi-process DP)", argv
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Recipe 7 — the canonical TPU-native recipe (BASELINE.json's north star:
+"add a sixth, TPU-native recipe alongside the five").
+
+Everything on: bf16 compute policy, GSPMD gradient sync fused into the step,
+sharded exact-masked evaluation, double-buffered device feeding, rank-0
+checkpointing with resume, epoch CSV.  On a pod this same entry point spans
+hosts via TPU runtime metadata with zero launcher ceremony.
+"""
+
+from pytorch_distributed_tpu.recipes._common import run_recipe
+
+
+def main(argv=None) -> float:
+    return run_recipe(
+        "TPU ImageNet Training (canonical TPU-native recipe)",
+        argv,
+        precision_default="bf16",
+        epoch_csv_default="tpu_native.csv",
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Checkpoint save / resume.
+
+Capability parity with the reference's ``save_checkpoint``
+(reference distributed.py:327-330, payload at :219-225): a single-file
+checkpoint of ``{epoch, arch, state, best_acc1}`` written by rank 0, copied
+to ``model_best`` on a new best — plus the **resume load path the reference
+lacks** (no ``torch.load`` exists anywhere in the reference; SURVEY.md §5.3).
+
+Like the reference's ``model.module.state_dict()`` unwrap (:223), the saved
+tree is plain host numpy — recipe-interchangeable: any recipe can load any
+recipe's checkpoint regardless of mesh shape, because state is replicated
+(DP) and re-sharding happens at restore time via ``device_put``.
+
+Format: flax msgpack (``flax.serialization``), written atomically
+(tmp + rename).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+from pytorch_distributed_tpu.train.state import TrainState
+
+CHECKPOINT_NAME = "checkpoint.msgpack"
+BEST_NAME = "model_best.msgpack"
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(
+    directory: str,
+    state: TrainState,
+    epoch: int,
+    arch: str,
+    best_acc1: float,
+    is_best: bool,
+    is_primary: bool = True,
+) -> Optional[str]:
+    """Rank-0-guarded atomic save (reference distributed.py:218-225)."""
+    if not is_primary:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    payload = {
+        "epoch": epoch,
+        "arch": arch,
+        "best_acc1": float(best_acc1),
+        "state": _to_host(
+            {
+                "step": state.step,
+                "params": state.params,
+                "batch_stats": state.batch_stats,
+                "momentum": state.momentum,
+            }
+        ),
+    }
+    path = os.path.join(directory, CHECKPOINT_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(payload))
+    os.replace(tmp, path)
+    if is_best:
+        shutil.copyfile(path, os.path.join(directory, BEST_NAME))
+    return path
+
+
+def load_checkpoint(
+    path: str, state_template: TrainState
+) -> Tuple[TrainState, Dict[str, Any]]:
+    """Restore ``(state, meta)`` from a checkpoint file.
+
+    ``state_template`` supplies the pytree structure/shapes (a freshly
+    initialized state for the same arch); meta carries epoch/arch/best_acc1
+    for the ``--start-epoch``/resume flow.
+    """
+    with open(path, "rb") as f:
+        raw = f.read()
+    template = {
+        "epoch": 0,
+        "arch": "",
+        "best_acc1": 0.0,
+        "state": {
+            "step": state_template.step,
+            "params": state_template.params,
+            "batch_stats": state_template.batch_stats,
+            "momentum": state_template.momentum,
+        },
+    }
+    payload = serialization.from_bytes(template, raw)
+    st = payload["state"]
+    state = TrainState(
+        step=st["step"],
+        params=st["params"],
+        batch_stats=st["batch_stats"],
+        momentum=st["momentum"],
+    )
+    meta = {k: payload[k] for k in ("epoch", "arch", "best_acc1")}
+    return state, meta

@@ -1,0 +1,112 @@
+"""CLI / config: the reference's 15-flag surface as one dataclass.
+
+Flag names, shorthands, and defaults mirror reference distributed.py:25-102
+(``--data -a -j --epochs --start-epoch -b --lr --momentum --wd -p -e
+--pretrained --seed``), with the reference's per-recipe extras available as
+opt-ins (``--dist-file`` from distributed_slurm_main.py:102-105) and
+TPU-native additions the recipes need:
+
+- ``--precision {fp32,bf16}``   — the apex-AMP slot (SURVEY.md §7.1)
+- ``--synthetic``               — synthetic dataset (no ImageNet on disk)
+- ``--image-size``              — train crop size (default 224)
+- ``--resume PATH``             — the load path the reference lacks (§5.3)
+- ``--checkpoint-dir``          — where checkpoints land
+
+Like the reference, the global batch is divided by world size in the driver
+(reference distributed.py:146), not here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from pytorch_distributed_tpu import models
+
+
+@dataclasses.dataclass
+class Config:
+    data: str = "/home/zhangzhi/Data/exports/ImageNet2012"
+    arch: str = "resnet18"
+    workers: int = 4
+    epochs: int = 90
+    start_epoch: int = 0
+    batch_size: int = 3200        # GLOBAL batch (reference semantics)
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    print_freq: int = 10
+    evaluate: bool = False
+    pretrained: bool = False
+    seed: Optional[int] = None
+    # per-recipe extras / TPU-native additions
+    dist_file: Optional[str] = None
+    # None = "recipe decides" (apex/tpu_native default to bf16); an explicit
+    # --precision flag always wins over the recipe default.
+    precision: Optional[str] = None
+    synthetic: bool = False
+    synthetic_length: int = 1280
+    image_size: int = 224
+    num_classes: int = 1000
+    resume: Optional[str] = None
+    checkpoint_dir: str = "."
+    epoch_csv: Optional[str] = None
+    # derived at runtime (reference args.nprocs, distributed.py:114)
+    nprocs: int = 1
+
+
+def build_parser(description: str = "TPU ImageNet Training") -> argparse.ArgumentParser:
+    d = Config()
+    names = models.model_names()
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--data", metavar="DIR", default=d.data, help="path to dataset")
+    p.add_argument("-a", "--arch", metavar="ARCH", default=d.arch, choices=names,
+                   help="model architecture: " + " | ".join(names) + f" (default: {d.arch})")
+    p.add_argument("-j", "--workers", default=d.workers, type=int, metavar="N",
+                   help="number of data loading workers (default: 4)")
+    p.add_argument("--epochs", default=d.epochs, type=int, metavar="N",
+                   help="number of total epochs to run")
+    p.add_argument("--start-epoch", default=d.start_epoch, type=int, metavar="N",
+                   help="manual epoch number (useful on restarts)")
+    p.add_argument("-b", "--batch-size", default=d.batch_size, type=int, metavar="N",
+                   help="mini-batch size: total batch size across all chips")
+    p.add_argument("--lr", "--learning-rate", default=d.lr, type=float,
+                   metavar="LR", help="initial learning rate", dest="lr")
+    p.add_argument("--momentum", default=d.momentum, type=float, metavar="M",
+                   help="momentum")
+    p.add_argument("--wd", "--weight-decay", default=d.weight_decay, type=float,
+                   metavar="W", help="weight decay (default: 1e-4)", dest="weight_decay")
+    p.add_argument("-p", "--print-freq", default=d.print_freq, type=int, metavar="N",
+                   help="print frequency (default: 10)")
+    p.add_argument("-e", "--evaluate", dest="evaluate", action="store_true",
+                   help="evaluate model on validation set")
+    p.add_argument("--pretrained", dest="pretrained", action="store_true",
+                   help="use pre-trained model")
+    p.add_argument("--seed", default=d.seed, type=int,
+                   help="seed for initializing training.")
+    p.add_argument("--dist-file", default=d.dist_file, type=str,
+                   help="rendezvous file for multi-host bootstrap (slurm recipe)")
+    p.add_argument("--precision", default=d.precision, choices=("fp32", "bf16"),
+                   help="compute precision policy (bf16 = apex-AMP slot); "
+                   "unset = recipe default")
+    p.add_argument("--synthetic", action="store_true",
+                   help="use a synthetic dataset instead of --data")
+    p.add_argument("--synthetic-length", default=d.synthetic_length, type=int,
+                   help="samples per synthetic epoch")
+    p.add_argument("--image-size", default=d.image_size, type=int,
+                   help="train crop size (default 224)")
+    p.add_argument("--num-classes", default=d.num_classes, type=int,
+                   help="number of classes (synthetic mode; ImageFolder infers)")
+    p.add_argument("--resume", default=d.resume, type=str, metavar="PATH",
+                   help="path to checkpoint to resume from")
+    p.add_argument("--checkpoint-dir", default=d.checkpoint_dir, type=str,
+                   help="directory for checkpoint files")
+    p.add_argument("--epoch-csv", default=d.epoch_csv, type=str,
+                   help="append [timestamp, epoch_seconds] rows to this CSV")
+    return p
+
+
+def parse_config(argv=None, description: str = "TPU ImageNet Training") -> Config:
+    args = build_parser(description).parse_args(argv)
+    return Config(**{k: v for k, v in vars(args).items()})

@@ -1,0 +1,33 @@
+"""Training state: params + BN statistics + SGD momentum, as one pytree.
+
+The TPU-native analogue of the reference's (model, optimizer) pair
+(reference distributed.py:134-156): a single immutable pytree that flows
+through the jitted step function and is donated each step, so parameter
+updates happen in-place in device memory.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray          # global step counter (int32 scalar)
+    params: Pytree             # f32 master weights
+    batch_stats: Pytree        # BatchNorm running mean/var (f32)
+    momentum: Pytree           # SGD momentum buffers (f32, params-shaped)
+
+    @classmethod
+    def create(cls, variables: Pytree, momentum: Pytree) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            momentum=momentum,
+        )

@@ -1,0 +1,207 @@
+"""Jitted SPMD train/eval steps — the heart of the framework.
+
+Replaces the reference's hot loop (reference distributed.py:242-276), which
+performs 4 synchronous collectives + 3 ``.item()`` host syncs per batch
+*before* backward even starts (SURVEY.md §3.1a note), with one compiled XLA
+program per step:
+
+- forward, loss, backward, gradient sync, SGD update, and the global metric
+  means are all **inside** the jitted function;
+- gradient all-reduce is not a backward hook (DDP, distributed.py:147) but a
+  collective XLA fuses into the step — under GSPMD it is inserted
+  automatically from the shardings; in the explicit variant we write the
+  ``psum`` ourselves inside ``shard_map`` (Horovod-recipe analogue, with
+  bf16 wire compression ≙ horovod_distributed.py:159-164);
+- the reference's ``barrier()`` has no equivalent: XLA programs are
+  bulk-synchronous by construction (SURVEY.md §5.8).
+
+Metrics are returned as unready device scalars; meters read them lazily, so
+the host never blocks inside the loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from pytorch_distributed_tpu.ops import cross_entropy, topk_correct
+from pytorch_distributed_tpu.train.optim import sgd_update
+from pytorch_distributed_tpu.train.state import TrainState
+
+Batch = Dict[str, jnp.ndarray]
+Metrics = Dict[str, jnp.ndarray]
+
+
+def _forward_and_sums(model, params, batch_stats, batch: Batch, train: bool):
+    """Weighted-sum loss/metric numerators + weight count (exact over padding)."""
+    variables = {"params": params, "batch_stats": batch_stats}
+    if train:
+        logits, mutated = model.apply(
+            variables, batch["images"], train=True, mutable=["batch_stats"]
+        )
+        new_stats = mutated["batch_stats"]
+    else:
+        logits = model.apply(variables, batch["images"], train=False)
+        new_stats = batch_stats
+    w = batch["weights"].astype(jnp.float32)
+    count = jnp.sum(w)
+    loss_sum = cross_entropy(logits, batch["labels"], weights=w) * count
+    c1 = jnp.sum(topk_correct(logits, batch["labels"], 1) * w)
+    c5 = jnp.sum(topk_correct(logits, batch["labels"], 5) * w)
+    return loss_sum, (logits, new_stats, c1, c5, count)
+
+
+def make_train_step(
+    model,
+    mesh: Mesh,
+    momentum: float = 0.9,
+    weight_decay: float = 1e-4,
+    data_axis: str = "data",
+    wire_dtype: Optional[jnp.dtype] = None,
+    explicit_collectives: bool = False,
+) -> Callable[[TrainState, Batch, jnp.ndarray], Tuple[TrainState, Metrics]]:
+    """Build the jitted train step for ``mesh``.
+
+    Two interchangeable gradient-sync expressions (the recipe difference
+    matrix, SURVEY.md §2.3):
+
+    - GSPMD (default): shardings in, XLA inserts the gradient all-reduce.
+      ≙ DDP's fused bucketed allreduce (reference distributed.py:147-148).
+    - ``explicit_collectives=True``: ``shard_map`` over the data axis with a
+      hand-written ``psum`` — the Horovod-analogue; ``wire_dtype=bf16``
+      reproduces fp16 gradient wire compression
+      (horovod_distributed.py:159-164) as bf16-compressed collectives.
+
+    BatchNorm semantics differ deliberately, matching each formulation's GPU
+    ancestor: GSPMD BN normalizes over the *global* batch (SyncBN — XLA
+    inserts the cross-replica mean), while the shard_map variant normalizes
+    per shard, exactly like torch DDP's unsynced BN (the reference's
+    behavior).  Running stats are pmean'd in both so replicas stay consistent.
+    """
+
+    def sync_grads(grads, count):
+        # grads arrive as *local weighted sums*; psum then normalize.
+        if wire_dtype is not None:
+            grads = jax.tree_util.tree_map(lambda g: g.astype(wire_dtype), grads)
+        grads = jax.lax.psum(grads, data_axis)
+        gcount = jax.lax.psum(count, data_axis)
+        return jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) / gcount, grads
+        ), gcount
+
+    def local_step(state: TrainState, batch: Batch, lr: jnp.ndarray):
+        """Runs per-shard under shard_map; all reductions explicit."""
+
+        def loss_fn(params):
+            loss_sum, aux = _forward_and_sums(
+                model, params, state.batch_stats, batch, train=True
+            )
+            return loss_sum, aux  # local *sum*; normalized after psum
+
+        (loss_sum, (_, new_stats, c1, c5, count)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        grads, gcount = sync_grads(grads, count)
+        new_params, new_momentum = sgd_update(
+            grads, state.momentum, state.params, lr,
+            momentum=momentum, weight_decay=weight_decay,
+        )
+        # BN running stats: average local EMAs across shards so replicas agree.
+        new_stats = jax.lax.pmean(new_stats, data_axis)
+        metrics = {
+            "loss": jax.lax.psum(loss_sum, data_axis) / gcount,
+            "acc1": jax.lax.psum(c1, data_axis) * 100.0 / gcount,
+            "acc5": jax.lax.psum(c5, data_axis) * 100.0 / gcount,
+        }
+        return (
+            TrainState(state.step + 1, new_params, new_stats, new_momentum),
+            metrics,
+        )
+
+    def global_step(state: TrainState, batch: Batch, lr: jnp.ndarray):
+        """GSPMD formulation: global-semantics math, XLA infers collectives."""
+
+        def loss_fn(params):
+            loss_sum, aux = _forward_and_sums(
+                model, params, state.batch_stats, batch, train=True
+            )
+            count = aux[4]
+            return loss_sum / jnp.maximum(count, 1.0), aux
+
+        (loss, (_, new_stats, c1, c5, count)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        if wire_dtype is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(wire_dtype).astype(jnp.float32), grads
+            )
+        new_params, new_momentum = sgd_update(
+            grads, state.momentum, state.params, lr,
+            momentum=momentum, weight_decay=weight_decay,
+        )
+        metrics = {
+            "loss": loss,
+            "acc1": c1 * 100.0 / count,
+            "acc5": c5 * 100.0 / count,
+        }
+        return (
+            TrainState(state.step + 1, new_params, new_stats, new_momentum),
+            metrics,
+        )
+
+    replicated = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P(data_axis))
+    batch_shardings = {"images": sharded, "labels": sharded, "weights": sharded}
+
+    if explicit_collectives:
+        batch_specs = {k: P(data_axis) for k in ("images", "labels", "weights")}
+        stepped = shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), batch_specs, P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    else:
+        stepped = global_step
+
+    return jax.jit(
+        stepped,
+        in_shardings=(replicated, batch_shardings, replicated),
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(
+    model,
+    mesh: Mesh,
+    data_axis: str = "data",
+) -> Callable[[TrainState, Batch], Metrics]:
+    """Distributed evaluation step (reference validate(),
+    distributed.py:279-324 + the README's distributed-eval chapter).
+
+    Returns weighted *sums* (loss·w, correct@1, correct@5, count) so the host
+    can aggregate exactly over an epoch — the all-reduce lives inside the
+    compiled program; no ``barrier()`` + 3 ``all_reduce`` calls per batch.
+    """
+
+    def step(state: TrainState, batch: Batch) -> Metrics:
+        loss_sum, (_, _, c1, c5, count) = _forward_and_sums(
+            model, state.params, state.batch_stats, batch, train=False
+        )
+        return {"loss_sum": loss_sum, "correct1": c1, "correct5": c5, "count": count}
+
+    replicated = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P(data_axis))
+    batch_shardings = {"images": sharded, "labels": sharded, "weights": sharded}
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch_shardings),
+        out_shardings=replicated,
+    )

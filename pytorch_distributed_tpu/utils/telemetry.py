@@ -1,0 +1,67 @@
+"""Device telemetry sampler — the reference's ``statistics.sh`` equivalent.
+
+The reference samples ``nvidia-smi --query-gpu=timestamp,index,memory.total,
+memory.used,utilization.gpu`` every 500 ms into a per-recipe CSV
+(reference statistics.sh:1-4).  Here the same file contract is fed from the
+TPU runtime's per-device memory statistics (``Device.memory_stats()``), plus
+wall-clock; columns: ``timestamp,index,bytes_limit,bytes_in_use,peak_bytes``.
+
+Run standalone (``python statistics.py``) or in-process via ``TelemetrySampler``.
+"""
+
+from __future__ import annotations
+
+import csv
+import threading
+import time
+from typing import Optional
+
+
+def sample_devices():
+    import jax
+
+    rows = []
+    now = time.time()
+    for i, d in enumerate(jax.local_devices()):
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # backends without memory_stats (CPU sim)
+            pass
+        rows.append(
+            [
+                now,
+                i,
+                stats.get("bytes_limit", 0),
+                stats.get("bytes_in_use", 0),
+                stats.get("peak_bytes_in_use", 0),
+            ]
+        )
+    return rows
+
+
+class TelemetrySampler:
+    """Background 500 ms sampler appending CSV rows (statistics.sh contract)."""
+
+    def __init__(self, path: str, interval_s: float = 0.5):
+        self.path = path
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "TelemetrySampler":
+        def loop():
+            while not self._stop.is_set():
+                rows = sample_devices()
+                with open(self.path, "a+", newline="") as f:
+                    csv.writer(f).writerows(rows)
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join()

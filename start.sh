@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Canonical launch lines, one per recipe — reference start.sh:1-5 parity.
+# For smoke runs on a non-TPU host, prefix any line with
+#   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+# to simulate an 8-chip mesh on CPU.
+
+# 1. self-contained multi-process DP (ref start.sh:1: python multiprocessing_distributed.py)
+python -m pytorch_distributed_tpu.recipes.multiprocessing_distributed --data "$DATA"
+
+# 2. external-launcher DP (ref start.sh:2: torch.distributed.launch --nproc_per_node=4 distributed.py)
+#    On GPU-style clusters the launcher exports PTD_TPU_*; on a TPU pod none needed.
+PTD_TPU_COORDINATOR=127.0.0.1:23456 PTD_TPU_NUM_PROCESSES=1 PTD_TPU_PROCESS_ID=0 \
+  python -m pytorch_distributed_tpu.recipes.distributed --data "$DATA"
+
+# 3. bf16 mixed precision (ref start.sh:3: torch.distributed.launch apex_distributed.py)
+python -m pytorch_distributed_tpu.recipes.apex_distributed --data "$DATA"
+
+# 4. explicit collectives + compressed wire grads (ref start.sh:4: horovodrun -np 4 horovod_distributed.py)
+python -m pytorch_distributed_tpu.recipes.horovod_distributed --data "$DATA"
+
+# 5. multi-node SLURM / multi-slice pod (ref start.sh:5: srun -N2 --gres gpu:4 distributed_slurm_main.py)
+# srun -N2 --ntasks-per-node=1 python -m pytorch_distributed_tpu.recipes.distributed_slurm_main --data "$DATA"
+
+# 6. single-process DataParallel baseline (ref README.md:86: python dataparallel.py)
+python -m pytorch_distributed_tpu.recipes.dataparallel --data "$DATA"
+
+# 7. canonical TPU-native recipe (BASELINE.json north star)
+python -m pytorch_distributed_tpu.recipes.tpu_native --data "$DATA" -a resnet50

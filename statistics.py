@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""TPU telemetry to CSV — reference statistics.sh parity (statistics.sh:1-4).
+
+Usage:  python statistics.py [outfile.csv] [interval_seconds]
+Samples per-device memory stats every 500 ms (default) until Ctrl-C.
+"""
+
+import sys
+import time
+
+from pytorch_distributed_tpu.utils.telemetry import TelemetrySampler
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "tpu_statistics.csv"
+    interval = float(sys.argv[2]) if len(sys.argv) > 2 else 0.5
+    sampler = TelemetrySampler(path, interval).start()
+    print(f"sampling device memory to {path} every {interval}s (Ctrl-C to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        sampler.stop()
+
+
+if __name__ == "__main__":
+    main()

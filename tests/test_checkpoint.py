@@ -1,0 +1,70 @@
+"""Checkpoint round-trip, best-copy, rank guard, and resume semantics."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu import models
+from pytorch_distributed_tpu.train.checkpoint import (
+    BEST_NAME,
+    CHECKPOINT_NAME,
+    load_checkpoint,
+    save_checkpoint,
+)
+from pytorch_distributed_tpu.train.optim import sgd_init
+from pytorch_distributed_tpu.train.state import TrainState
+
+
+def _state(seed=0):
+    model = models.create_model("resnet18", num_classes=10)
+    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 32, 32, 3)), train=False)
+    return TrainState.create(variables, sgd_init(variables["params"]))
+
+
+def _tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_round_trip(tmp_path):
+    state = _state(seed=1)
+    path = save_checkpoint(
+        str(tmp_path), state, epoch=7, arch="resnet18", best_acc1=55.5, is_best=False
+    )
+    assert path and os.path.exists(path)
+    template = _state(seed=2)  # different values, same structure
+    restored, meta = load_checkpoint(path, template)
+    assert meta == {"epoch": 7, "arch": "resnet18", "best_acc1": 55.5}
+    _tree_equal(restored.params, state.params)
+    _tree_equal(restored.momentum, state.momentum)
+    _tree_equal(restored.batch_stats, state.batch_stats)
+
+
+def test_best_copy(tmp_path):
+    state = _state()
+    save_checkpoint(str(tmp_path), state, 0, "resnet18", 10.0, is_best=True)
+    assert os.path.exists(tmp_path / BEST_NAME)
+    # Non-best save must not touch model_best.
+    best_mtime = os.path.getmtime(tmp_path / BEST_NAME)
+    save_checkpoint(str(tmp_path), state, 1, "resnet18", 10.0, is_best=False)
+    assert os.path.getmtime(tmp_path / BEST_NAME) == best_mtime
+
+
+def test_rank_guard(tmp_path):
+    state = _state()
+    out = save_checkpoint(
+        str(tmp_path), state, 0, "resnet18", 0.0, is_best=True, is_primary=False
+    )
+    assert out is None
+    assert not os.path.exists(tmp_path / CHECKPOINT_NAME)
+
+
+def test_no_partial_file_on_overwrite(tmp_path):
+    state = _state()
+    save_checkpoint(str(tmp_path), state, 0, "resnet18", 1.0, is_best=False)
+    save_checkpoint(str(tmp_path), state, 1, "resnet18", 2.0, is_best=False)
+    _, meta = load_checkpoint(str(tmp_path / CHECKPOINT_NAME), _state())
+    assert meta["epoch"] == 1
+    assert not os.path.exists(str(tmp_path / CHECKPOINT_NAME) + ".tmp")

@@ -1,0 +1,69 @@
+"""Recipe smoke matrix — the de-facto test the reference ran by hand
+(start.sh launches, SURVEY.md §4 item 1), executed on the simulated mesh."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.recipes import (
+    apex_distributed,
+    dataparallel,
+    distributed,
+    horovod_distributed,
+    multiprocessing_distributed,
+    tpu_native,
+)
+from pytorch_distributed_tpu.recipes import distributed_slurm_main
+
+SMOKE_ARGS = [
+    "--synthetic",
+    "--synthetic-length", "32",
+    "-a", "resnet18",
+    "--image-size", "32",
+    "--num-classes", "4",
+    "-b", "16",
+    "--epochs", "1",
+    "-p", "1",
+    "--seed", "0",
+]
+
+
+def _args(tmp_path, extra=()):
+    return SMOKE_ARGS + ["--checkpoint-dir", str(tmp_path)] + list(extra)
+
+
+@pytest.mark.parametrize(
+    "recipe",
+    [
+        dataparallel,
+        distributed,
+        multiprocessing_distributed,
+        apex_distributed,
+        horovod_distributed,
+        distributed_slurm_main,
+        tpu_native,
+    ],
+    ids=lambda m: m.__name__.rsplit(".", 1)[-1],
+)
+def test_recipe_trains_one_epoch(recipe, tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # recipes with epoch CSVs write into cwd
+    best = recipe.main(_args(tmp_path))
+    out = capsys.readouterr().out
+    assert "Epoch: [0]" in out
+    assert "* Acc@1" in out
+    assert 0.0 <= best <= 100.0
+    assert (tmp_path / "checkpoint.msgpack").exists()
+
+
+def test_epoch_csv_written_by_dataparallel(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    dataparallel.main(_args(tmp_path))
+    csv_path = tmp_path / "dataparallel.csv"
+    assert csv_path.exists()
+    row = csv_path.read_text().strip().splitlines()[0].split(",")
+    assert len(row) == 2 and float(row[1]) > 0
+
+
+def test_evaluate_flag(tmp_path, capsys):
+    best = tpu_native.main(_args(tmp_path, ["-e"]))
+    out = capsys.readouterr().out
+    assert "* Acc@1" in out and "Epoch: [0]" not in out

@@ -1,0 +1,99 @@
+"""Regressions for code-review findings: num_classes inference order,
+explicit-precision precedence, evaluate return value, --pretrained wiring."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.train.config import Config
+from pytorch_distributed_tpu.train.trainer import Trainer
+
+
+def _imagefolder(tmp_path, classes=3, per_class=4, size=32):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for split in ("train", "val"):
+        for c in range(classes):
+            d = tmp_path / split / f"cls{c}"
+            d.mkdir(parents=True)
+            for i in range(per_class):
+                arr = rng.integers(0, 256, size=(size, size, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+    return str(tmp_path)
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        arch="resnet18", batch_size=8, epochs=1, print_freq=1, seed=0,
+        synthetic=True, synthetic_length=16, image_size=32, num_classes=4,
+        checkpoint_dir=str(tmp_path), workers=2,
+    )
+    base.update(kw)
+    return Config(**base)
+
+
+def test_imagefolder_num_classes_sizes_the_head(tmp_path):
+    root = _imagefolder(tmp_path / "data", classes=3)
+    cfg = _cfg(tmp_path, synthetic=False, data=root, num_classes=1000)
+    t = Trainer(cfg)
+    assert cfg.num_classes == 3
+    fc_kernel = t.state.params["fc"]["kernel"]
+    assert fc_kernel.shape[-1] == 3  # head sized by inferred classes
+
+
+def test_explicit_precision_fp32_wins_over_recipe_default(tmp_path, monkeypatch):
+    from pytorch_distributed_tpu.recipes import apex_distributed
+
+    monkeypatch.chdir(tmp_path)
+    captured = {}
+    import pytorch_distributed_tpu.recipes._common as common
+
+    orig = common.Trainer
+
+    class SpyTrainer(orig):
+        def __init__(self, cfg, **kw):
+            captured["precision"] = cfg.precision
+            super().__init__(cfg, **kw)
+
+    monkeypatch.setattr(common, "Trainer", SpyTrainer)
+    args = ["--synthetic", "--synthetic-length", "16", "-a", "resnet18",
+            "--image-size", "32", "--num-classes", "2", "-b", "8",
+            "--epochs", "1", "--checkpoint-dir", str(tmp_path)]
+    apex_distributed.main(args + ["--precision", "fp32"])
+    assert captured["precision"] == "fp32"
+    apex_distributed.main(args)
+    assert captured["precision"] == "bf16"  # recipe default when unset
+
+
+def test_evaluate_returns_measured_accuracy(tmp_path):
+    t = Trainer(_cfg(tmp_path, num_classes=2, evaluate=True))
+    acc = t.fit()
+    # Must be the measured value, not the stale best_acc1=0 (a 2-class random
+    # head is essentially never exactly 0% on 16 samples... but accept 0<=.
+    assert acc == pytest.approx(t.validate(), abs=1e-6)
+
+
+def test_pretrained_missing_weights_fails_loudly(tmp_path, monkeypatch):
+    monkeypatch.setenv("PTD_TPU_PRETRAINED_DIR", str(tmp_path / "nowhere"))
+    with pytest.raises(FileNotFoundError, match="--pretrained"):
+        Trainer(_cfg(tmp_path, pretrained=True))
+
+
+def test_pretrained_loads_saved_checkpoint(tmp_path, monkeypatch, capsys):
+    t = Trainer(_cfg(tmp_path, num_classes=4))
+    from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
+
+    pdir = tmp_path / "zoo"
+    save_checkpoint(str(pdir), t.state, 0, "resnet18", 50.0, is_best=False)
+    os.rename(pdir / "checkpoint.msgpack", pdir / "resnet18.msgpack")
+    monkeypatch.setenv("PTD_TPU_PRETRAINED_DIR", str(pdir))
+
+    t2 = Trainer(_cfg(tmp_path, num_classes=4, pretrained=True))
+    assert "using pre-trained model" in capsys.readouterr().out
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(t.state.params),
+                    jax.tree_util.tree_leaves(t2.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
