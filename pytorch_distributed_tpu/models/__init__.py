@@ -27,6 +27,15 @@ from pytorch_distributed_tpu.models.resnet import (  # noqa: F401
     resnext101_32x8d,
 )
 
+from pytorch_distributed_tpu.models.transformer import (  # noqa: F401
+    TransformerLM,
+    transformer_lm,
+)
+
+# Image-classification zoo: the ``-a`` choices of every recipe CLI
+# (reference distributed.py:21-23 surface).  Language models live in a
+# separate registry — they take token inputs and train through the LM path,
+# so exposing them as image-recipe archs would only offer a guaranteed crash.
 _REGISTRY: Dict[str, Callable] = {
     "resnet18": resnet18,
     "resnet34": resnet34,
@@ -40,19 +49,36 @@ _REGISTRY: Dict[str, Callable] = {
 }
 
 
-def register(name: str, ctor: Callable) -> None:
-    """Add a model family to the registry (used by models/transformer.py)."""
-    _REGISTRY[name] = ctor
+_LM_REGISTRY: Dict[str, Callable] = {
+    "transformer_lm": transformer_lm,
+}
+
+
+def register(name: str, ctor: Callable, family: str = "image") -> None:
+    """Add a model to a registry family ('image' or 'lm')."""
+    (_REGISTRY if family == "image" else _LM_REGISTRY)[name] = ctor
     globals()[name] = ctor
 
 
 def model_names() -> List[str]:
-    """Sorted architecture names (reference distributed.py:21-23)."""
+    """Sorted image-arch names — the recipe-CLI ``-a`` surface
+    (reference distributed.py:21-23)."""
     return sorted(_REGISTRY)
 
 
+def lm_model_names() -> List[str]:
+    """Sorted language-model arch names (long-context family)."""
+    return sorted(_LM_REGISTRY)
+
+
 def create_model(name: str, num_classes: int = 1000, dtype: Any = jnp.float32, **kw):
-    """``models.__dict__[arch]()`` equivalent (reference distributed.py:134-139)."""
-    if name not in _REGISTRY:
-        raise ValueError(f"unknown arch {name!r}; choose from {model_names()}")
-    return _REGISTRY[name](num_classes=num_classes, dtype=dtype, **kw)
+    """``models.__dict__[arch]()`` equivalent (reference distributed.py:134-139).
+
+    Resolves both families; ``num_classes`` plays the vocab-size role for LMs.
+    """
+    registry = _REGISTRY if name in _REGISTRY else _LM_REGISTRY
+    if name not in registry:
+        raise ValueError(
+            f"unknown arch {name!r}; choose from {model_names() + lm_model_names()}"
+        )
+    return registry[name](num_classes=num_classes, dtype=dtype, **kw)

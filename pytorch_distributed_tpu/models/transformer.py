@@ -1,0 +1,116 @@
+"""Decoder-only transformer LM with optional ring-attention sequence
+parallelism — the framework's long-context model family.
+
+Beyond-reference capability (the reference is image-classification only,
+SURVEY.md §5.7), first-class per the framework brief.  The same module runs:
+
+- single-device / pure-DP with dense attention;
+- sequence-parallel over a ``seq`` mesh axis via ``parallel/ring.py``'s ring
+  attention (KV blocks rotate on ICI, online softmax, O(L/P) memory).
+
+TPU-first choices: pre-LN blocks (stable in bf16), RoPE positions (position
+math is local so sequence sharding needs no global gather), GELU MLP at 4×
+width, f32 layernorm/softmax accumulation under a bf16 compute policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from pytorch_distributed_tpu.parallel.ring import dense_attention, ring_self_attention
+
+
+def rope(x: jnp.ndarray, base: float = 10000.0) -> jnp.ndarray:
+    """Rotary position embedding over [B, L, H, D] (global positions — under
+    GSPMD the position index is computed on the full array, so sequence
+    sharding stays transparent)."""
+    B, L, H, D = x.shape
+    half = D // 2
+    freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = jnp.arange(L, dtype=jnp.float32)[:, None] * freqs[None, :]  # [L, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+class SelfAttention(nn.Module):
+    n_heads: int
+    dtype: Any = jnp.float32
+    mesh: Optional[Mesh] = None
+    ring: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, C = x.shape
+        D = C // self.n_heads
+        qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, L, self.n_heads, D)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q, k = rope(q), rope(k)
+        if self.ring:
+            if self.mesh is None:
+                raise ValueError("ring attention requires a mesh with a 'seq' axis")
+            out = ring_self_attention(q, k, v, self.mesh, causal=True)
+        else:
+            out = dense_attention(q, k, v, causal=True)
+        out = out.reshape(B, L, C)
+        return nn.Dense(C, use_bias=False, dtype=self.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    n_heads: int
+    dtype: Any = jnp.float32
+    mesh: Optional[Mesh] = None
+    ring: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + SelfAttention(self.n_heads, self.dtype, self.mesh, self.ring,
+                              name="attn")(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(4 * C, dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(C, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Next-token LM.  ``__call__(tokens[B, L]) -> logits[B, L, vocab]``."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 8
+    dtype: Any = jnp.float32
+    mesh: Optional[Mesh] = None
+    ring: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        embed = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                         name="embed")
+        x = embed(tokens)
+        for i in range(self.n_layers):
+            x = Block(self.n_heads, self.dtype, self.mesh, self.ring,
+                      name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Tied output head (embed.attend) keeps params lean at long context.
+        return embed.attend(x.astype(jnp.float32)).astype(jnp.float32)
+
+
+def transformer_lm(num_classes: int = 32000, dtype: Any = jnp.float32, **kw):
+    """Registry adapter: ``num_classes`` plays the vocab-size role."""
+    return TransformerLM(vocab_size=num_classes, dtype=dtype, **kw)

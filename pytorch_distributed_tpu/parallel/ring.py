@@ -1,0 +1,137 @@
+"""Ring attention: sequence/context parallelism over a ``seq`` mesh axis.
+
+Beyond-reference capability (the reference has none — SURVEY.md §5.7), built
+first-class per the framework brief: long sequences are sharded across
+devices on the ``seq`` axis; each device computes attention for its local
+query block while key/value blocks rotate around the ring via
+``jax.lax.ppermute`` (ICI neighbor exchanges), overlapping compute with
+transfer.  Softmax is computed **online** (flash-attention style running
+max/denominator), so no device ever materializes the full [L, L] score
+matrix — memory is O(L·L/P) per device and sequence length scales linearly
+with ring size.
+
+Layout contract: ``[batch, seq, heads, head_dim]``, sequence sharded on
+``seq``, batch optionally sharded on ``data``.  The inner function runs
+under ``shard_map``; ``ring_self_attention`` applies the wrapper for you.
+
+Reference pattern: Ring Attention (Liu et al. 2023) blockwise formulation;
+see also the ring-collective pattern in the Pallas TPU guide (§Patterns:
+Ring Collectives) — a Pallas RDMA kernel is the planned upgrade path; this
+XLA-collective version is the semantics anchor it will be tested against.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps fully-masked rows NaN-free
+
+
+def _block_attention(q, k, v, m, l, acc, qpos, kpos, scale, causal):
+    """One online-softmax accumulation of q against a (k, v) block.
+
+    q: [B, Lq, H, D]; k/v: [B, Lk, H, D]; m,l: [B, H, Lq]; acc like q.
+    qpos: [Lq] global query positions; kpos: [Lk] global key positions.
+    """
+    # scores: [B, H, Lq, Lk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        mask = kpos[None, None, None, :] <= qpos[None, None, :, None]
+        scores = jnp.where(mask, scores, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])          # [B, H, Lq, Lk]
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "seq",
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Blockwise ring attention; call *inside* ``shard_map``.
+
+    Arguments are the device-local blocks ``[B, L/P, H, D]``.  Requires the
+    global sequence to be evenly sharded (same L/P on every device).
+    """
+    P_ = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, Lb, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+
+    local_pos = jnp.arange(Lb)
+    qpos = idx * Lb + local_pos
+
+    m0 = jnp.full((B, H, Lb), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Lb), jnp.float32)
+    acc0 = jnp.zeros((B, Lb, H, D), jnp.float32)
+
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+
+    def body(carry, _):
+        kv_k, kv_v, kv_idx, m, l, acc = carry
+        kpos = kv_idx * Lb + local_pos
+        m, l, acc = _block_attention(
+            q.astype(jnp.float32),
+            kv_k.astype(jnp.float32),
+            kv_v.astype(jnp.float32),
+            m, l, acc, qpos, kpos, scale, causal,
+        )
+        # Rotate kv blocks one step around the ring (ICI neighbor exchange).
+        kv_k = jax.lax.ppermute(kv_k, axis_name, perm)
+        kv_v = jax.lax.ppermute(kv_v, axis_name, perm)
+        kv_idx = jax.lax.ppermute(kv_idx, axis_name, perm)
+        return (kv_k, kv_v, kv_idx, m, l, acc), None
+
+    init = (k, v, idx, m0, l0, acc0)
+    (kv_k, kv_v, kv_idx, m, l, acc), _ = jax.lax.scan(
+        body, init, None, length=P_
+    )
+    # Normalize; fully-masked rows (l==0) can only occur non-causally with
+    # empty inputs — guard anyway.
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    causal: bool = True,
+    seq_axis: str = "seq",
+    data_axis: Optional[str] = "data",
+) -> jnp.ndarray:
+    """``shard_map`` wrapper: global ``[B, L, H, D]`` in, same out, with L
+    sharded over ``seq_axis`` (and B over ``data_axis`` if present)."""
+    batch_spec = data_axis if data_axis in mesh.axis_names else None
+    spec = P(batch_spec, seq_axis, None, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def dense_attention(q, k, v, causal: bool = True) -> jnp.ndarray:
+    """Single-device reference semantics (the oracle ring_attention is
+    tested against)."""
+    B, L, H, D = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / (D ** 0.5)
+    if causal:
+        pos = jnp.arange(L)
+        scores = jnp.where(pos[None, None, None, :] <= pos[None, None, :, None],
+                           scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32)).astype(q.dtype)

@@ -6,7 +6,7 @@ memory.used,utilization.gpu`` every 500 ms into a per-recipe CSV
 TPU runtime's per-device memory statistics (``Device.memory_stats()``), plus
 wall-clock; columns: ``timestamp,index,bytes_limit,bytes_in_use,peak_bytes``.
 
-Run standalone (``python statistics.py``) or in-process via ``TelemetrySampler``.
+Run standalone (``python tpu_statistics.py``) or in-process via ``TelemetrySampler``.
 """
 
 from __future__ import annotations

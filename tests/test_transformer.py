@@ -1,0 +1,59 @@
+"""TransformerLM: ring-parallel model ≡ dense model, and it learns."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.models.transformer import TransformerLM
+from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+from pytorch_distributed_tpu.ops import cross_entropy
+
+
+def _mesh():
+    return build_mesh(MeshSpec(("data", "seq"), (2, 4)), jax.devices()[:8])
+
+
+def _tokens(B=2, L=32, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, vocab, size=(B, L)).astype(np.int32))
+
+
+def test_ring_model_matches_dense_model():
+    mesh = _mesh()
+    kw = dict(vocab_size=64, d_model=64, n_heads=4, n_layers=2)
+    dense = TransformerLM(**kw)
+    ringm = TransformerLM(**kw, mesh=mesh, ring=True)
+    tokens = _tokens()
+    params = dense.init(jax.random.PRNGKey(0), tokens)
+    out_d = dense.apply(params, tokens)
+    out_r = ringm.apply(params, tokens)  # same params, sp execution
+    np.testing.assert_allclose(
+        np.asarray(out_r), np.asarray(out_d), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_lm_train_step_learns_over_data_seq_mesh():
+    """Full sp+dp LM training step: loss must drop on a memorizable batch."""
+    mesh = _mesh()
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1,
+                          mesh=mesh, ring=True)
+    tokens = _tokens(B=4, L=16, vocab=32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    def loss_fn(params, tokens):
+        logits = model.apply(params, tokens)
+        return cross_entropy(
+            logits[:, :-1].reshape(-1, 32), tokens[:, 1:].reshape(-1)
+        )
+
+    @jax.jit
+    def step(params, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.5 * g, params, grads)
+        return params, loss
+
+    losses = []
+    for _ in range(10):
+        params, loss = step(params, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """TPU telemetry to CSV — reference statistics.sh parity (statistics.sh:1-4).
 
-Usage:  python statistics.py [outfile.csv] [interval_seconds]
+Usage:  python tpu_statistics.py [outfile.csv] [interval_seconds]
 Samples per-device memory stats every 500 ms (default) until Ctrl-C.
 """
 
