@@ -55,10 +55,11 @@ class ImageFolder:
         with Image.open(path) as im:
             img = im.convert("RGB")
             if self.transform is not None:
-                img = self.transform(rng, img)
+                img = np.asarray(self.transform(rng, img))
             else:
                 img = np.asarray(img, dtype=np.float32) / 255.0
-        return np.asarray(img, dtype=np.float32), label
+        # Preserve uint8 from the *_u8 stacks; everything else is f32.
+        return (img if img.dtype == np.uint8 else img.astype(np.float32)), label
 
     def __getitem__(self, index: int) -> Tuple[np.ndarray, int]:
         return self.get(index)
@@ -96,8 +97,8 @@ class SyntheticImageDataset:
         if rng is None:
             rng = content_rng
         if self.transform is not None:
-            img = self.transform(rng, img)
-            return np.asarray(img, dtype=np.float32), label
+            out = np.asarray(self.transform(rng, img))
+            return (out if out.dtype == np.uint8 else out.astype(np.float32)), label
         return img.astype(np.float32) / 255.0, label
 
     def __getitem__(self, index: int) -> Tuple[np.ndarray, int]:

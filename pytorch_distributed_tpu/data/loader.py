@@ -21,6 +21,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -44,7 +45,23 @@ class DataLoader:
         num_workers: int = 2,
         drop_last: bool = False,
         seed: int = 0,
+        batch_mode: str = "f32",
+        random_flip: bool = False,
     ):
+        """``batch_mode``:
+
+        - ``"f32"``     — per-sample transforms yield normalized float32
+                          (reference-shaped pipeline; default);
+        - ``"u8_host"`` — transforms yield uint8; flip+normalize run at batch
+                          level in the native C++ library (data/native/);
+        - ``"u8_wire"`` — transforms yield uint8; flip runs host-side, the
+                          batch crosses PCIe/ICI as uint8 (4× fewer bytes)
+                          and normalization happens on device (DeviceFeeder).
+        ``random_flip`` applies the train-stack horizontal flip in the u8
+        modes (in f32 mode the flip lives in the per-sample transform).
+        """
+        if batch_mode not in ("f32", "u8_host", "u8_wire"):
+            raise ValueError(f"unknown batch_mode {batch_mode!r}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.sampler = sampler or DistributedShardSampler(
@@ -53,6 +70,8 @@ class DataLoader:
         self.num_workers = max(1, num_workers)
         self.drop_last = drop_last
         self.seed = seed
+        self.batch_mode = batch_mode
+        self.random_flip = random_flip
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
@@ -84,12 +103,42 @@ class DataLoader:
                     val = np.concatenate([val, np.zeros(pad, dtype=val.dtype)])
                 samples = list(pool.map(self._fetch, idx, val))
                 proto = next(s for s in samples if s is not None)
-                images = np.zeros((self.batch_size,) + proto[0].shape, dtype=np.float32)
+                img_dtype = np.uint8 if self.batch_mode != "f32" else np.float32
+                if self.batch_mode != "f32" and proto[0].dtype != np.uint8:
+                    raise TypeError(
+                        f"batch_mode {self.batch_mode!r} needs uint8 samples "
+                        f"(use the *_transform_u8 stacks), got {proto[0].dtype}"
+                    )
+                images = np.zeros((self.batch_size,) + proto[0].shape, dtype=img_dtype)
                 labels = np.zeros(self.batch_size, dtype=np.int32)
                 for i, s in enumerate(samples):
                     if s is not None:
                         images[i] = s[0]
                         labels[i] = s[1]
+                if self.batch_mode != "f32":
+                    flip_rng = np.random.default_rng(
+                        (self.seed, self.sampler.epoch, b, 1)
+                    )
+                    flip = (
+                        (flip_rng.random(self.batch_size) < 0.5).astype(np.uint8)
+                        if self.random_flip
+                        else None
+                    )
+                    if self.batch_mode == "u8_host":
+                        from pytorch_distributed_tpu.data.native import (
+                            normalize_batch,
+                        )
+                        from pytorch_distributed_tpu.data.transforms import (
+                            IMAGENET_MEAN,
+                            IMAGENET_STD,
+                        )
+
+                        images = normalize_batch(
+                            images, IMAGENET_MEAN, IMAGENET_STD, flip=flip
+                        )
+                    elif flip is not None:  # u8_wire: flip on host, u8 out
+                        fidx = np.nonzero(flip)[0]
+                        images[fidx] = images[fidx, :, ::-1, :]
                 yield {
                     "images": images,
                     "labels": labels,
@@ -111,6 +160,7 @@ class DeviceFeeder:
         self.mesh = mesh
         self.data_axis = data_axis
         self.prefetch = max(1, prefetch)
+        self._dev_norm = None  # built lazily on first uint8 batch
 
     def _shardings(self) -> Dict[str, NamedSharding]:
         spec = P(self.data_axis)
@@ -130,10 +180,28 @@ class DeviceFeeder:
                 f"multiple of {n_shards // jax.process_count() or 1}"
             )
         sh = self._shardings()
-        return {
+        out = {
             k: jax.make_array_from_process_local_data(sh[k], v)
             for k, v in batch.items()
         }
+        if out["images"].dtype == jnp.uint8:
+            # u8_wire mode: the batch crossed the wire as uint8; normalize on
+            # device (fused by XLA; replaces the apex GPU-side sub_/div_,
+            # reference apex_distributed.py:123-158 — minus its
+            # double-normalize quirk, SURVEY.md §7.5).
+            if self._dev_norm is None:
+                from pytorch_distributed_tpu.data.transforms import (
+                    IMAGENET_MEAN,
+                    IMAGENET_STD,
+                )
+
+                mean = jnp.asarray(IMAGENET_MEAN)
+                std = jnp.asarray(IMAGENET_STD)
+                self._dev_norm = jax.jit(
+                    lambda x: (x.astype(jnp.float32) / 255.0 - mean) / std
+                )
+            out["images"] = self._dev_norm(out["images"])
+        return out
 
     def __call__(self, host_iter) -> Iterator[Dict[str, jax.Array]]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)

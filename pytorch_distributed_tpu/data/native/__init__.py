@@ -1,0 +1,8 @@
+"""Native (C++) host-side data plane.  See native.py for the ctypes binding."""
+
+from pytorch_distributed_tpu.data.native.binding import (
+    native_available,
+    normalize_batch,
+)
+
+__all__ = ["native_available", "normalize_batch"]

@@ -1,0 +1,108 @@
+"""ctypes binding for the C++ data-plane library (ptd_data.cpp).
+
+Builds the shared library on first use if g++ is available (the image bakes
+the native toolchain; pybind11 is not present, hence ctypes).  Falls back to
+a numpy implementation with identical semantics when no compiler exists, so
+the framework stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ptd_data.cpp")
+_LIB_PATH = os.path.join(_HERE, "libptd_data.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", _LIB_PATH, _SRC, "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(
+            _LIB_PATH
+        ) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.ptd_normalize_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.ptd_normalize_batch.restype = None
+        lib.ptd_data_abi_version.restype = ctypes.c_int
+        if lib.ptd_data_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def normalize_batch(
+    images_u8: np.ndarray,
+    mean: np.ndarray,
+    std: np.ndarray,
+    flip: Optional[np.ndarray] = None,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """(u8 NHWC batch / 255 - mean) / std, with optional per-sample hflip.
+
+    C++ fast path when available; numpy fallback otherwise (bit-identical up
+    to f32 rounding — tested in tests/test_native.py).
+    """
+    assert images_u8.dtype == np.uint8 and images_u8.ndim == 4
+    n, h, w, c = images_u8.shape
+    assert c == 3, "NHWC RGB expected"
+    mean = np.ascontiguousarray(mean, dtype=np.float32)
+    std = np.ascontiguousarray(std, dtype=np.float32)
+    lib = _load()
+    if lib is not None:
+        images_u8 = np.ascontiguousarray(images_u8)
+        out = np.empty((n, h, w, c), dtype=np.float32)
+        flip_arr = (
+            np.ascontiguousarray(flip, dtype=np.uint8) if flip is not None else None
+        )
+        lib.ptd_normalize_batch(
+            images_u8.ctypes.data, out.ctypes.data,
+            n, h, w,
+            mean.ctypes.data, std.ctypes.data,
+            flip_arr.ctypes.data if flip_arr is not None else None,
+            n_threads,
+        )
+        return out
+    # numpy fallback, same semantics
+    imgs = images_u8.astype(np.float32) / 255.0
+    if flip is not None:
+        idx = np.nonzero(flip)[0]
+        imgs[idx] = imgs[idx, :, ::-1, :]
+    return (imgs - mean) / std

@@ -1,0 +1,91 @@
+// Native host-side data plane: batch assembly + augmentation hot loop.
+//
+// The reference's input-pipeline native layer is CUDA streams + GPU-side
+// normalize inside apex's data_prefetcher (reference apex_distributed.py:
+// 115-169: side-stream H2D copy overlap, sub_/div_ on device).  On TPU the
+// copy overlap lives in the DeviceFeeder's async transfers; the *byte-level*
+// per-sample work (uint8 -> float normalize, horizontal flip, NHWC batch
+// assembly) is the host hot loop, and doing it per-sample in Python/numpy
+// costs more CPU than JPEG decode itself at v5e feed rates (SURVEY.md §7.4
+// item 4).  This library does that work in C++ with the GIL released,
+// multithreaded, writing straight into the caller-provided batch buffer.
+//
+// Exposed via ctypes (no pybind11 in the image); see native.py.
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libptd_data.so ptd_data.cpp -lpthread
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Normalize + optional horizontal flip for one contiguous uint8 NHWC batch.
+//   in:    [n, h, w, 3] uint8
+//   out:   [n, h, w, 3] float32, out = (in/255 - mean[c]) / std[c]
+//   flip:  [n] uint8, nonzero => mirror horizontally
+// n_threads <= 0 picks hardware_concurrency.
+void ptd_normalize_batch(const uint8_t* in, float* out, int64_t n, int64_t h,
+                         int64_t w, const float* mean, const float* stddev,
+                         const uint8_t* flip, int n_threads) {
+  // Precompute the 256-entry lookup table per channel: (v/255 - mean)/std.
+  float lut[3][256];
+  for (int c = 0; c < 3; ++c) {
+    const float inv = 1.0f / stddev[c];
+    for (int v = 0; v < 256; ++v) {
+      lut[c][v] = (static_cast<float>(v) * (1.0f / 255.0f) - mean[c]) * inv;
+    }
+  }
+  const int64_t row = w * 3;
+  const int64_t img = h * row;
+
+  auto work = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* src = in + i * img;
+      float* dst = out + i * img;
+      const bool f = flip != nullptr && flip[i] != 0;
+      for (int64_t y = 0; y < h; ++y) {
+        const uint8_t* srow = src + y * row;
+        float* drow = dst + y * row;
+        if (!f) {
+          for (int64_t x = 0; x < row; x += 3) {
+            drow[x] = lut[0][srow[x]];
+            drow[x + 1] = lut[1][srow[x + 1]];
+            drow[x + 2] = lut[2][srow[x + 2]];
+          }
+        } else {
+          for (int64_t x = 0; x < w; ++x) {
+            const uint8_t* sp = srow + (w - 1 - x) * 3;
+            float* dp = drow + x * 3;
+            dp[0] = lut[0][sp[0]];
+            dp[1] = lut[1][sp[1]];
+            dp[2] = lut[2][sp[2]];
+          }
+        }
+      }
+    }
+  };
+
+  int threads = n_threads > 0
+                    ? n_threads
+                    : static_cast<int>(std::thread::hardware_concurrency());
+  if (threads <= 1 || n <= 1) {
+    work(0, n);
+    return;
+  }
+  if (threads > n) threads = static_cast<int>(n);
+  std::vector<std::thread> pool;
+  const int64_t chunk = (n + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
+int ptd_data_abi_version() { return 1; }
+
+}  // extern "C"

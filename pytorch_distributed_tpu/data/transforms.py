@@ -141,6 +141,17 @@ class Normalize:
         return (np.asarray(img, dtype=np.float32) - self.mean) / self.std
 
 
+class ToU8:
+    """PIL → uint8 HWC array (the u8-pipeline terminal: normalization and
+    flip happen at batch level — C++ host path or on-device)."""
+
+    def __call__(self, rng: np.random.Generator, img):
+        arr = np.asarray(img, dtype=np.uint8)
+        if arr.ndim == 2:
+            arr = np.stack([arr] * 3, axis=-1)
+        return arr
+
+
 def train_transform(size: int = 224) -> Compose:
     """The reference's training stack (distributed.py:166-173)."""
     return Compose(
@@ -151,3 +162,13 @@ def train_transform(size: int = 224) -> Compose:
 def eval_transform(size: int = 224, resize: int = 256) -> Compose:
     """The reference's validation stack (distributed.py:182-189)."""
     return Compose([Resize(resize), CenterCrop(size), ToArray(), Normalize()])
+
+
+def train_transform_u8(size: int = 224) -> Compose:
+    """Training stack ending in uint8 (flip+normalize at batch level)."""
+    return Compose([RandomResizedCrop(size), ToU8()])
+
+
+def eval_transform_u8(size: int = 224, resize: int = 256) -> Compose:
+    """Validation stack ending in uint8 (normalize at batch level)."""
+    return Compose([Resize(resize), CenterCrop(size), ToU8()])

@@ -1,0 +1,218 @@
+"""Fused flash attention — Pallas TPU kernel for the framework's hot op.
+
+Replaces the reference's native-kernel layer for attention-bearing models:
+where the GPU stack reaches cuDNN/apex fused kernels through torch bindings
+(SURVEY.md §2.2), the TPU stack reaches the MXU through this Pallas kernel.
+Dense XLA attention materializes the [L, L] score matrix in HBM; this kernel
+keeps score blocks in VMEM with online softmax, so HBM traffic stays
+O(L·D) and memory O(L·BK) — the single-chip complement of the cross-chip
+ring attention in parallel/ring.py (which this kernel's math mirrors).
+
+Forward: Pallas kernel, grid (batch·heads, q-blocks, kv-blocks), f32
+accumulators in VMEM scratch, causal blocks skipped via predication.
+Backward: custom VJP that recomputes attention blockwise from the saved
+logsumexp (flash-attention-2 style) in plain XLA — O(L·BK) memory, no
+[L, L] materialization; a Pallas backward kernel is the planned upgrade.
+
+Layout: [B, L, H, D] like parallel/ring.py; block sizes default to the
+128-lane MXU tile.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float, causal: bool,
+                block_q: int, block_k: int):
+    """One (bh, qi, kj) grid step: accumulate q-block × kv-block online."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: whole block masked out when the kv block starts after the
+    # q block ends; cheap predication, no wasted MXU work.
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0].astype(jnp.float32)            # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)            # [BK, D]
+        v = v_ref[0].astype(jnp.float32)            # [BK, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                    # [BQ, BK]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_scr[:, :1]                        # [BQ, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                       # [BQ, BK]
+        corr = jnp.exp(m_prev - m_new)               # [BQ, 1]
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kj == nk - 1)
+    def _final():
+        l = l_scr[:, :1]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # lse is lane-broadcast to 128 (TPU block alignment; caller reads
+        # lane 0) — same layout as jax's reference TPU kernel.
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(safe_l), lse_ref.shape[1:]
+        )
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    B, L, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    bq = min(block_q, L)
+    bk = min(block_k, L)
+    assert L % bq == 0 and L % bk == 0, (
+        f"sequence length {L} must divide block sizes ({bq}, {bk})"
+    )
+    # [B, L, H, D] -> [B*H, L, D]
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+
+    grid = (B * H, L // bq, L // bk)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, L, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, L, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max
+            pltpu.VMEM((bq, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((bq, D), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3), lse[:, :, 0]
+
+
+def _bwd_blockwise(res, g, causal: bool, block_k: int):
+    """Memory-efficient backward: recompute P blockwise from saved lse."""
+    q, k, v, out, lse = res  # q,k,v,out: [B,L,H,D]; lse: [B*H, L]
+    B, L, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    f32 = jnp.float32
+    qf = q.astype(f32).transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    kf = k.astype(f32).transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    vf = v.astype(f32).transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    of = out.astype(f32).transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    gf = g.astype(f32).transpose(0, 2, 1, 3).reshape(B * H, L, D)
+
+    delta = jnp.sum(of * gf, axis=-1)  # [BH, L] = rowsum(dO ∘ O)
+    bk = min(block_k, L)
+    nk = L // bk
+    pos = jnp.arange(L)
+
+    def kv_block(carry, j):
+        dq = carry
+        ks = jax.lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)  # [BH,bk,D]
+        vs = jax.lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
+        s = jnp.einsum("zqd,zkd->zqk", qf, ks) * scale             # [BH,L,bk]
+        if causal:
+            kpos = j * bk + jnp.arange(bk)
+            s = jnp.where(kpos[None, None, :] <= pos[None, :, None], s, NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])                           # [BH,L,bk]
+        dv = jnp.einsum("zqk,zqd->zkd", p, gf)
+        dp = jnp.einsum("zqd,zkd->zqk", gf, vs)
+        ds = p * (dp - delta[:, :, None]) * scale
+        dq = dq + jnp.einsum("zqk,zkd->zqd", ds, ks)
+        dk = jnp.einsum("zqk,zqd->zkd", ds, qf)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dks, dvs) = jax.lax.scan(kv_block, dq0, jnp.arange(nk))
+    dk = jnp.moveaxis(dks, 0, 1).reshape(B * H, L, D)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(B * H, L, D)
+
+    def back(x):
+        return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+    return (back(dq).astype(q.dtype), back(dk).astype(k.dtype),
+            back(dv).astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    block_q: int = 256,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused attention over [B, L, H, D].  ``interpret=None`` auto-selects
+    the Pallas interpreter off-TPU (slow, exact) and compiled mode on TPU."""
+    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k,
+                        _resolve_interpret(interpret))
+    return out
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
+                          _resolve_interpret(interpret))
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    return _bwd_blockwise(res, g, causal, block_k)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -47,6 +47,7 @@ class Config:
     precision: Optional[str] = None
     synthetic: bool = False
     synthetic_length: int = 1280
+    wire: str = "f32"
     image_size: int = 224
     num_classes: int = 1000
     resume: Optional[str] = None
@@ -98,6 +99,11 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="train crop size (default 224)")
     p.add_argument("--num-classes", default=d.num_classes, type=int,
                    help="number of classes (synthetic mode; ImageFolder infers)")
+    p.add_argument("--wire", default=d.wire, choices=("f32", "u8host", "u8"),
+                   help="input pipeline format: f32 = per-sample normalize "
+                   "(reference-shaped); u8host = native C++ batch "
+                   "flip+normalize; u8 = uint8 over the wire, normalize on "
+                   "device (4x fewer host->device bytes)")
     p.add_argument("--resume", default=d.resume, type=str, metavar="PATH",
                    help="path to checkpoint to resume from")
     p.add_argument("--checkpoint-dir", default=d.checkpoint_dir, type=str,

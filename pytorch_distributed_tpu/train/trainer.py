@@ -147,12 +147,17 @@ class Trainer:
                 seed=seed + 1,
             )
         else:
-            self.train_set = ImageFolder(
-                f"{cfg.data}/train", transform=train_transform(cfg.image_size)
-            )
-            self.val_set = ImageFolder(
-                f"{cfg.data}/val", transform=eval_transform(cfg.image_size)
-            )
+            if cfg.wire == "f32":
+                ttf, vtf = train_transform(cfg.image_size), eval_transform(cfg.image_size)
+            else:
+                from pytorch_distributed_tpu.data.transforms import (
+                    eval_transform_u8,
+                    train_transform_u8,
+                )
+
+                ttf, vtf = train_transform_u8(cfg.image_size), eval_transform_u8(cfg.image_size)
+            self.train_set = ImageFolder(f"{cfg.data}/train", transform=ttf)
+            self.val_set = ImageFolder(f"{cfg.data}/val", transform=vtf)
             cfg.num_classes = len(self.train_set.classes)
         self.train_sampler = DistributedShardSampler(
             len(self.train_set), world, rank, shuffle=True, seed=seed
@@ -165,6 +170,11 @@ class Trainer:
         # torch reference trains on a smaller final batch instead (dynamic
         # shapes); with ImageNet-scale epochs the dropped tail is <1 batch.
         # Eval keeps padding + masks so metrics stay exact (SURVEY §7.4 it.3).
+        # Synthetic datasets emit f32 directly; wire modes apply to the
+        # ImageFolder (u8-transform) path.
+        batch_mode = {"f32": "f32", "u8host": "u8_host", "u8": "u8_wire"}[cfg.wire]
+        if cfg.synthetic:
+            batch_mode = "f32"
         self.train_loader = DataLoader(
             self.train_set,
             self.local_batch,
@@ -172,6 +182,8 @@ class Trainer:
             num_workers=cfg.workers,
             drop_last=True,
             seed=seed,
+            batch_mode=batch_mode,
+            random_flip=batch_mode != "f32",
         )
         self.val_loader = DataLoader(
             self.val_set,
@@ -179,6 +191,7 @@ class Trainer:
             sampler=self.val_sampler,
             num_workers=cfg.workers,
             seed=seed,
+            batch_mode=batch_mode,
         )
 
     # ----------------------------------------------------------------- train

@@ -1,0 +1,56 @@
+"""C++ data-plane library vs numpy semantics."""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.data.native import native_available, normalize_batch
+from pytorch_distributed_tpu.data.native import binding
+
+MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _numpy_oracle(u8, flip=None):
+    x = u8.astype(np.float32) / 255.0
+    if flip is not None:
+        idx = np.nonzero(flip)[0]
+        x[idx] = x[idx, :, ::-1, :]
+    return (x - MEAN) / STD
+
+
+def test_native_builds_and_loads():
+    assert native_available(), "g++ is baked into the image; build must succeed"
+
+
+def test_normalize_matches_numpy():
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 256, size=(4, 16, 24, 3)).astype(np.uint8)
+    got = normalize_batch(u8, MEAN, STD)
+    np.testing.assert_allclose(got, _numpy_oracle(u8), rtol=1e-6, atol=1e-6)
+
+
+def test_normalize_with_flip():
+    rng = np.random.default_rng(1)
+    u8 = rng.integers(0, 256, size=(5, 8, 10, 3)).astype(np.uint8)
+    flip = np.array([1, 0, 1, 0, 1], np.uint8)
+    got = normalize_batch(u8, MEAN, STD, flip=flip)
+    np.testing.assert_allclose(got, _numpy_oracle(u8, flip), rtol=1e-6, atol=1e-6)
+
+
+def test_multithreaded_matches_single():
+    rng = np.random.default_rng(2)
+    u8 = rng.integers(0, 256, size=(16, 32, 32, 3)).astype(np.uint8)
+    flip = (rng.random(16) < 0.5).astype(np.uint8)
+    a = normalize_batch(u8, MEAN, STD, flip=flip, n_threads=1)
+    b = normalize_batch(u8, MEAN, STD, flip=flip, n_threads=4)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_fallback_path_same_semantics(monkeypatch):
+    rng = np.random.default_rng(3)
+    u8 = rng.integers(0, 256, size=(3, 8, 8, 3)).astype(np.uint8)
+    flip = np.array([0, 1, 0], np.uint8)
+    fast = normalize_batch(u8, MEAN, STD, flip=flip)
+    monkeypatch.setattr(binding, "_load", lambda: None)
+    slow = normalize_batch(u8, MEAN, STD, flip=flip)
+    np.testing.assert_allclose(fast, slow, rtol=1e-6, atol=1e-6)
