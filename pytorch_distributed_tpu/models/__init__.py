@@ -36,7 +36,23 @@ from pytorch_distributed_tpu.models.transformer import (  # noqa: F401
 # (reference distributed.py:21-23 surface).  Language models live in a
 # separate registry — they take token inputs and train through the LM path,
 # so exposing them as image-recipe archs would only offer a guaranteed crash.
+from pytorch_distributed_tpu.models.simple import (  # noqa: F401
+    alexnet, vgg11, vgg13, vgg16, vgg19,
+    vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn,
+)
+from pytorch_distributed_tpu.models.densenet import (  # noqa: F401
+    densenet121, densenet161, densenet169, densenet201,
+)
+from pytorch_distributed_tpu.models.mobilenet import mobilenet_v2  # noqa: F401
+
 _REGISTRY: Dict[str, Callable] = {
+    "alexnet": alexnet,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn,
+    "vgg16_bn": vgg16_bn, "vgg19_bn": vgg19_bn,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "mobilenet_v2": mobilenet_v2,
     "resnet18": resnet18,
     "resnet34": resnet34,
     "resnet50": resnet50,

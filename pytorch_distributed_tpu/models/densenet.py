@@ -1,0 +1,68 @@
+"""DenseNet family (flax.linen, NHWC) — torchvision-config parity
+(densenet121/161/169/201; reference zoo surface, distributed.py:21-23)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _DenseLayer(nn.Module):
+    growth: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
+        h = nn.relu(norm()(x))
+        h = conv(4 * self.growth, (1, 1))(h)
+        h = nn.relu(norm()(h))
+        h = conv(self.growth, (3, 3), padding=[(1, 1), (1, 1)])(h)
+        return jnp.concatenate([x, h], axis=-1)
+
+
+class DenseNet(nn.Module):
+    block_config: Sequence[int]
+    growth: int = 32
+    init_features: int = 64
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
+        x = x.astype(self.dtype)
+        x = conv(self.init_features, (7, 7), (2, 2), padding=[(3, 3), (3, 3)])(x)
+        x = nn.relu(norm()(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
+        for bi, layers in enumerate(self.block_config):
+            for li in range(layers):
+                x = _DenseLayer(self.growth, self.dtype,
+                                name=f"block{bi}_layer{li}")(x, train)
+            if bi != len(self.block_config) - 1:
+                # Transition: 1x1 conv halving channels + 2x2 avg pool.
+                x = nn.relu(norm()(x))
+                x = conv(x.shape[-1] // 2, (1, 1))(x)
+                x = nn.avg_pool(x, (2, 2), (2, 2))
+        x = nn.relu(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+
+
+densenet121 = functools.partial(DenseNet, block_config=(6, 12, 24, 16))
+densenet161 = functools.partial(
+    DenseNet, block_config=(6, 12, 36, 24), growth=48, init_features=96
+)
+densenet169 = functools.partial(DenseNet, block_config=(6, 12, 32, 32))
+densenet201 = functools.partial(DenseNet, block_config=(6, 12, 48, 32))

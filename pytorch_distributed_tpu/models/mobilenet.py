@@ -1,0 +1,87 @@
+"""MobileNetV2 (flax.linen, NHWC) — torchvision-config parity
+(inverted residuals, width-multiplier support; reference zoo surface)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _InvertedResidual(nn.Module):
+    out_ch: int
+    stride: int
+    expand: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
+        in_ch = x.shape[-1]
+        hidden = in_ch * self.expand
+        h = x
+        if self.expand != 1:
+            h = nn.relu6(norm()(conv(hidden, (1, 1))(h)))
+        h = conv(hidden, (3, 3), (self.stride, self.stride),
+                 padding=[(1, 1), (1, 1)], feature_group_count=hidden)(h)
+        h = nn.relu6(norm()(h))
+        h = norm()(conv(self.out_ch, (1, 1))(h))
+        if self.stride == 1 and in_ch == self.out_ch:
+            return x + h
+        return h
+
+
+# (expand, channels, repeats, stride) — torchvision mobilenet_v2 table.
+_SETTINGS = [
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+]
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
+        x = x.astype(self.dtype)
+        ch = _make_divisible(32 * self.width_mult)
+        x = nn.relu6(norm()(conv(ch, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])(x)))
+        for expand, c, reps, s in _SETTINGS:
+            out_ch = _make_divisible(c * self.width_mult)
+            for i in range(reps):
+                x = _InvertedResidual(
+                    out_ch, s if i == 0 else 1, expand, self.dtype
+                )(x, train)
+        last = _make_divisible(1280 * max(1.0, self.width_mult))
+        x = nn.relu6(norm()(conv(last, (1, 1))(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+
+
+mobilenet_v2 = functools.partial(MobileNetV2)

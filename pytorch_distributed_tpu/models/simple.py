@@ -1,0 +1,112 @@
+"""AlexNet and VGG families (flax.linen, NHWC, dtype-policy aware).
+
+Zoo-surface parity with the torchvision architectures the reference
+instantiates by name (reference distributed.py:21-23): same stage/channel
+configurations as torchvision's alexnet and vgg11/13/16/19 (+bn variants),
+so ``-a vgg16`` etc. work across recipes.  Classifier heads run in f32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.relu(conv(64, (11, 11), (4, 4), padding=[(2, 2), (2, 2)])(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(conv(192, (5, 5), padding=[(2, 2), (2, 2)])(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(conv(384, (3, 3), padding=[(1, 1), (1, 1)])(x))
+        x = nn.relu(conv(256, (3, 3), padding=[(1, 1), (1, 1)])(x))
+        x = nn.relu(conv(256, (3, 3), padding=[(1, 1), (1, 1)])(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        # torchvision adaptive-avg-pools to 6x6 before the classifier.
+        x = _adaptive_avg_pool(x, 6)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+
+
+def _adaptive_avg_pool(x, out: int):
+    """torch AdaptiveAvgPool2d semantics: output bin (i, j) averages input
+    rows [⌊iH/out⌋, ⌈(i+1)H/out⌉) × cols [⌊jW/out⌋, ⌈(j+1)W/out⌉).  The
+    bin loop is static (out² iterations), so XLA sees plain slices."""
+    B, H, W, C = x.shape
+    if H == out and W == out:
+        return x
+    if H % out == 0 and W % out == 0:
+        return nn.avg_pool(x, (H // out, W // out), (H // out, W // out))
+    rows = []
+    for i in range(out):
+        h0, h1 = (i * H) // out, -(-((i + 1) * H) // out)
+        cols = []
+        for j in range(out):
+            w0, w1 = (j * W) // out, -(-((j + 1) * W) // out)
+            cols.append(jnp.mean(x[:, h0:h1, w0:w1, :], axis=(1, 2)))
+        rows.append(jnp.stack(cols, axis=1))
+    return jnp.stack(rows, axis=1)
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    batch_norm: bool = False
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), (2, 2))
+            else:
+                x = conv(int(v), (3, 3), padding=[(1, 1), (1, 1)])(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(
+                        use_running_average=not train, momentum=0.9,
+                        epsilon=1e-5, dtype=self.dtype,
+                    )(x)
+                x = nn.relu(x)
+        x = _adaptive_avg_pool(x, 7)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)
+
+
+alexnet = functools.partial(AlexNet)
+vgg11 = functools.partial(VGG, cfg=_VGG_CFGS["A"])
+vgg13 = functools.partial(VGG, cfg=_VGG_CFGS["B"])
+vgg16 = functools.partial(VGG, cfg=_VGG_CFGS["D"])
+vgg19 = functools.partial(VGG, cfg=_VGG_CFGS["E"])
+vgg11_bn = functools.partial(VGG, cfg=_VGG_CFGS["A"], batch_norm=True)
+vgg13_bn = functools.partial(VGG, cfg=_VGG_CFGS["B"], batch_norm=True)
+vgg16_bn = functools.partial(VGG, cfg=_VGG_CFGS["D"], batch_norm=True)
+vgg19_bn = functools.partial(VGG, cfg=_VGG_CFGS["E"], batch_norm=True)

@@ -53,6 +53,8 @@ class Config:
     resume: Optional[str] = None
     checkpoint_dir: str = "."
     epoch_csv: Optional[str] = None
+    profile_dir: Optional[str] = None
+    telemetry_csv: Optional[str] = None
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -110,6 +112,12 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="directory for checkpoint files")
     p.add_argument("--epoch-csv", default=d.epoch_csv, type=str,
                    help="append [timestamp, epoch_seconds] rows to this CSV")
+    p.add_argument("--profile-dir", default=d.profile_dir, type=str,
+                   help="write an XPlane/TensorBoard profiler trace of the "
+                   "first trained epoch of this run to this directory")
+    p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
+                   help="sample device memory stats to this CSV every 500ms "
+                   "during training (statistics.sh-in-process)")
     return p
 
 

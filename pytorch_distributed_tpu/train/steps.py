@@ -37,14 +37,17 @@ Batch = Dict[str, jnp.ndarray]
 Metrics = Dict[str, jnp.ndarray]
 
 
-def _forward_and_sums(model, params, batch_stats, batch: Batch, train: bool):
+def _forward_and_sums(model, params, batch_stats, batch: Batch, train: bool,
+                      dropout_rng=None):
     """Weighted-sum loss/metric numerators + weight count (exact over padding)."""
     variables = {"params": params, "batch_stats": batch_stats}
     if train:
+        rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
         logits, mutated = model.apply(
-            variables, batch["images"], train=True, mutable=["batch_stats"]
+            variables, batch["images"], train=True, mutable=["batch_stats"],
+            rngs=rngs,
         )
-        new_stats = mutated["batch_stats"]
+        new_stats = mutated.get("batch_stats", batch_stats)
     else:
         logits = model.apply(variables, batch["images"], train=False)
         new_stats = batch_stats
@@ -64,6 +67,7 @@ def make_train_step(
     data_axis: str = "data",
     wire_dtype: Optional[jnp.dtype] = None,
     explicit_collectives: bool = False,
+    seed: int = 0,
 ) -> Callable[[TrainState, Batch, jnp.ndarray], Tuple[TrainState, Metrics]]:
     """Build the jitted train step for ``mesh``.
 
@@ -94,12 +98,20 @@ def make_train_step(
             lambda g: g.astype(jnp.float32) / gcount, grads
         ), gcount
 
+    base_key = jax.random.PRNGKey(seed)
+
     def local_step(state: TrainState, batch: Batch, lr: jnp.ndarray):
         """Runs per-shard under shard_map; all reductions explicit."""
+        # Per-step, per-shard dropout stream (shards see different data).
+        rng = jax.random.fold_in(
+            jax.random.fold_in(base_key, state.step),
+            jax.lax.axis_index(data_axis),
+        )
 
         def loss_fn(params):
             loss_sum, aux = _forward_and_sums(
-                model, params, state.batch_stats, batch, train=True
+                model, params, state.batch_stats, batch, train=True,
+                dropout_rng=rng,
             )
             return loss_sum, aux  # local *sum*; normalized after psum
 
@@ -125,10 +137,12 @@ def make_train_step(
 
     def global_step(state: TrainState, batch: Batch, lr: jnp.ndarray):
         """GSPMD formulation: global-semantics math, XLA infers collectives."""
+        rng = jax.random.fold_in(base_key, state.step)
 
         def loss_fn(params):
             loss_sum, aux = _forward_and_sums(
-                model, params, state.batch_stats, batch, train=True
+                model, params, state.batch_stats, batch, train=True,
+                dropout_rng=rng,
             )
             count = aux[4]
             return loss_sum / jnp.maximum(count, 1.0), aux

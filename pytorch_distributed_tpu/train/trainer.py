@@ -102,6 +102,7 @@ class Trainer:
             data_axis=data_axis,
             wire_dtype=wire_dtype,
             explicit_collectives=explicit_collectives,
+            seed=seed,
         )
         self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
@@ -258,13 +259,37 @@ class Trainer:
 
     # ------------------------------------------------------------------- fit
     def fit(self) -> float:
+        """Train/eval driver with the reference's observability surface
+        (SURVEY.md §5.1): per-step meters, per-epoch CSV, optional in-process
+        device telemetry, and an optional XPlane profiler trace of epoch 0
+        (the TPU-native upgrade of nvidia-smi sampling — open in
+        TensorBoard's profile plugin)."""
         cfg = self.cfg
         if cfg.evaluate:
             return self.validate()
+        telemetry = None
+        if cfg.telemetry_csv:
+            from pytorch_distributed_tpu.utils.telemetry import TelemetrySampler
+
+            telemetry = TelemetrySampler(cfg.telemetry_csv).start()
+        try:
+            return self._fit_epochs()
+        finally:
+            if telemetry is not None:
+                telemetry.stop()
+
+    def _fit_epochs(self) -> float:
+        cfg = self.cfg
         for epoch in range(cfg.start_epoch, cfg.epochs):
             self.csv.epoch_start()
+            profiling = cfg.profile_dir and epoch == cfg.start_epoch
+            if profiling:
+                jax.profiler.start_trace(cfg.profile_dir)
             self.train_epoch(epoch)
             jax.block_until_ready(self.state.params)
+            if profiling:
+                jax.profiler.stop_trace()
+                print(f"=> wrote profiler trace to '{cfg.profile_dir}'")
             acc1 = self.validate()
             elapsed = self.csv.epoch_end()
             print(f"Epoch {epoch} took {elapsed:.1f}s", flush=True)

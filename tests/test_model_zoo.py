@@ -1,0 +1,97 @@
+"""Every registered image arch initializes, runs forward, and (for a sample
+incl. a dropout model) takes a train step on the simulated mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu import models
+from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+from pytorch_distributed_tpu.train.optim import sgd_init
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.steps import make_train_step
+
+EXPECTED = {
+    "alexnet", "vgg11", "vgg13", "vgg16", "vgg19",
+    "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+    "densenet121", "densenet161", "densenet169", "densenet201",
+    "mobilenet_v2",
+    "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "wide_resnet50_2", "wide_resnet101_2",
+    "resnext50_32x4d", "resnext101_32x8d",
+}
+
+
+def test_registry_contains_expected_families():
+    assert EXPECTED <= set(models.model_names())
+
+
+# Keep per-arch cost low: one light representative per family at tiny size.
+FWD_ARCHS = ["alexnet", "vgg11_bn", "densenet121", "mobilenet_v2", "resnet34"]
+
+
+@pytest.mark.parametrize("arch", FWD_ARCHS)
+def test_forward_shapes(arch):
+    model = models.create_model(arch, num_classes=7)
+    size = 64 if arch == "alexnet" else 32  # alexnet's 11x11/s4 stem needs room
+    x = jnp.zeros((2, size, size, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 7)
+    assert out.dtype == jnp.float32
+
+
+def test_dropout_arch_trains():
+    """AlexNet has dropout: the train step must thread a dropout rng."""
+    mesh = build_mesh(MeshSpec(("data",), (8,)), jax.devices()[:8])
+    model = models.create_model("alexnet", num_classes=4)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)),
+                           train=False)
+    state = TrainState.create(variables, sgd_init(variables["params"]))
+    step = make_train_step(model, mesh, seed=3)
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": rng.normal(size=(16, 64, 64, 3)).astype(np.float32),
+        "labels": rng.integers(0, 4, size=16).astype(np.int32),
+        "weights": np.ones(16, np.float32),
+    }
+    s1, m1 = step(state, batch, jnp.float32(0.01))
+    assert np.isfinite(float(m1["loss"]))
+    s2, m2 = step(s1, batch, jnp.float32(0.01))
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_vgg_trains_through_explicit_collectives():
+    mesh = build_mesh(MeshSpec(("data",), (8,)), jax.devices()[:8])
+    model = models.create_model("vgg11", num_classes=4)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    state = TrainState.create(variables, sgd_init(variables["params"]))
+    step = make_train_step(model, mesh, explicit_collectives=True, seed=1)
+    rng = np.random.default_rng(1)
+    batch = {
+        "images": rng.normal(size=(16, 32, 32, 3)).astype(np.float32),
+        "labels": rng.integers(0, 4, size=16).astype(np.int32),
+        "weights": np.ones(16, np.float32),
+    }
+    _, m = step(state, batch, jnp.float32(0.01))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_adaptive_avg_pool_matches_torch():
+    """Non-divisible sizes must follow torch AdaptiveAvgPool2d bin edges
+    (regression: earlier fallback collapsed to a global mean)."""
+    torch = pytest.importorskip("torch")
+    from pytorch_distributed_tpu.models.simple import _adaptive_avg_pool
+
+    rng = np.random.default_rng(0)
+    for H, out in ((8, 7), (5, 7), (13, 6), (1, 7), (14, 7)):
+        x = rng.normal(size=(2, H, H, 3)).astype(np.float32)
+        want = (
+            torch.nn.AdaptiveAvgPool2d(out)(
+                torch.from_numpy(x.transpose(0, 3, 1, 2))
+            ).numpy().transpose(0, 2, 3, 1)
+        )
+        got = np.asarray(_adaptive_avg_pool(jnp.asarray(x), out))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
