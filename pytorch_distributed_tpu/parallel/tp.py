@@ -1,0 +1,82 @@
+"""Tensor parallelism: Megatron-style parameter sharding over a ``model``
+mesh axis, expressed as GSPMD sharding specs (XLA inserts the all-reduces).
+
+Beyond-reference capability (the reference is DP-only, SURVEY.md §2.3
+"Explicitly absent"), first-class per the framework brief.  The design
+follows the scaling-book recipe: pick a mesh, annotate parameter shardings,
+let XLA place collectives — no hand-written all-reduce in the model code.
+
+For the TransformerLM the classic layout is:
+
+- attention ``qkv`` kernel: column-parallel  → ``P(None, 'model')``
+- attention ``proj`` kernel: row-parallel    → ``P('model', None)``
+- MLP ``fc1``: column-parallel               → ``P(None, 'model')``
+- MLP ``fc2``: row-parallel                  → ``P('model', None)``
+- embedding: vocab-sharded                   → ``P('model', None)``
+- everything else (norms, biases): replicated
+
+With these specs, XLA emits exactly Megatron's two all-reduces per block
+(after ``proj`` and after ``fc2``) on the ``model`` axis — which should be
+the innermost/fastest mesh axis so they ride ICI (parallel/mesh.py note).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+_COLUMN_PARALLEL = ("qkv", "fc1")
+_ROW_PARALLEL = ("proj", "fc2")
+
+
+def transformer_tp_spec(path: tuple, leaf, model_axis: str = "model") -> P:
+    """PartitionSpec for one TransformerLM parameter, by its tree path."""
+    names = [getattr(k, "key", str(k)) for k in path]
+    is_kernel = names[-1] == "kernel"
+    module = names[-2] if len(names) >= 2 else ""
+    if names[-1] == "embedding":
+        return P(model_axis, None)  # vocab-sharded (tied head stays sharded)
+    if is_kernel and module in _COLUMN_PARALLEL:
+        return P(None, model_axis)
+    if is_kernel and module in _ROW_PARALLEL:
+        return P(model_axis, None)
+    return P()  # norms, biases: replicated
+
+
+def tp_specs(params: Pytree, model_axis: str = "model") -> Pytree:
+    """Pytree of PartitionSpecs shaped like ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: transformer_tp_spec(path, leaf, model_axis), params
+    )
+
+
+def shard_pytree(tree: Pytree, specs: Pytree, mesh: Mesh) -> Pytree:
+    """Place a (host or replicated) pytree onto the mesh per ``specs``."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def replicated_like(tree: Pytree) -> Pytree:
+    """All-replicated specs shaped like ``tree`` (DP-only layout)."""
+    return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+def state_specs(param_specs: Pytree):
+    """TrainState-shaped PartitionSpec tree: params and momentum share
+    ``param_specs``; step and (empty) batch_stats are replicated.  The single
+    source for jit in_shardings and device placement — keep them identical
+    or XLA silently reshards every step."""
+    from pytorch_distributed_tpu.train.state import TrainState
+
+    return TrainState(step=P(), params=param_specs, batch_stats={},
+                      momentum=param_specs)
+
+
+def shard_state(state, param_specs: Pytree, mesh: Mesh):
+    """Place a TrainState on ``mesh`` per ``state_specs(param_specs)``."""
+    return shard_pytree(state, state_specs(param_specs), mesh)

@@ -32,7 +32,21 @@ BEST_NAME = "model_best.msgpack"
 
 
 def _to_host(tree: Any) -> Any:
-    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+    """Fetch to host numpy, gathering sharded leaves first.
+
+    DP state is replicated (plain fetch); TP/SP-sharded state on multi-host
+    meshes spans non-addressable devices, where ``np.asarray`` would raise —
+    those leaves are all-gathered across processes so the written checkpoint
+    is always the full, replicated tree (the recipe-interchange invariant)."""
+
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(fetch, tree)
 
 
 def save_checkpoint(

@@ -26,3 +26,7 @@ python -m pytorch_distributed_tpu.recipes.dataparallel --data "$DATA"
 
 # 7. canonical TPU-native recipe (BASELINE.json north star)
 python -m pytorch_distributed_tpu.recipes.tpu_native --data "$DATA" -a resnet50
+
+# 8. long-context LM pretraining (beyond reference): tensor- or sequence-parallel
+python -m pytorch_distributed_tpu.recipes.lm_pretrain --tp 4 --seq-len 2048 -b 32 --steps 1000
+# python -m pytorch_distributed_tpu.recipes.lm_pretrain --sp 4 --seq-len 16384 -b 8 --steps 1000
