@@ -1,0 +1,107 @@
+"""Mixture-of-Experts MLP with expert parallelism over an ``expert`` mesh axis.
+
+Beyond-reference capability completing the framework's parallelism menu
+(dp / tp / sp / **ep**).  Switch-Transformer-style top-1 routing with a
+capacity limit, expressed as dense dispatch/combine einsums — the
+GSPMD-idiomatic formulation: expert parameters are stacked on a leading
+``E`` axis and sharded ``P('expert', …)``; XLA lowers the dispatch einsum to
+the all-to-all token exchange across the expert axis.  No hand-written
+routing collectives.
+
+The router's auxiliary load-balancing loss (Switch eq. 4: ``E · Σ_e f_e·p_e``)
+is recorded via ``self.sow("losses", …)``; the LM step collects it with
+``mutable=["losses"]`` and adds it to the objective.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class _FFN(nn.Module):
+    d_model: int
+    d_hidden: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.d_hidden, dtype=self.dtype, name="fc1")(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.d_model, dtype=self.dtype, name="fc2")(h)
+
+
+class MoEMLP(nn.Module):
+    n_experts: int
+    capacity_factor: float = 1.25
+    aux_coef: float = 0.01
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, C = x.shape
+        E = self.n_experts
+        S = B * L
+        cap = max(1, int(self.capacity_factor * S / E))
+        tokens = x.reshape(S, C)
+
+        # Router runs in f32 (standard for stability).
+        logits = nn.Dense(E, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )
+        probs = jax.nn.softmax(logits, axis=-1)                  # [S, E]
+        expert_idx = jnp.argmax(probs, axis=-1)                  # [S]
+        gate = jnp.max(probs, axis=-1)                           # [S]
+
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [S, E]
+        # Position of each token within its expert's queue.
+        pos_in_expert = jnp.sum(
+            (jnp.cumsum(onehot, axis=0) - 1.0) * onehot, axis=-1
+        ).astype(jnp.int32)
+        keep = (pos_in_expert < cap).astype(jnp.float32)
+
+        # Switch aux loss: fraction-routed × mean-probability per expert.
+        frac = jnp.mean(onehot, axis=0)
+        imp = jnp.mean(probs, axis=0)
+        self.sow("losses", "moe_aux", self.aux_coef * E * jnp.sum(frac * imp))
+
+        dispatch = (
+            onehot[:, :, None]
+            * jax.nn.one_hot(pos_in_expert, cap, dtype=jnp.float32)[:, None, :]
+            * keep[:, None, None]
+        )                                                         # [S, E, cap]
+        expert_in = jnp.einsum(
+            "sec,sd->ecd", dispatch, tokens.astype(jnp.float32)
+        ).astype(self.dtype)                                      # [E, cap, C]
+
+        experts = nn.vmap(
+            _FFN,
+            in_axes=0, out_axes=0,
+            variable_axes={"params": 0},   # stacked params, leading E axis
+            split_rngs={"params": True},
+            metadata_params={nn.PARTITION_NAME: "expert"},
+        )(d_model=C, d_hidden=4 * C, dtype=self.dtype, name="experts")
+        expert_out = experts(expert_in)                           # [E, cap, C]
+
+        combine = dispatch * gate[:, None, None]                  # [S, E, cap]
+        out = jnp.einsum(
+            "sec,ecd->sd", combine, expert_out.astype(jnp.float32)
+        )
+        return out.reshape(B, L, C).astype(x.dtype)
+
+
+def moe_specs(params, expert_axis: str = "expert"):
+    """PartitionSpec tree: expert-stacked params sharded on their leading
+    axis; everything else replicated.  Compose with tp.py's ``state_specs``."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(k, "key", str(k)) for k in path]
+        if "experts" in names:
+            return P(expert_axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -91,6 +91,7 @@ class Block(nn.Module):
     mesh: Optional[Mesh] = None
     ring: bool = False
     attn_impl: str = "auto"
+    moe_experts: int = 0  # >0 replaces the dense MLP with an MoE layer
 
     @nn.compact
     def __call__(self, x):
@@ -99,9 +100,14 @@ class Block(nn.Module):
         x = x + SelfAttention(self.n_heads, self.dtype, self.mesh, self.ring,
                               self.attn_impl, name="attn")(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        h = nn.Dense(4 * C, dtype=self.dtype, name="fc1")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(C, dtype=self.dtype, name="fc2")(h)
+        if self.moe_experts > 0:
+            from pytorch_distributed_tpu.models.moe import MoEMLP
+
+            h = MoEMLP(self.moe_experts, dtype=self.dtype, name="moe")(h)
+        else:
+            h = nn.Dense(4 * C, dtype=self.dtype, name="fc1")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(C, dtype=self.dtype, name="fc2")(h)
         return x + h
 
 
@@ -116,15 +122,21 @@ class TransformerLM(nn.Module):
     mesh: Optional[Mesh] = None
     ring: bool = False
     attn_impl: str = "auto"
+    remat: bool = False  # rematerialize blocks: activations recomputed in
+    #                      backward — O(sqrt) memory for long context
+    #                      (the jax.checkpoint HBM/FLOPs trade, brief §HBM)
+    moe_experts: int = 0  # >0: MoE MLP in every block (expert parallelism)
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
         embed = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                          name="embed")
         x = embed(tokens)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.n_layers):
-            x = Block(self.n_heads, self.dtype, self.mesh, self.ring,
-                      self.attn_impl, name=f"block_{i}")(x)
+            x = block_cls(self.n_heads, self.dtype, self.mesh, self.ring,
+                          self.attn_impl, self.moe_experts,
+                          name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied output head (embed.attend) keeps params lean at long context.
         return embed.attend(x.astype(jnp.float32)).astype(jnp.float32)

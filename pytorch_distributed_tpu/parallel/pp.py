@@ -1,0 +1,92 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``pipe``
+mesh axis, completing the framework's parallelism menu (dp/tp/sp/ep/**pp**).
+
+Beyond-reference capability.  Each device owns one pipeline *stage* (a stack
+of identical transformer blocks); microbatches stream through the ring:
+device ``p`` processes microbatch ``m`` at tick ``t = p + m``, activations
+hop to the next stage via ``ppermute`` (ICI neighbor exchange).  The whole
+schedule is a ``lax.scan`` over ``M + P - 1`` ticks inside ``shard_map`` —
+compiled once, bulk-synchronous, differentiable (the backward pipeline falls
+out of autodiff through scan+ppermute; synchronous GPipe semantics, no
+weight staleness).
+
+Stage parameters are created stacked on a leading ``P`` axis (``nn.vmap``
+over stages, like models/moe.py's experts) and sharded ``P('pipe', …)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Pytree = Any
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Pytree, jnp.ndarray], jnp.ndarray],
+    stage_params: Pytree,
+    x: jnp.ndarray,
+    n_microbatches: int,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+) -> jnp.ndarray:
+    """Run ``x`` through ``n_stages`` of ``stage_fn`` as a GPipe pipeline.
+
+    - ``stage_params``: pytree with a leading stage axis (size = pipe axis).
+    - ``x``: [B, ...] activations entering stage 0; ``n_microbatches`` must
+      divide ``B``.
+    Returns the activations after the final stage, same shape as ``x``.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(f"batch {B} not divisible by {n_microbatches} microbatches")
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading axis {leaf.shape[0]} != '{pipe_axis}' "
+                f"mesh size {n_stages} — stages would be silently dropped"
+            )
+    mb = B // n_microbatches
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    def per_stage(params_local, micro_local):
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        idx = jax.lax.axis_index(pipe_axis)
+        n_ticks = n_microbatches + n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+        def tick(carry, t):
+            buf = carry  # activations arriving at this stage this tick
+            feed = micro_local[jnp.minimum(t, n_microbatches - 1)]
+            cur = jnp.where(idx == 0, feed, buf)
+            y = stage_fn(params_local, cur)
+            # Last stage's finished microbatch index at tick t is t-(P-1).
+            out_idx = t - (n_stages - 1)
+            is_out = jnp.logical_and(idx == n_stages - 1, out_idx >= 0)
+            out_contrib = jnp.where(is_out, y, jnp.zeros_like(y))
+            buf_next = jax.lax.ppermute(y, pipe_axis, perm)
+            return buf_next, (out_contrib, out_idx)
+
+        buf0 = jnp.zeros_like(micro_local[0])
+        _, (outs, out_idxs) = jax.lax.scan(
+            tick, buf0, jnp.arange(n_ticks)
+        )
+        # Scatter finished microbatches into order; rows with out_idx < 0 are
+        # already zeroed by the is_out gate, and only the last stage
+        # contributes nonzero rows — the psum broadcasts them to all stages.
+        result = jnp.zeros_like(micro_local)
+        result = result.at[jnp.clip(out_idxs, 0, n_microbatches - 1)].add(outs)
+        return jax.lax.psum(result, pipe_axis)
+
+    sharded = jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(P(pipe_axis), P()),   # params sharded by stage; micro replicated
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, micro)
+    return sharded.reshape(x.shape)

@@ -43,6 +43,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel (ring) size")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel size (MoE MLPs, one expert/device)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
@@ -55,13 +57,20 @@ def main(argv=None) -> float:
     args = build_parser().parse_args(argv)
     ctx = initialize()
     n = jax.device_count()
-    if args.tp > 1 and args.sp > 1:
-        raise SystemExit("--tp and --sp cannot be combined yet (use one)")
-    if n % (args.tp * args.sp):
-        raise SystemExit(f"{n} devices not divisible by tp*sp")
+    if sum(x > 1 for x in (args.tp, args.sp, args.ep)) > 1:
+        raise SystemExit("--tp/--sp/--ep cannot be combined yet (use one)")
+    if n % (args.tp * args.sp * args.ep):
+        raise SystemExit(f"{n} devices not divisible by tp*sp*ep")
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
 
-    if args.sp > 1:
+    if args.ep > 1:
+        mesh = build_mesh(MeshSpec(("data", "expert"), (n // args.ep, args.ep)))
+        model = TransformerLM(
+            vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+            n_layers=args.n_layers, dtype=dtype, moe_experts=args.ep,
+        )
+        specs = "ep"
+    elif args.sp > 1:
         mesh = build_mesh(MeshSpec(("data", "seq"), (n // args.sp, args.sp)))
         model = TransformerLM(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
@@ -83,11 +92,16 @@ def main(argv=None) -> float:
     )
     with mesh:
         tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
-        if specs == "tp":
+        if specs in ("tp", "ep"):
             params_shape = jax.eval_shape(
                 lambda: model.init(jax.random.PRNGKey(args.seed), tokens0)
             )["params"]
-            specs = tp_specs(params_shape)
+            if specs == "tp":
+                specs = tp_specs(params_shape)
+            else:
+                from pytorch_distributed_tpu.models.moe import moe_specs
+
+                specs = moe_specs(params_shape)
         trainer = LMTrainer(
             model, mesh, dataset, args.batch_size, lr=args.lr,
             param_specs=specs, seed=args.seed, is_primary=ctx.is_primary,

@@ -82,12 +82,18 @@ def make_lm_train_step(
 
     def step(state: TrainState, tokens: jnp.ndarray, lr: jnp.ndarray):
         def loss_fn(params):
-            logits = model.apply({"params": params}, tokens)
+            # mutable=["losses"] collects sown auxiliary objectives (the MoE
+            # router's load-balancing loss); {} for dense models.
+            logits, sown = model.apply(
+                {"params": params}, tokens, mutable=["losses"]
+            )
             vocab = logits.shape[-1]
             loss = cross_entropy(
                 logits[:, :-1].reshape(-1, vocab),
                 tokens[:, 1:].reshape(-1),
             )
+            for leaf in jax.tree_util.tree_leaves(sown.get("losses", {})):
+                loss = loss + leaf
             acc = jnp.mean(
                 (jnp.argmax(logits[:, :-1], axis=-1) == tokens[:, 1:]).astype(
                     jnp.float32

@@ -68,6 +68,7 @@ def make_train_step(
     wire_dtype: Optional[jnp.dtype] = None,
     explicit_collectives: bool = False,
     seed: int = 0,
+    tx=None,
 ) -> Callable[[TrainState, Batch, jnp.ndarray], Tuple[TrainState, Metrics]]:
     """Build the jitted train step for ``mesh``.
 
@@ -80,6 +81,11 @@ def make_train_step(
       hand-written ``psum`` — the Horovod-analogue; ``wire_dtype=bf16``
       reproduces fp16 gradient wire compression
       (horovod_distributed.py:159-164) as bf16-compressed collectives.
+
+    ``tx``: an optional optax ``GradientTransformation``.  Default (None) is
+    the torch-parity SGD (train/optim.py), with ``lr`` as a live scalar
+    operand; with optax the schedule lives inside ``tx`` and the ``lr``
+    argument is ignored (state.momentum carries the optax opt_state).
 
     BatchNorm semantics differ deliberately, matching each formulation's GPU
     ancestor: GSPMD BN normalizes over the *global* batch (SyncBN — XLA
@@ -99,6 +105,27 @@ def make_train_step(
         ), gcount
 
     base_key = jax.random.PRNGKey(seed)
+    if tx is not None:
+        import warnings
+
+        warnings.warn(
+            "make_train_step: tx provided — the lr argument (and the "
+            "harness's step-decay schedule) plus the momentum/weight_decay "
+            "settings are INACTIVE; configure schedule and regularization "
+            "inside the optax transformation.",
+            stacklevel=2,
+        )
+
+    def apply_updates(state: TrainState, grads, lr):
+        if tx is None:
+            return sgd_update(
+                grads, state.momentum, state.params, lr,
+                momentum=momentum, weight_decay=weight_decay,
+            )
+        import optax
+
+        updates, new_opt = tx.update(grads, state.momentum, state.params)
+        return optax.apply_updates(state.params, updates), new_opt
 
     def local_step(state: TrainState, batch: Batch, lr: jnp.ndarray):
         """Runs per-shard under shard_map; all reductions explicit."""
@@ -119,10 +146,7 @@ def make_train_step(
             loss_fn, has_aux=True
         )(state.params)
         grads, gcount = sync_grads(grads, count)
-        new_params, new_momentum = sgd_update(
-            grads, state.momentum, state.params, lr,
-            momentum=momentum, weight_decay=weight_decay,
-        )
+        new_params, new_momentum = apply_updates(state, grads, lr)
         # BN running stats: average local EMAs across shards so replicas agree.
         new_stats = jax.lax.pmean(new_stats, data_axis)
         metrics = {
@@ -154,10 +178,7 @@ def make_train_step(
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(wire_dtype).astype(jnp.float32), grads
             )
-        new_params, new_momentum = sgd_update(
-            grads, state.momentum, state.params, lr,
-            momentum=momentum, weight_decay=weight_decay,
-        )
+        new_params, new_momentum = apply_updates(state, grads, lr)
         metrics = {
             "loss": loss,
             "acc1": c1 * 100.0 / count,

@@ -46,7 +46,10 @@ class Trainer:
         explicit_collectives: bool = False,
         wire_dtype=None,
         data_axis: str = "data",
+        tx=None,
     ):
+        """``tx``: optional optax GradientTransformation replacing the
+        default torch-parity SGD (see train/steps.py docstring)."""
         self.cfg = cfg
         self.ctx = ctx or DistContext(
             jax.process_index(), jax.process_count(), None
@@ -77,7 +80,10 @@ class Trainer:
         rng = jax.random.PRNGKey(seed)
         sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
         variables = self.model.init(rng, sample, train=False)
-        self.state = TrainState.create(variables, sgd_init(variables["params"]))
+        opt0 = tx.init(variables["params"]) if tx is not None else sgd_init(
+            variables["params"]
+        )
+        self.state = TrainState.create(variables, opt0)
         del variables
 
         if cfg.pretrained:
@@ -103,6 +109,7 @@ class Trainer:
             wire_dtype=wire_dtype,
             explicit_collectives=explicit_collectives,
             seed=seed,
+            tx=tx,
         )
         self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
