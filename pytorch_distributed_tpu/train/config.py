@@ -48,6 +48,8 @@ class Config:
     synthetic: bool = False
     synthetic_length: int = 1280
     wire: str = "f32"
+    accum_steps: int = 1
+    local_rank: int = -1  # launch-line parity only; unused on TPU
     image_size: int = 224
     num_classes: int = 1000
     resume: Optional[str] = None
@@ -101,6 +103,14 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="train crop size (default 224)")
     p.add_argument("--num-classes", default=d.num_classes, type=int,
                    help="number of classes (synthetic mode; ImageFolder infers)")
+    p.add_argument("--accum-steps", default=d.accum_steps, type=int,
+                   help="split each batch into N microbatches, accumulate "
+                   "gradients in-graph, apply one update (fits the default "
+                   "global batch 3200 on small chip counts)")
+    p.add_argument("--local_rank", default=-1, type=int,
+                   help="accepted for reference launch-line parity "
+                   "(distributed.py:73-76); process identity on TPU comes "
+                   "from PTD_TPU_PROCESS_ID / pod metadata instead")
     p.add_argument("--wire", default=d.wire, choices=("f32", "u8host", "u8"),
                    help="input pipeline format: f32 = per-sample normalize "
                    "(reference-shaped); u8host = native C++ batch "

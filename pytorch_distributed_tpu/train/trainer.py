@@ -110,7 +110,15 @@ class Trainer:
             explicit_collectives=explicit_collectives,
             seed=seed,
             tx=tx,
+            accum_steps=cfg.accum_steps,
         )
+        if cfg.accum_steps < 1:
+            raise ValueError(f"--accum-steps must be >= 1, got {cfg.accum_steps}")
+        if cfg.accum_steps > 1 and self.local_batch % cfg.accum_steps:
+            raise ValueError(
+                f"per-process batch {self.local_batch} not divisible by "
+                f"--accum-steps {cfg.accum_steps}"
+            )
         self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
         self.csv = EpochCSVLogger(cfg.epoch_csv)
