@@ -114,11 +114,17 @@ class Trainer:
         )
         if cfg.accum_steps < 1:
             raise ValueError(f"--accum-steps must be >= 1, got {cfg.accum_steps}")
-        if cfg.accum_steps > 1 and self.local_batch % cfg.accum_steps:
-            raise ValueError(
-                f"per-process batch {self.local_batch} not divisible by "
-                f"--accum-steps {cfg.accum_steps}"
-            )
+        if cfg.accum_steps > 1:
+            # Each strided microbatch must still cover every data-axis shard
+            # evenly, or XLA reshards the input on every scan iteration.
+            shards = dict(self.mesh.shape)[self.data_axis]
+            micro_global = cfg.batch_size // cfg.accum_steps
+            if cfg.batch_size % cfg.accum_steps or micro_global % shards:
+                raise ValueError(
+                    f"global batch {cfg.batch_size} / --accum-steps "
+                    f"{cfg.accum_steps} must be a whole multiple of the "
+                    f"'{self.data_axis}' mesh axis ({shards} shards)"
+                )
         self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
         self.csv = EpochCSVLogger(cfg.epoch_csv)

@@ -64,7 +64,7 @@ def test_trainer_accum_flag(tmp_path):
     best = Trainer(cfg).fit()
     assert 0.0 <= best <= 100.0
 
-    with pytest.raises(ValueError, match="not divisible"):
+    with pytest.raises(ValueError, match="whole multiple"):
         Trainer(Config(
             arch="resnet18", batch_size=16, epochs=1, seed=0, synthetic=True,
             synthetic_length=32, image_size=32, num_classes=2,
