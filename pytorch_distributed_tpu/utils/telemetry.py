@@ -7,6 +7,10 @@ TPU runtime's per-device memory statistics (``Device.memory_stats()``), plus
 wall-clock; columns: ``timestamp,index,bytes_limit,bytes_in_use,peak_bytes``.
 
 Run standalone (``python tpu_statistics.py``) or in-process via ``TelemetrySampler``.
+
+Degrades gracefully where the runtime exposes no memory statistics (the CPU
+simulator, and tunneled single-chip platforms): rows are still written on
+schedule with zeroed byte columns, keeping the file contract intact.
 """
 
 from __future__ import annotations
