@@ -40,13 +40,41 @@ class DistContext:
         return self.process_index == 0
 
 
+def _first_slurm_host(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist, dashed-hostname safe.
+
+    ``scontrol show hostnames`` is authoritative (handles every compressed
+    form); the fallback only expands the bracket range — it never splits on
+    ``-`` outside brackets, so ``tpu-host[01-04]`` → ``tpu-host01`` and
+    ``gpu-node-01`` stays intact (round-1 advisor finding)."""
+    if not nodelist:
+        return "127.0.0.1"
+    try:
+        import subprocess
+
+        out = subprocess.run(
+            ["scontrol", "show", "hostnames", nodelist],
+            capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.split()[0]
+    except (OSError, subprocess.SubprocessError):
+        pass
+    head = nodelist.split(",")[0]
+    if "[" in head:
+        prefix, rest = head.split("[", 1)
+        first_token = rest.rstrip("]").split(",")[0].split("-")[0]
+        return prefix + first_token
+    return head
+
+
 def _slurm_env() -> Optional[dict]:
     """Derive multi-host topology from SLURM (reference
     distributed_slurm_main.py:124-128), fixed to count processes not nodes."""
     if "SLURM_PROCID" not in os.environ:
         return None
     nodelist = os.environ.get("SLURM_STEP_NODELIST", os.environ.get("SLURM_NODELIST", ""))
-    first = nodelist.split(",")[0].replace("[", "").split("-")[0] if nodelist else "127.0.0.1"
+    first = _first_slurm_host(nodelist)
     return {
         "process_id": int(os.environ["SLURM_PROCID"]),
         "num_processes": int(os.environ.get("SLURM_NTASKS", os.environ.get("SLURM_NPROCS", "1"))),

@@ -22,21 +22,6 @@ from pytorch_distributed_tpu.recipes._common import run_recipe
 
 
 def main(argv=None) -> float:
-    import sys
-
-    tokens = sys.argv[1:] if argv is None else list(argv)
-    for i, tok in enumerate(tokens):
-        if tok == "--accum-steps":
-            value = tokens[i + 1] if i + 1 < len(tokens) else "1"
-        elif tok.startswith("--accum-steps="):
-            value = tok.split("=", 1)[1]
-        else:
-            continue
-        if value.lstrip("-").isdigit() and int(value) > 1:
-            raise SystemExit(
-                "--accum-steps > 1 is not supported by the explicit-"
-                "collectives recipe; use a GSPMD recipe (e.g. tpu_native)"
-            )
     return run_recipe(
         "TPU ImageNet Training (explicit collectives + bf16 wire grads)",
         argv,

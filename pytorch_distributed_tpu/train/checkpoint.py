@@ -31,20 +31,26 @@ CHECKPOINT_NAME = "checkpoint.msgpack"
 BEST_NAME = "model_best.msgpack"
 
 
-def _to_host(tree: Any) -> Any:
+def _to_host(tree: Any, want_value: bool = True) -> Any:
     """Fetch to host numpy, gathering sharded leaves first.
 
     DP state is replicated (plain fetch); TP/SP-sharded state on multi-host
     meshes spans non-addressable devices, where ``np.asarray`` would raise —
     those leaves are all-gathered across processes so the written checkpoint
-    is always the full, replicated tree (the recipe-interchange invariant)."""
+    is always the full, replicated tree (the recipe-interchange invariant).
+
+    ``want_value=False`` (non-primary ranks): still participate in the
+    cross-process all-gather for non-addressable leaves — a collective every
+    rank must enter — but skip the device→host copy of addressable leaves,
+    whose bytes only the writing rank needs."""
 
     def fetch(x):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
             from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
-        return np.asarray(x)
+            gathered = multihost_utils.process_allgather(x, tiled=True)
+            return np.asarray(gathered) if want_value else None
+        return np.asarray(x) if want_value else None
 
     return jax.tree_util.tree_map(fetch, tree)
 
@@ -58,7 +64,22 @@ def save_checkpoint(
     is_best: bool,
     is_primary: bool = True,
 ) -> Optional[str]:
-    """Rank-0-guarded atomic save (reference distributed.py:218-225)."""
+    """Rank-0-guarded atomic save (reference distributed.py:218-225).
+
+    The host gather runs on EVERY process before the primary guard:
+    ``_to_host`` performs a cross-process all-gather for non-fully-addressable
+    (multi-host-sharded) leaves, and a collective entered by rank 0 alone
+    would deadlock the job at the first checkpoint. All ranks gather; only
+    the primary writes."""
+    host_state = _to_host(
+        {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "momentum": state.momentum,
+        },
+        want_value=is_primary,
+    )
     if not is_primary:
         return None
     os.makedirs(directory, exist_ok=True)
@@ -66,14 +87,7 @@ def save_checkpoint(
         "epoch": epoch,
         "arch": arch,
         "best_acc1": float(best_acc1),
-        "state": _to_host(
-            {
-                "step": state.step,
-                "params": state.params,
-                "batch_stats": state.batch_stats,
-                "momentum": state.momentum,
-            }
-        ),
+        "state": host_state,
     }
     path = os.path.join(directory, CHECKPOINT_NAME)
     tmp = path + ".tmp"

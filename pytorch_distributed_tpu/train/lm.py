@@ -189,9 +189,13 @@ class LMTrainer:
             if i % print_freq == 0:
                 progress.display(i)
         last_loss = losses.val  # end-of-training loss, not the run average
-        if self.checkpoint_dir and self.is_primary:
+        if self.checkpoint_dir:
             from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
 
+            # ALL ranks call: save_checkpoint gathers sharded leaves with a
+            # cross-process collective before its primary guard — gating the
+            # call itself on is_primary would deadlock multi-host TP/SP runs.
             save_checkpoint(self.checkpoint_dir, self.state, 0,
-                            "transformer_lm", 0.0, is_best=False)
+                            "transformer_lm", 0.0, is_best=False,
+                            is_primary=self.is_primary)
         return last_loss

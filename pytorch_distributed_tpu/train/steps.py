@@ -117,6 +117,8 @@ def make_train_step(
             lambda g: g.astype(jnp.float32) / gcount, grads
         ), gcount
 
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     base_key = jax.random.PRNGKey(seed)
     if tx is not None:
         import warnings
@@ -126,6 +128,17 @@ def make_train_step(
             "harness's step-decay schedule) plus the momentum/weight_decay "
             "settings are INACTIVE; configure schedule and regularization "
             "inside the optax transformation.",
+            stacklevel=2,
+        )
+    if wire_dtype is not None and not explicit_collectives:
+        import warnings
+
+        warnings.warn(
+            "make_train_step: wire_dtype under GSPMD is a NUMERICS emulation "
+            "only — XLA places the gradient all-reduce from the shardings, so "
+            "the cast rounds already-synced values and does not compress the "
+            "collective wire format. Use explicit_collectives=True for true "
+            "bf16-wire gradient sync (the Horovod-compression analogue).",
             stacklevel=2,
         )
 
@@ -140,6 +153,67 @@ def make_train_step(
         updates, new_opt = tx.update(grads, state.momentum, state.params)
         return optax.apply_updates(state.params, updates), new_opt
 
+    def micro_grads(params, stats, mbatch, mrng):
+        """Unnormalized (sum-form) grads + metric sums for one microbatch."""
+
+        def loss_fn(params):
+            loss_sum, aux = _forward_and_sums(
+                model, params, stats, mbatch, train=True, dropout_rng=mrng
+            )
+            return loss_sum, aux
+
+        (loss_sum, (_, new_stats, c1, c5, count)), grads = (
+            jax.value_and_grad(loss_fn, has_aux=True)(params)
+        )
+        return grads, new_stats, (loss_sum, c1, c5, count)
+
+    def accumulated_grads(params, stats, batch: Batch, rng):
+        """Sum-form grads/metric-sums over ``accum_steps`` strided microbatches.
+
+        Shared by both formulations: under GSPMD the batch is the global
+        batch; under shard_map it is the per-shard slice (the strided split
+        is then shard-local, and the single psum still happens *after* the
+        scan — one collective per optimizer step, not per microbatch, which
+        is the whole point of accumulating)."""
+        if accum_steps == 1:
+            return micro_grads(params, stats, batch, rng)
+        b = batch["images"].shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"batch dimension {b} (per-shard under explicit collectives, "
+                f"global under GSPMD) is not divisible by accum_steps "
+                f"{accum_steps}"
+            )
+        # Strided split: microbatch i = samples [i::accum_steps].  A
+        # contiguous split would concentrate each microbatch on a subset
+        # of the data-sharded devices and force an all-to-all of the
+        # whole input every step; the strided layout keeps every
+        # microbatch evenly distributed shard-locally.
+        micro = jax.tree_util.tree_map(
+            lambda v: v.reshape(
+                (v.shape[0] // accum_steps, accum_steps) + v.shape[1:]
+            ).swapaxes(0, 1),
+            batch,
+        )
+
+        def body(carry, xs):
+            g_acc, stats, sums = carry
+            mb, i = xs
+            g, stats, s = micro_grads(params, stats, mb, jax.random.fold_in(rng, i))
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+            sums = tuple(a + b for a, b in zip(sums, s))
+            return (g_acc, stats, sums), None
+
+        init = (
+            jax.tree_util.tree_map(jnp.zeros_like, params),
+            stats,
+            (jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.float32(0)),
+        )
+        (grads, new_stats, sums), _ = jax.lax.scan(
+            body, init, (micro, jnp.arange(accum_steps))
+        )
+        return grads, new_stats, sums
+
     def local_step(state: TrainState, batch: Batch, lr: jnp.ndarray):
         """Runs per-shard under shard_map; all reductions explicit."""
         # Per-step, per-shard dropout stream (shards see different data).
@@ -147,17 +221,9 @@ def make_train_step(
             jax.random.fold_in(base_key, state.step),
             jax.lax.axis_index(data_axis),
         )
-
-        def loss_fn(params):
-            loss_sum, aux = _forward_and_sums(
-                model, params, state.batch_stats, batch, train=True,
-                dropout_rng=rng,
-            )
-            return loss_sum, aux  # local *sum*; normalized after psum
-
-        (loss_sum, (_, new_stats, c1, c5, count)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
+        grads, new_stats, (loss_sum, c1, c5, count) = accumulated_grads(
+            state.params, state.batch_stats, batch, rng
+        )
         grads, gcount = sync_grads(grads, count)
         new_params, new_momentum = apply_updates(state, grads, lr)
         # BN running stats: average local EMAs across shards so replicas agree.
@@ -175,57 +241,9 @@ def make_train_step(
     def global_step(state: TrainState, batch: Batch, lr: jnp.ndarray):
         """GSPMD formulation: global-semantics math, XLA infers collectives."""
         rng = jax.random.fold_in(base_key, state.step)
-
-        def micro_grads(params, stats, mbatch, mrng):
-            """Unnormalized (sum-form) grads + metric sums for one microbatch."""
-
-            def loss_fn(params):
-                loss_sum, aux = _forward_and_sums(
-                    model, params, stats, mbatch, train=True, dropout_rng=mrng
-                )
-                return loss_sum, aux
-
-            (loss_sum, (_, new_stats, c1, c5, count)), grads = (
-                jax.value_and_grad(loss_fn, has_aux=True)(params)
-            )
-            return grads, new_stats, (loss_sum, c1, c5, count)
-
-        if accum_steps == 1:
-            grads, new_stats, (loss_sum, c1, c5, count) = micro_grads(
-                state.params, state.batch_stats, batch, rng
-            )
-        else:
-            # Strided split: microbatch i = samples [i::accum_steps].  A
-            # contiguous split would concentrate each microbatch on a subset
-            # of the data-sharded devices and force an all-to-all of the
-            # whole input every step; the strided layout keeps every
-            # microbatch evenly distributed shard-locally.
-            micro = jax.tree_util.tree_map(
-                lambda v: v.reshape(
-                    (v.shape[0] // accum_steps, accum_steps) + v.shape[1:]
-                ).swapaxes(0, 1),
-                batch,
-            )
-
-            def body(carry, xs):
-                g_acc, stats, sums = carry
-                mb, i = xs
-                g, stats, s = micro_grads(
-                    state.params, stats, mb, jax.random.fold_in(rng, i)
-                )
-                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
-                sums = tuple(a + b for a, b in zip(sums, s))
-                return (g_acc, stats, sums), None
-
-            init = (
-                jax.tree_util.tree_map(jnp.zeros_like, state.params),
-                state.batch_stats,
-                (jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.float32(0)),
-            )
-            (grads, new_stats, (loss_sum, c1, c5, count)), _ = jax.lax.scan(
-                body, init, (micro, jnp.arange(accum_steps))
-            )
-
+        grads, new_stats, (loss_sum, c1, c5, count) = accumulated_grads(
+            state.params, state.batch_stats, batch, rng
+        )
         count = jnp.maximum(count, 1.0)
         grads = jax.tree_util.tree_map(lambda g: g / count, grads)
         if wire_dtype is not None:
@@ -247,10 +265,6 @@ def make_train_step(
     sharded = NamedSharding(mesh, P(data_axis))
     batch_shardings = {"images": sharded, "labels": sharded, "weights": sharded}
 
-    if explicit_collectives and accum_steps > 1:
-        raise NotImplementedError(
-            "gradient accumulation is only implemented for the GSPMD step"
-        )
     if explicit_collectives:
         batch_specs = {k: P(data_axis) for k in ("images", "labels", "weights")}
         stepped = shard_map(

@@ -100,18 +100,9 @@ class Trainer:
                 f"(epoch {meta['epoch']}, best_acc1 {self.best_acc1:.3f})"
             )
 
-        self.train_step = make_train_step(
-            self.model,
-            self.mesh,
-            momentum=cfg.momentum,
-            weight_decay=cfg.weight_decay,
-            data_axis=data_axis,
-            wire_dtype=wire_dtype,
-            explicit_collectives=explicit_collectives,
-            seed=seed,
-            tx=tx,
-            accum_steps=cfg.accum_steps,
-        )
+        # Validate accumulation settings BEFORE building the step — an invalid
+        # accum_steps inside make_train_step would only surface as a confusing
+        # trace-time reshape error (round-1 advisor finding).
         if cfg.accum_steps < 1:
             raise ValueError(f"--accum-steps must be >= 1, got {cfg.accum_steps}")
         if cfg.accum_steps > 1:
@@ -125,6 +116,18 @@ class Trainer:
                     f"{cfg.accum_steps} must be a whole multiple of the "
                     f"'{self.data_axis}' mesh axis ({shards} shards)"
                 )
+        self.train_step = make_train_step(
+            self.model,
+            self.mesh,
+            momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay,
+            data_axis=data_axis,
+            wire_dtype=wire_dtype,
+            explicit_collectives=explicit_collectives,
+            seed=seed,
+            tx=tx,
+            accum_steps=cfg.accum_steps,
+        )
         self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
         self.csv = EpochCSVLogger(cfg.epoch_csv)

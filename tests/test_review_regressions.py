@@ -81,6 +81,42 @@ def test_pretrained_missing_weights_fails_loudly(tmp_path, monkeypatch):
         Trainer(_cfg(tmp_path, pretrained=True))
 
 
+@pytest.mark.parametrize("nodelist,expected", [
+    ("tpu-host[01-04]", "tpu-host01"),        # dashed prefix + bracket range
+    ("gpu-node-01", "gpu-node-01"),           # plain dashed hostname intact
+    ("n[001,005-008],n[100]", "n001"),        # comma inside brackets
+    ("hosta,hostb", "hosta"),
+    ("", "127.0.0.1"),
+])
+def test_slurm_first_host_handles_dashed_names(nodelist, expected):
+    """Advisor round-1 finding: 'gpu-node-01' must not resolve to 'gpu'."""
+    from pytorch_distributed_tpu.parallel.dist import _first_slurm_host
+
+    assert _first_slurm_host(nodelist) == expected
+
+
+def test_wire_dtype_gspmd_warns_numerics_only():
+    """Advisor round-1 finding: GSPMD-mode wire_dtype does not compress the
+    collective wire; the API must say so."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.steps import make_train_step
+    from tests.test_steps import _MLP
+
+    mesh = build_mesh(MeshSpec(("data",), (8,)), jax.devices()[:8])
+    with pytest.warns(UserWarning, match="NUMERICS emulation"):
+        make_train_step(_MLP(classes=2), mesh, wire_dtype=jnp.bfloat16)
+
+
+def test_accum_zero_rejected_before_step_build(tmp_path):
+    """Advisor round-1 finding: validation must precede make_train_step so
+    accum_steps=0 raises the clear ValueError, not a trace-time reshape."""
+    with pytest.raises(ValueError, match="--accum-steps"):
+        Trainer(_cfg(tmp_path, accum_steps=0))
+
+
 def test_pretrained_loads_saved_checkpoint(tmp_path, monkeypatch, capsys):
     t = Trainer(_cfg(tmp_path, num_classes=4))
     from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
