@@ -1,0 +1,142 @@
+"""Fused BatchNorm(+ReLU) with a hand-written VJP — the HBM-traffic fix.
+
+Training ResNet-50 on TPU is HBM-bound, not MXU-bound: profiling the round-1
+step (scripts/profile_trace.py) showed backward conv fusions re-reading the
+full pre-BN activation through flax BatchNorm's f32-promoted autodiff
+residuals, putting the step at ~2× the memory-roofline time.  This module
+replaces ``flax.linen.BatchNorm`` (+ the following ReLU) in the conv stacks:
+
+- **forward** computes batch statistics in one pass (mean + mean-of-squares,
+  f32 accumulation over bf16 reads) and normalizes; XLA fuses the stats
+  reduce into the producing conv's epilogue and the normalize into the
+  consuming conv's input.
+- **backward** is a custom VJP whose residuals are the *bf16* pre-BN tensor
+  plus per-channel vectors — flax's autodiff saves an f32-promoted copy
+  (2× the bytes) and reads both the pre-BN and post-ReLU tensors; ours
+  reads exactly one saved tensor (the ReLU mask is recomputed from it:
+  ``relu'(γ·x̂+β) = [γ·x̂+β > 0]``).
+
+Semantics match ``nn.BatchNorm(momentum=0.9, epsilon=1e-5)`` + ``nn.relu``
+exactly (tested to f32 tolerance in tests/test_fused_bn.py), including
+SyncBN-under-GSPMD: the statistics reductions are global-semantics means, so
+XLA inserts the cross-replica psum when the batch is sharded — same as the
+flax path (reference capability: torch DDP's unsynced BN, see
+train/steps.py docstring for the per-recipe BN semantics note).
+
+Reference anchor: the BN layers of every torchvision model the reference
+instantiates (reference distributed.py:134-139).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _stats(y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One-pass batch mean/variance over all-but-channel axes, f32 accum."""
+    axes = tuple(range(y.ndim - 1))
+    yf = y.astype(jnp.float32)
+    mu = yf.mean(axes)
+    var = (yf * yf).mean(axes) - mu * mu
+    return mu, var
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_act(y, gamma, beta, eps: float, relu: bool):
+    """Returns ``(o, mean, var)`` — stats are exposed for the EMA update
+    (stop-gradiented by the caller, so their cotangents are zero)."""
+    (o, mu, var), _ = _bn_act_fwd(y, gamma, beta, eps, relu)
+    return o, mu, var
+
+
+def _bn_act_fwd(y, gamma, beta, eps: float, relu: bool):
+    mu, var = _stats(y)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = gamma * inv
+    shift = beta - mu * scale
+    o = (y.astype(jnp.float32) * scale + shift).astype(y.dtype)
+    if relu:
+        o = jax.nn.relu(o)
+    # Residuals: the bf16 pre-BN tensor + per-channel vectors.  Neither the
+    # normalized nor the post-ReLU tensor is saved — backward reconstructs
+    # x̂ and the ReLU mask from y.
+    return (o, mu, var), (y, mu, inv, gamma, beta)
+
+
+def _bn_act_bwd(eps: float, relu: bool, res, cts):
+    y, mu, inv, gamma, beta = res
+    do = cts[0]  # cotangents for (mu, var) outputs are zero (EMA is stop-grad)
+    axes = tuple(range(y.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= y.shape[a]
+    yf = y.astype(jnp.float32)
+    xhat = (yf - mu) * inv
+    dof = do.astype(jnp.float32)
+    if relu:
+        dof = jnp.where(gamma * xhat + beta > 0, dof, 0.0)
+    dbeta = dof.sum(axes)
+    dgamma = (dof * xhat).sum(axes)
+    # Standard BN backward through the batch statistics.
+    dx = (gamma * inv) * (dof - dbeta / n - xhat * (dgamma / n))
+    return dx.astype(y.dtype), dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype)
+
+
+_bn_act.defvjp(_bn_act_fwd, _bn_act_bwd)
+
+
+class FusedBatchNormAct(nn.Module):
+    """Drop-in for ``nn.BatchNorm(...)`` (+ optional fused ReLU).
+
+    Variable names/collections match flax BatchNorm (params ``scale``/
+    ``bias``; batch_stats ``mean``/``var``) so checkpoints remain
+    recipe-interchangeable with the round-1 models.
+    """
+
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    # No dtype knob: storage follows the input dtype, normalization math is
+    # always f32-in-register (reads/writes stay bf16 under the bf16 policy).
+    relu: bool = False
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        # Call-time flag overrides the constructor (unlike flax's merge_param,
+        # which forbids setting both — recipes set it at construction, tests
+        # at call time).
+        use_ra = (
+            use_running_average
+            if use_running_average is not None
+            else bool(self.use_running_average)
+        )
+        features = x.shape[-1]
+        gamma = self.param("scale", self.scale_init, (features,), jnp.float32)
+        beta = self.param("bias", self.bias_init, (features,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if use_ra:
+            inv = jax.lax.rsqrt(ra_var.value + self.epsilon)
+            scale = gamma * inv
+            shift = beta - ra_mean.value * scale
+            o = (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
+            return jax.nn.relu(o) if self.relu else o
+
+        o, mu, var = _bn_act(x, gamma, beta, self.epsilon, self.relu)
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * jax.lax.stop_gradient(mu)
+            ra_var.value = m * ra_var.value + (1 - m) * jax.lax.stop_gradient(var)
+        return o

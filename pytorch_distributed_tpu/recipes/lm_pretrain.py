@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-p", "--print-freq", type=int, default=10)
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--dataset-length", type=int, default=4096)
+    p.add_argument("--eval-every", type=int, default=0,
+                   help="run held-out eval (loss/ppl) every N steps; "
+                        "0 = end-of-run only")
+    p.add_argument("--eval-batches", type=int, default=8)
+    p.add_argument("--no-eval", action="store_true",
+                   help="disable the held-out eval entirely")
     return p
 
 
@@ -102,10 +108,18 @@ def main(argv=None) -> float:
                 from pytorch_distributed_tpu.models.moe import moe_specs
 
                 specs = moe_specs(params_shape)
+        eval_dataset = (
+            None if args.no_eval else SyntheticTokenDataset(
+                max(args.dataset_length // 10, args.batch_size),
+                args.seq_len, args.vocab, seed=args.seed + 1,
+            )
+        )
         trainer = LMTrainer(
             model, mesh, dataset, args.batch_size, lr=args.lr,
             param_specs=specs, seed=args.seed, is_primary=ctx.is_primary,
             checkpoint_dir=args.checkpoint_dir,
+            eval_dataset=eval_dataset, eval_every=args.eval_every,
+            eval_batches=args.eval_batches,
         )
         final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
     print(f" * Final loss {final_loss:.4f}", flush=True)

@@ -54,6 +54,7 @@ class Config:
     num_classes: int = 1000
     resume: Optional[str] = None
     checkpoint_dir: str = "."
+    ckpt_backend: str = "msgpack"
     epoch_csv: Optional[str] = None
     profile_dir: Optional[str] = None
     telemetry_csv: Optional[str] = None
@@ -120,6 +121,10 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="path to checkpoint to resume from")
     p.add_argument("--checkpoint-dir", default=d.checkpoint_dir, type=str,
                    help="directory for checkpoint files")
+    p.add_argument("--ckpt-backend", default=d.ckpt_backend,
+                   choices=("msgpack", "orbax"), dest="ckpt_backend",
+                   help="msgpack = single-file portable (default); orbax = "
+                   "async sharded per-process writes (multi-host TP/SP scale)")
     p.add_argument("--epoch-csv", default=d.epoch_csv, type=str,
                    help="append [timestamp, epoch_seconds] rows to this CSV")
     p.add_argument("--profile-dir", default=d.profile_dir, type=str,

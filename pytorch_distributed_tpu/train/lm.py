@@ -127,8 +127,46 @@ def make_lm_train_step(
     )
 
 
+def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data"):
+    """Jitted held-out eval step returning exact token-weighted *sums*
+    (loss·count, correct, count) — the LM counterpart of the image harness's
+    ``make_eval_step`` (reference validate() pattern,
+    reference distributed.py:279-324): aggregation is exact on the host,
+    reductions live inside the compiled program."""
+
+    def step(state: TrainState, tokens: jnp.ndarray):
+        # mutable=["losses"]: MoE models sow the router aux loss even in
+        # inference; collected and dropped (eval reports data loss only).
+        logits, _ = model.apply({"params": state.params}, tokens,
+                                mutable=["losses"])
+        vocab = logits.shape[-1]
+        flat_logits = logits[:, :-1].reshape(-1, vocab)
+        flat_targets = tokens[:, 1:].reshape(-1)
+        count = jnp.float32(flat_targets.shape[0])
+        loss = cross_entropy(flat_logits, flat_targets)
+        correct = jnp.sum(
+            (jnp.argmax(flat_logits, axis=-1) == flat_targets).astype(jnp.float32)
+        )
+        return {"loss_sum": loss * count, "correct": correct, "count": count}
+
+    from pytorch_distributed_tpu.parallel.tp import state_specs
+
+    state_shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), state_specs(param_specs)
+    )
+    token_sharding = NamedSharding(mesh, P(data_axis, None))
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, token_sharding),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
 class LMTrainer:
-    """Step-driven driver: meters, periodic display, rank-0 checkpoints."""
+    """Step-driven driver: meters, periodic display, rank-0 checkpoints,
+    and a held-out eval loop (loss / perplexity / next-token accuracy) with
+    best tracking — mirroring the image harness's validate/best-acc flow
+    (reference distributed.py:212-225)."""
 
     def __init__(
         self,
@@ -141,6 +179,9 @@ class LMTrainer:
         seed: int = 0,
         is_primary: bool = True,
         checkpoint_dir: Optional[str] = None,
+        eval_dataset: Optional[SyntheticTokenDataset] = None,
+        eval_every: int = 0,
+        eval_batches: int = 8,
     ):
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
@@ -168,6 +209,37 @@ class LMTrainer:
         self.state = shard_state(state, self.param_specs, mesh)
         self.step_fn = make_lm_train_step(model, mesh, self.param_specs)
         self.token_sharding = NamedSharding(mesh, P("data", None))
+        self.eval_dataset = eval_dataset
+        self.eval_every = eval_every
+        self.eval_batches = eval_batches
+        self.best_ppl = float("inf")
+        self._eval_fn = (
+            make_lm_eval_step(model, mesh, self.param_specs)
+            if eval_dataset is not None
+            else None
+        )
+
+    def evaluate(self) -> Tuple[float, float, float]:
+        """Held-out ``(loss, perplexity, next-token acc%)`` over
+        ``eval_batches`` batches; prints the summary line (the LM analogue of
+        the reference's ``* Acc@1 …``, distributed.py:321-322)."""
+        if self._eval_fn is None:
+            raise ValueError("LMTrainer built without eval_dataset")
+        totals = {"loss_sum": 0.0, "correct": 0.0, "count": 0.0}
+        for i in range(self.eval_batches):
+            tokens = jax.device_put(
+                self.eval_dataset.batch(i, self.batch_size), self.token_sharding
+            )
+            sums = self._eval_fn(self.state, tokens)
+            for k in totals:
+                totals[k] += float(sums[k])
+        count = max(totals["count"], 1.0)
+        loss = totals["loss_sum"] / count
+        ppl = float(np.exp(min(loss, 30.0)))
+        acc = totals["correct"] * 100.0 / count
+        print(f" * Eval loss {loss:.4f} ppl {ppl:.2f} Acc@1 {acc:.2f}",
+              flush=True)
+        return loss, ppl, acc
 
     def fit(self, steps: int, print_freq: int = 10) -> float:
         losses = AverageMeter("Loss", ":.4e")
@@ -177,6 +249,7 @@ class LMTrainer:
                                  prefix="Step: ")
         lr = jnp.float32(self.lr)
         end = time.time()
+        final_ppl = None  # ppl from an interval eval on the very last step
         for i in range(steps):
             tokens = jax.device_put(
                 self.dataset.batch(i, self.batch_size), self.token_sharding
@@ -188,6 +261,24 @@ class LMTrainer:
             end = time.time()
             if i % print_freq == 0:
                 progress.display(i)
+            if (
+                self._eval_fn is not None
+                and self.eval_every > 0
+                and (i + 1) % self.eval_every == 0
+            ):
+                _, final_ppl, _ = self.evaluate()
+                self.best_ppl = min(self.best_ppl, final_ppl)
+                end = time.time()  # eval time must not pollute the step meter
+            else:
+                final_ppl = None
+        is_best = False
+        if self._eval_fn is not None:
+            if final_ppl is None:  # last step didn't land on an eval boundary
+                _, final_ppl, _ = self.evaluate()
+            # <= so the final state is marked best when it ties the best seen
+            # (the common case: the just-run interval eval set best_ppl).
+            is_best = final_ppl <= self.best_ppl
+            self.best_ppl = min(self.best_ppl, final_ppl)
         last_loss = losses.val  # end-of-training loss, not the run average
         if self.checkpoint_dir:
             from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
@@ -195,7 +286,10 @@ class LMTrainer:
             # ALL ranks call: save_checkpoint gathers sharded leaves with a
             # cross-process collective before its primary guard — gating the
             # call itself on is_primary would deadlock multi-host TP/SP runs.
+            # best_acc1 slot carries the best perplexity for the LM family.
             save_checkpoint(self.checkpoint_dir, self.state, 0,
-                            "transformer_lm", 0.0, is_best=False,
+                            "transformer_lm",
+                            self.best_ppl if self._eval_fn is not None else 0.0,
+                            is_best=is_best,
                             is_primary=self.is_primary)
         return last_loss

@@ -327,5 +327,13 @@ class Trainer:
                 self.best_acc1,
                 is_best,
                 is_primary=self.ctx.is_primary,
+                backend=cfg.ckpt_backend,
+                metric=acc1,  # this epoch's own score (orbax best retention)
             )
+        if cfg.ckpt_backend == "orbax":
+            from pytorch_distributed_tpu.train.checkpoint import (
+                wait_for_async_saves,
+            )
+
+            wait_for_async_saves()
         return self.best_acc1

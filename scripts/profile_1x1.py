@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""1x1 conv vs equivalent reshaped matmul at ResNet stage-1 shapes (chained
+in-program so LICM can't hoist)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+REPS = 20
+
+
+def timed_scalar(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench(b, h, w, cin, cout, label):
+    x0 = jnp.ones((b, h, w, cin), jnp.bfloat16)
+    w1 = jnp.ones((1, 1, cin, cout), jnp.bfloat16) / cin
+    w2 = jnp.ones((1, 1, cout, cin), jnp.bfloat16) / cout
+    flops = 2 * b * h * w * cin * cout * 2  # two convs per chain iter
+
+    @jax.jit
+    def conv_chain(x0, w1, w2):
+        def body(i, x):
+            y = jax.lax.conv_general_dilated(
+                x, w1, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            return jax.lax.conv_general_dilated(
+                y, w2, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        return jax.lax.fori_loop(0, REPS, body, x0).astype(jnp.float32).mean()
+
+    t = timed_scalar(conv_chain, x0, w1, w2) / REPS
+    print(f"{label} conv1x1 pair: {t*1e3:.3f} ms -> {flops/t/1e12:.1f} TFLOP/s")
+
+    wa = w1.reshape(cin, cout)
+    wb = w2.reshape(cout, cin)
+
+    @jax.jit
+    def dot_chain(x0, wa, wb):
+        def body(i, x):
+            y = x @ wa
+            return y @ wb
+
+        return jax.lax.fori_loop(0, REPS, body, x0).astype(jnp.float32).mean()
+
+    t = timed_scalar(dot_chain, x0, wa, wb) / REPS
+    print(f"{label} dot pair:     {t*1e3:.3f} ms -> {flops/t/1e12:.1f} TFLOP/s")
+
+    # flattened-spatial dot (one big M dim)
+    xf = x0.reshape(b * h * w, cin)
+
+    @jax.jit
+    def dotf_chain(xf, wa, wb):
+        def body(i, x):
+            return (x @ wa) @ wb
+
+        return jax.lax.fori_loop(0, REPS, body, xf).astype(jnp.float32).mean()
+
+    t = timed_scalar(dotf_chain, xf, wa, wb) / REPS
+    print(f"{label} flat dot:     {t*1e3:.3f} ms -> {flops/t/1e12:.1f} TFLOP/s")
+
+    # weight-grad shape: [cin, M] @ [M, cout]
+    g = jnp.ones((b * h * w, cout), jnp.bfloat16)
+
+    @jax.jit
+    def wgrad(xf, g):
+        def body(i, acc):
+            gw = (xf * (1.0 + acc)).T @ g
+            return gw.astype(jnp.float32).mean()
+
+        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0))
+
+    wflops = 2 * b * h * w * cin * cout
+    t = timed_scalar(wgrad, xf, g) / REPS
+    print(f"{label} wgrad dot:    {t*1e3:.3f} ms -> {wflops/t/1e12:.1f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    bench(256, 56, 56, 64, 256, "56x56  64<->256")
+    bench(256, 28, 28, 128, 512, "28x28 128<->512")
+    bench(256, 14, 14, 256, 1024, "14x14 256<->1024")

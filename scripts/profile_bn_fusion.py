@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Does fusing BN statistics into the conv epilogue slow the conv?
+
+Chained conv+BN blocks, with and without an optimization_barrier between the
+conv output and the statistics reduction."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+REPS = 10
+
+
+def timed_scalar(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def conv1x1(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def bn_train(y, barrier):
+    if barrier:
+        (y,) = jax.lax.optimization_barrier((y,))
+    yf = y.astype(jnp.float32)
+    mu = yf.mean(axis=(0, 1, 2))
+    var = (yf * yf).mean(axis=(0, 1, 2)) - mu * mu
+    inv = jax.lax.rsqrt(var + 1e-5)
+    return ((yf - mu) * inv).astype(jnp.bfloat16)
+
+
+def make_block(barrier):
+    def block(x, w1, w2):
+        y = bn_train(conv1x1(x, w1), barrier)
+        y = jax.nn.relu(y)
+        y = bn_train(conv1x1(y, w2), barrier)
+        return jax.nn.relu(y)
+
+    return block
+
+
+def bench(b, h, w, cin, cout):
+    x0 = jnp.ones((b, h, w, cin), jnp.bfloat16)
+    w1 = jnp.ones((1, 1, cin, cout), jnp.bfloat16) / cin
+    w2 = jnp.ones((1, 1, cout, cin), jnp.bfloat16) / cout
+    flops = 2 * b * h * w * cin * cout * 2
+
+    for barrier in (False, True):
+        block = make_block(barrier)
+
+        @jax.jit
+        def fwd(x0, w1, w2):
+            def body(i, x):
+                return block(x, w1, w2)
+
+            return jax.lax.fori_loop(0, REPS, body, x0).astype(jnp.float32).mean()
+
+        t = timed_scalar(fwd, x0, w1, w2) / REPS
+        print(f"{h}x{w} {cin}<->{cout} fwd  barrier={barrier}: {t*1e3:.3f} ms "
+              f"-> {flops/t/1e12:.1f} conv-TFLOP/s")
+
+        @jax.jit
+        def fwdbwd(x0, w1, w2):
+            def loss(x, w1, w2):
+                return block(x, w1, w2).astype(jnp.float32).mean()
+
+            def body(i, carry):
+                x, acc = carry
+                gx, g1, g2 = jax.grad(loss, argnums=(0, 1, 2))(x, w1, w2)
+                return gx.astype(jnp.bfloat16), acc + g1.astype(jnp.float32).mean()
+
+            x, acc = jax.lax.fori_loop(0, REPS, body, (x0, jnp.float32(0)))
+            return x.astype(jnp.float32).mean() + acc
+
+        t = timed_scalar(fwdbwd, x0, w1, w2) / REPS
+        print(f"{h}x{w} {cin}<->{cout} f+b  barrier={barrier}: {t*1e3:.3f} ms "
+              f"-> {3*flops/t/1e12:.1f} conv-TFLOP/s eq")
+
+
+if __name__ == "__main__":
+    bench(256, 56, 56, 64, 256)
+    bench(256, 28, 28, 128, 512)

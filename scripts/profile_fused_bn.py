@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Fused BN+ReLU (custom_vjp, backward reads only the pre-BN tensor) vs
+flax-style BN — bottleneck-shaped conv chain, fwd+bwd."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+REPS = 10
+
+
+def timed_scalar(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def conv1x1(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+# ---------------- flax-style BN+relu (baseline) ----------------
+def bn_relu_ref(y, gamma, beta):
+    yf = y.astype(jnp.float32)
+    mu = yf.mean(axis=(0, 1, 2))
+    var = (yf * yf).mean(axis=(0, 1, 2)) - mu * mu
+    inv = jax.lax.rsqrt(var + 1e-5)
+    o = ((yf - mu) * inv * gamma + beta).astype(y.dtype)
+    return jax.nn.relu(o)
+
+
+# ---------------- fused BN+relu with custom bwd ----------------
+@partial(jax.custom_vjp, nondiff_argnums=())
+def bn_relu_fused(y, gamma, beta):
+    o, _ = _bnr_fwd(y, gamma, beta)
+    return o
+
+
+def _bnr_fwd(y, gamma, beta):
+    yf = y.astype(jnp.float32)
+    n = y.shape[0] * y.shape[1] * y.shape[2]
+    mu = yf.mean(axis=(0, 1, 2))
+    var = (yf * yf).mean(axis=(0, 1, 2)) - mu * mu
+    inv = jax.lax.rsqrt(var + 1e-5)
+    o = ((yf - mu) * (inv * gamma) + beta).astype(y.dtype)
+    o = jax.nn.relu(o)
+    # residuals: pre-BN tensor + per-channel vectors only (o NOT saved)
+    return o, (y, mu, inv, gamma, beta)
+
+
+def _bnr_bwd(res, do):
+    y, mu, inv, gamma, beta = res
+    n = y.shape[0] * y.shape[1] * y.shape[2]
+    yf = y.astype(jnp.float32)
+    xhat = (yf - mu) * inv
+    act = (gamma * xhat + beta) > 0  # relu mask recomputed from y
+    dof = jnp.where(act, do.astype(jnp.float32), 0.0)
+    dbeta = dof.sum(axis=(0, 1, 2))
+    dgamma = (dof * xhat).sum(axis=(0, 1, 2))
+    dx = (gamma * inv) * (dof - dbeta / n - xhat * (dgamma / n))
+    return dx.astype(y.dtype), dgamma, dbeta
+
+
+bn_relu_fused.defvjp(_bnr_fwd, _bnr_bwd)
+
+
+def bench(b, h, w, cin, cout, bn_fn, label):
+    x0 = jnp.ones((b, h, w, cin), jnp.bfloat16)
+    w1 = jnp.ones((1, 1, cin, cout), jnp.bfloat16) / cin
+    w2 = jnp.ones((1, 1, cout, cin), jnp.bfloat16) / cout
+    g1 = jnp.ones((cout,), jnp.float32)
+    b1 = jnp.zeros((cout,), jnp.float32)
+    g2 = jnp.ones((cin,), jnp.float32)
+    b2 = jnp.zeros((cin,), jnp.float32)
+    flops = 2 * b * h * w * cin * cout * 2
+
+    def block(x, w1, w2, g1, b1, g2, b2):
+        y = bn_fn(conv1x1(x, w1), g1, b1)
+        return bn_fn(conv1x1(y, w2), g2, b2)
+
+    @jax.jit
+    def fwdbwd(x0, w1, w2, g1, b1, g2, b2):
+        def loss(x, w1, w2):
+            return block(x, w1, w2, g1, b1, g2, b2).astype(jnp.float32).mean()
+
+        def body(i, carry):
+            x, acc = carry
+            gx, gw1, gw2 = jax.grad(loss, argnums=(0, 1, 2))(x, w1, w2)
+            return gx.astype(jnp.bfloat16), acc + gw1.astype(jnp.float32).mean()
+
+        x, acc = jax.lax.fori_loop(0, REPS, body, (x0, jnp.float32(0)))
+        return x.astype(jnp.float32).mean() + acc
+
+    t = timed_scalar(fwdbwd, x0, w1, w2, g1, b1, g2, b2) / REPS
+    print(f"{label} {h}x{w} {cin}<->{cout} f+b: {t*1e3:.3f} ms "
+          f"-> {3*flops/t/1e12:.1f} conv-TFLOP/s eq")
+    return t
+
+
+def parity():
+    import numpy as np
+    rng = np.random.default_rng(0)
+    y = jnp.asarray(rng.normal(size=(8, 4, 4, 16)).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=16).astype(np.float32))
+    be = jnp.asarray(rng.normal(size=16).astype(np.float32))
+    do = jnp.asarray(rng.normal(size=(8, 4, 4, 16)).astype(np.float32))
+
+    def f_ref(y, g, be):
+        return (bn_relu_ref(y, g, be) * do).sum()
+
+    def f_fus(y, g, be):
+        return (bn_relu_fused(y, g, be) * do).sum()
+
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(y, g, be)
+    gf = jax.grad(f_fus, argnums=(0, 1, 2))(y, g, be)
+    for a, c, name in zip(gr, gf, "y gamma beta".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=2e-4,
+                                   atol=2e-4)
+    print("gradient parity: OK")
+
+
+if __name__ == "__main__":
+    parity()
+    for shape in [(256, 56, 56, 64, 256), (256, 28, 28, 128, 512)]:
+        t_ref = bench(*shape, bn_relu_ref, "flax-style")
+        t_fus = bench(*shape, bn_relu_fused, "fused-vjp ")
+        print(f"  speedup: {t_ref/t_fus:.2f}x")

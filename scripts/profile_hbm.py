@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""HBM bandwidth ceiling + full-step batch-size sensitivity."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed_scalar(fn, *args, iters=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def hbm():
+    n = 128 * 1024 * 1024  # 256MB bf16
+    x = jnp.ones((n,), jnp.bfloat16)
+    REPS = 20
+
+    @jax.jit
+    def chain(x):
+        def body(i, x):
+            return x * 1.0000001 + 0.0000001
+
+        return jax.lax.fori_loop(0, REPS, body, x).astype(jnp.float32).mean()
+
+    t = timed_scalar(chain, x) / REPS
+    traffic = 2 * n * 2  # read + write bf16
+    print(f"elementwise chain: {t*1e3:.3f} ms -> {traffic/t/1e9:.0f} GB/s")
+
+    @jax.jit
+    def reduce_chain(x):
+        def body(i, acc):
+            return acc + (x * (1.0 + acc)).astype(jnp.float32).mean()
+
+        return jax.lax.fori_loop(0, REPS, body, jnp.float32(0))
+
+    t = timed_scalar(reduce_chain, x) / REPS
+    print(f"reduce chain (read-only): {t*1e3:.3f} ms -> {n*2/t/1e9:.0f} GB/s")
+
+
+def step_bench(batch):
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+
+    image = 224
+    mesh = data_parallel_mesh()
+    model = models.create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)),
+                          train=False)
+    state = TrainState.create(variables, sgd_init(variables["params"]))
+    step = make_train_step(model, mesh)
+    rng = np.random.default_rng(0)
+    b = {"images": jnp.asarray(rng.normal(size=(batch, image, image, 3)).astype(np.float32)),
+         "labels": jnp.asarray(rng.integers(0, 1000, size=batch).astype(np.int32)),
+         "weights": jnp.ones((batch,), jnp.float32)}
+    lr = jnp.float32(0.1)
+    for _ in range(3):
+        state, met = step(state, b, lr)
+    float(met["loss"])
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, met = step(state, b, lr)
+    float(met["loss"])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"batch {batch}: {dt*1e3:.1f} ms/step -> {batch/dt:.0f} img/s")
+
+
+if __name__ == "__main__":
+    if sys.argv[1] == "hbm":
+        hbm()
+    else:
+        step_bench(int(sys.argv[1]))

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Step-phase breakdown on the real chip: fwd, fwd+bwd, full step, raw matmul.
+
+Finds where the ResNet-50 step time goes (VERDICT round-1: backward runs
+3.5x forward where ~2x is expected).  Run on TPU: ``python scripts/profile_step.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args, iters=20, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    # Value-fetch sync (axon block_until_ready returns early).
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.ops import cross_entropy
+    from pytorch_distributed_tpu.train.optim import sgd_init, sgd_update
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+
+    batch, image = 256, 224
+    mesh = data_parallel_mesh()
+    model = models.create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)),
+                          train=False)
+    params, stats = variables["params"], variables["batch_stats"]
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(batch, image, image, 3)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 1000, size=batch).astype(np.int32))
+
+    # --- raw MXU ceiling probe: bf16 matmul ---
+    m = 8192
+    a = jnp.ones((m, m), jnp.bfloat16)
+    mm = jax.jit(lambda x: x @ x)
+    t = timed(mm, a)
+    print(f"matmul {m}x{m} bf16: {t*1e3:.2f} ms -> {2*m**3/t/1e12:.1f} TFLOP/s")
+
+    # --- forward only (train mode, mutable stats) ---
+    def fwd(p, s, x):
+        logits, mut = model.apply({"params": p, "batch_stats": s}, x,
+                                  train=True, mutable=["batch_stats"])
+        return logits.sum()
+
+    f = jax.jit(fwd)
+    t_fwd = timed(f, params, stats, images)
+    print(f"forward(train): {t_fwd*1e3:.2f} ms")
+
+    # --- forward eval mode ---
+    fe = jax.jit(lambda p, s, x: model.apply(
+        {"params": p, "batch_stats": s}, x, train=False).sum())
+    t_fe = timed(fe, params, stats, images)
+    print(f"forward(eval):  {t_fe*1e3:.2f} ms")
+
+    # --- fwd + bwd (loss grad wrt params) ---
+    def loss_fn(p, s, x, y):
+        logits, mut = model.apply({"params": p, "batch_stats": s}, x,
+                                  train=True, mutable=["batch_stats"])
+        return cross_entropy(logits, y), mut
+
+    g = jax.jit(jax.grad(loss_fn, has_aux=True))
+    t_bwd = timed(g, params, stats, images, labels)
+    print(f"fwd+bwd: {t_bwd*1e3:.2f} ms (bwd-only ~{(t_bwd-t_fwd)*1e3:.2f} ms, "
+          f"ratio {(t_bwd-t_fwd)/t_fwd:.2f}x fwd)")
+
+    # --- optimizer update alone ---
+    mom = sgd_init(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    upd = jax.jit(lambda g_, m_, p_: sgd_update(g_, m_, p_, jnp.float32(0.1)))
+    t_upd = timed(upd, grads, mom, params)
+    print(f"sgd update: {t_upd*1e3:.2f} ms")
+
+    # --- full train step (the bench path) ---
+    state = TrainState.create({"params": params, "batch_stats": stats},
+                              sgd_init(params))
+    step = make_train_step(model, mesh)
+    b = {"images": images, "labels": labels,
+         "weights": jnp.ones((batch,), jnp.float32)}
+
+    def run(s):
+        s2, m2 = step(s, b, jnp.float32(0.1))
+        return m2["loss"]
+
+    # can't donate in a timing loop with same state; rebuild step without donation
+    from pytorch_distributed_tpu.train import steps as steps_mod
+    t0 = time.perf_counter()
+    iters = 20
+    st = state
+    for _ in range(3):
+        st, met = step(st, b, jnp.float32(0.1))
+    float(met["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        st, met = step(st, b, jnp.float32(0.1))
+    float(met["loss"])
+    t_step = (time.perf_counter() - t0) / iters
+    print(f"full step: {t_step*1e3:.2f} ms -> {batch/t_step:.0f} img/s")
+
+
+if __name__ == "__main__":
+    main()

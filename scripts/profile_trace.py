@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Capture an XPlane trace of the ResNet-50 train step on the real chip and
+print the self-time op breakdown (tensorboard_plugin_profile converter)."""
+
+import glob
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TRACE_DIR = "/tmp/ptd_trace"
+
+
+def capture():
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+
+    batch, image = 256, 224
+    mesh = data_parallel_mesh()
+    model = models.create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)),
+                          train=False)
+    state = TrainState.create(variables, sgd_init(variables["params"]))
+    step = make_train_step(model, mesh)
+    rng = np.random.default_rng(0)
+    b = {
+        "images": jnp.asarray(rng.normal(size=(batch, image, image, 3)).astype(np.float32)),
+        "labels": jnp.asarray(rng.integers(0, 1000, size=batch).astype(np.int32)),
+        "weights": jnp.ones((batch,), jnp.float32),
+    }
+    lr = jnp.float32(0.1)
+    for _ in range(3):
+        state, met = step(state, b, lr)
+    float(met["loss"])
+    jax.profiler.start_trace(TRACE_DIR)
+    for _ in range(5):
+        state, met = step(state, b, lr)
+    float(met["loss"])
+    jax.profiler.stop_trace()
+    print("trace captured")
+
+
+def analyze(tool="framework_op_stats"):
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+
+    paths = sorted(glob.glob(TRACE_DIR + "/**/*.xplane.pb", recursive=True))
+    if not paths:
+        sys.exit("no xplane.pb found")
+    data, _ = raw_to_tool_data.xspace_to_tool_data([paths[-1]], tool + "^", {})
+    out = f"/tmp/{tool}.out"
+    mode = "wb" if isinstance(data, bytes) else "w"
+    with open(out, mode) as f:
+        f.write(data)
+    print(f"wrote {out} ({len(data)} bytes)")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "analyze":
+        analyze(sys.argv[2] if len(sys.argv) > 2 else "framework_op_stats")
+    else:
+        capture()
