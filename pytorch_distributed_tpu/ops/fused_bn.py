@@ -42,7 +42,10 @@ def _stats(y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     axes = tuple(range(y.ndim - 1))
     yf = y.astype(jnp.float32)
     mu = yf.mean(axes)
-    var = (yf * yf).mean(axes) - mu * mu
+    # One-pass E[y²]−μ² can go (numerically) negative under cancellation for
+    # large-mean/small-spread channels; clamp like flax's _compute_stats or
+    # rsqrt(var+eps) NaNs mid-training.
+    var = jnp.maximum((yf * yf).mean(axes) - mu * mu, 0.0)
     return mu, var
 
 

@@ -67,13 +67,15 @@ def _orbax_manager(directory: str):
     path = os.path.abspath(os.path.join(directory, _ORBAX_DIRNAME))
     mgr = _orbax_managers.get(path)
     if mgr is None:
+        # Retention is latest-N (NOT best_fn): resume-from-latest must always
+        # work, and a best_fn policy would garbage-collect the just-written
+        # newest step whenever it isn't top-N.  The best epoch's score lives
+        # in each step's meta/metrics for offline selection.
         mgr = ocp.CheckpointManager(
             path,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=2,
                 enable_async_checkpointing=True,
-                best_fn=lambda m: m["best_acc1"],
-                best_mode="max",
             ),
         )
         _orbax_managers[path] = mgr

@@ -1,0 +1,21 @@
+"""Shared timing helper for the profiling scripts.
+
+Sync discipline on this platform: fetch a SCALAR value — on the tunneled
+axon backend ``block_until_ready`` can return before the device queue
+drains, so ``float(out)`` (a value fetch) is the only reliable barrier.
+Benchmarked computations must therefore reduce to a scalar on-device.
+"""
+
+import time
+
+
+def timed_scalar(fn, *args, iters=5, warmup=2):
+    """Mean seconds/call of ``fn(*args)``, which must return a device scalar."""
+    for _ in range(warmup):
+        out = fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / iters

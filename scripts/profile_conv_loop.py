@@ -8,15 +8,7 @@ import jax
 import jax.numpy as jnp
 
 
-def timed_scalar(fn, *args, iters=5, warmup=2):
-    for _ in range(warmup):
-        out = fn(*args)
-    float(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    float(out)
-    return (time.perf_counter() - t0) / iters
+from benchlib import timed_scalar  # noqa: E402
 
 
 REPS = 20

@@ -11,15 +11,7 @@ import jax.numpy as jnp
 REPS = 10
 
 
-def timed_scalar(fn, *args, iters=5, warmup=2):
-    for _ in range(warmup):
-        out = fn(*args)
-    float(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    float(out)
-    return (time.perf_counter() - t0) / iters
+from benchlib import timed_scalar  # noqa: E402
 
 
 def conv1x1(x, w):

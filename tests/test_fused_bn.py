@@ -37,14 +37,12 @@ def test_forward_matches_flax(relu):
     yr, mr = ref.apply(vr, x, mutable=["batch_stats"])
     yf, mf = fused.apply(vf, x, mutable=["batch_stats"])
     np.testing.assert_allclose(np.asarray(yr), np.asarray(yf), atol=1e-5)
-    # EMA running-stats update parity
+    # EMA running-stats update parity: both mean and var
+    ref_stats = mr["batch_stats"]["BatchNorm_0"]
     for k in ("mean", "var"):
-        a = jax.tree_util.tree_leaves(
-            {kk: v for kk, v in mr["batch_stats"].items()} if False else mr["batch_stats"]
-        )
-    rm = np.asarray(jax.tree_util.tree_leaves(mr["batch_stats"])[0])
-    fm = np.asarray(jax.tree_util.tree_leaves(mf["batch_stats"])[0])
-    np.testing.assert_allclose(rm, fm, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(ref_stats[k]), np.asarray(mf["batch_stats"][k]),
+            atol=1e-5, err_msg=k)
 
 
 @pytest.mark.parametrize("relu", [False, True])
