@@ -1,0 +1,124 @@
+"""Pipeline-parallel TransformerLM: stage-stacked blocks + GPipe schedule.
+
+Integrates ``parallel/pp.py``'s microbatch pipeline into the LM family
+(round-1 gap: PP existed only over toy affine stages).  The ``n_layers``
+transformer blocks are split into ``n_stages`` equal stages; stage
+parameters are stacked on a leading ``pipe`` axis and sharded over the
+``pipe`` mesh axis, while activations stream through the GPipe schedule
+(``pipeline_apply``: shard_map + ppermute + scan — compiled once,
+differentiable, synchronous semantics).  Composes with data parallelism
+over a ``("data", "pipe")`` mesh.
+
+Duck-typed to the flax ``init``/``apply`` surface ``LMTrainer`` and
+``make_lm_train_step`` consume, but functional underneath: the pipeline
+schedule needs raw per-stage parameter slices, which a lifted flax
+transform cannot hand to ``shard_map`` cleanly.
+
+Beyond-reference capability (SURVEY.md §2.3 "Explicitly absent": pipeline
+parallelism)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_tpu.models.transformer import Block
+from pytorch_distributed_tpu.parallel.pp import pipeline_apply
+
+
+class _Stage(nn.Module):
+    """One pipeline stage: ``n_blocks`` sequential transformer blocks."""
+
+    n_blocks: int
+    n_heads: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.n_blocks):
+            x = Block(self.n_heads, self.dtype, name=f"block_{i}")(x)
+        return x
+
+
+class PipelinedTransformerLM:
+    """``init(rng, tokens) -> {"params": ...}``;
+    ``apply({"params": ...}, tokens[, mutable]) -> logits`` — the LM-harness
+    model surface, with the forward running the GPipe schedule."""
+
+    def __init__(
+        self,
+        vocab_size: int,
+        d_model: int,
+        n_heads: int,
+        n_layers: int,
+        n_stages: int,
+        n_microbatches: int,
+        mesh: Mesh,
+        dtype: Any = jnp.float32,
+        pipe_axis: str = "pipe",
+    ):
+        if n_layers % n_stages:
+            raise ValueError(
+                f"n_layers {n_layers} not divisible by n_stages {n_stages}"
+            )
+        if dict(mesh.shape).get(pipe_axis) != n_stages:
+            raise ValueError(
+                f"mesh '{pipe_axis}' axis {dict(mesh.shape).get(pipe_axis)} "
+                f"!= n_stages {n_stages}"
+            )
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.mesh = mesh
+        self.dtype = dtype
+        self.pipe_axis = pipe_axis
+        self._embed = nn.Embed(vocab_size, d_model, dtype=dtype, name="embed")
+        self._ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
+        self._stage = _Stage(
+            n_blocks=n_layers // n_stages, n_heads=n_heads, dtype=dtype
+        )
+
+    # ------------------------------------------------------------ flax-like
+    def init(self, rng, tokens: jnp.ndarray):
+        r_embed, r_stage, r_ln = jax.random.split(rng, 3)
+        embed_p = self._embed.init(r_embed, tokens)["params"]
+        x0 = jnp.zeros(tokens.shape + (self.d_model,), self.dtype)
+        stage_p = jax.vmap(
+            lambda r: self._stage.init(r, x0)["params"]
+        )(jax.random.split(r_stage, self.n_stages))
+        ln_p = self._ln_f.init(r_ln, x0.astype(jnp.float32))["params"]
+        return {"params": {"embed": embed_p, "stages": stage_p, "ln_f": ln_p}}
+
+    def apply(self, variables, tokens: jnp.ndarray, mutable=None,
+              train: bool = True):
+        p = variables["params"]
+        x = self._embed.apply({"params": p["embed"]}, tokens)
+        x = pipeline_apply(
+            lambda sp, xb: self._stage.apply({"params": sp}, xb),
+            p["stages"], x, self.n_microbatches, self.mesh,
+            pipe_axis=self.pipe_axis,
+        )
+        x = self._ln_f.apply({"params": p["ln_f"]}, x.astype(jnp.float32))
+        logits = self._embed.apply(
+            {"params": p["embed"]}, x.astype(jnp.float32),
+            method=nn.Embed.attend,
+        ).astype(jnp.float32)
+        return (logits, {}) if mutable is not None else logits
+
+
+def pp_specs(params, pipe_axis: str = "pipe"):
+    """PartitionSpec tree for ``PipelinedTransformerLM`` params: the stacked
+    stage tree sharded on its leading (stage) axis, embed/ln replicated."""
+
+    def spec(path, leaf):
+        names = [getattr(k, "key", str(k)) for k in path]
+        if names and names[0] == "stages":
+            return P(pipe_axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

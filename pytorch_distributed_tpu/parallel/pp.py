@@ -32,6 +32,7 @@ def pipeline_apply(
     n_microbatches: int,
     mesh: Mesh,
     pipe_axis: str = "pipe",
+    data_axis: str = "data",
 ) -> jnp.ndarray:
     """Run ``x`` through ``n_stages`` of ``stage_fn`` as a GPipe pipeline.
 
@@ -39,6 +40,10 @@ def pipeline_apply(
     - ``x``: [B, ...] activations entering stage 0; ``n_microbatches`` must
       divide ``B``.
     Returns the activations after the final stage, same shape as ``x``.
+
+    Composes with data parallelism: when the mesh has ``data_axis``, the
+    microbatch batch dim stays sharded over it (each data-parallel replica
+    runs its own pipeline; activations hop only along ``pipe_axis``).
     """
     n_stages = mesh.shape[pipe_axis]
     B = x.shape[0]
@@ -82,11 +87,15 @@ def pipeline_apply(
         result = result.at[jnp.clip(out_idxs, 0, n_microbatches - 1)].add(outs)
         return jax.lax.psum(result, pipe_axis)
 
+    # micro is [M, mb, ...]: shard the per-microbatch batch dim over data.
+    micro_spec = (
+        P(None, data_axis) if data_axis in mesh.axis_names else P()
+    )
     sharded = jax.shard_map(
         per_stage,
         mesh=mesh,
-        in_specs=(P(pipe_axis), P()),   # params sharded by stage; micro replicated
-        out_specs=P(),
+        in_specs=(P(pipe_axis), micro_spec),  # params sharded by stage
+        out_specs=micro_spec,
         check_vma=False,
     )(stage_params, micro)
     return sharded.reshape(x.shape)

@@ -111,11 +111,18 @@ def ring_self_attention(
     causal: bool = True,
     seq_axis: str = "seq",
     data_axis: Optional[str] = "data",
+    model_axis: Optional[str] = "model",
 ) -> jnp.ndarray:
     """``shard_map`` wrapper: global ``[B, L, H, D]`` in, same out, with L
-    sharded over ``seq_axis`` (and B over ``data_axis`` if present)."""
+    sharded over ``seq_axis`` (B over ``data_axis``, and — composing with
+    Megatron TP — heads over ``model_axis`` when the mesh has one; attention
+    is independent per head, so the ring math is untouched and the
+    TP-sharded qkv activations enter without an all-gather)."""
     batch_spec = data_axis if data_axis in mesh.axis_names else None
-    spec = P(batch_spec, seq_axis, None, None)
+    head_spec = (
+        model_axis if model_axis and model_axis in mesh.axis_names else None
+    )
+    spec = P(batch_spec, seq_axis, head_spec, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

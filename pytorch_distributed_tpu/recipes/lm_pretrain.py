@@ -1,19 +1,27 @@
-"""Recipe 8 — long-context LM pretraining over dp × (tp | sp) meshes.
+"""Recipe 8 — long-context LM pretraining over composable dp×sp×tp (or
+dp×pp, dp×ep) meshes.
 
 Beyond-reference recipe (the reference is image-only): next-token training
 of the TransformerLM with the framework's parallelism menu —
 
 - ``--tp N``  tensor parallelism (Megatron-style sharded qkv/proj/fc1/fc2 +
   vocab-sharded embedding; XLA inserts the per-block all-reduces)
-- ``--sp N``  sequence parallelism (ring attention over the ``seq`` axis)
+- ``--sp N``  sequence parallelism (ring attention over the ``seq`` axis);
+  **composes with --tp**: one ``(data, seq, model)`` mesh, heads sharded
+  over ``model`` inside the ring
+- ``--pp N``  pipeline parallelism (GPipe stages over ``pipe``; composes
+  with the data axis)
+- ``--ep N``  expert parallelism (MoE model variant; exclusive)
 - remaining devices form the ``data`` axis (gradient psum)
 
 Examples (8 simulated chips):
 
     python -m pytorch_distributed_tpu.recipes.lm_pretrain --tp 4 \
         --d-model 512 --n-layers 4 --seq-len 512 -b 16 --steps 50
-    python -m pytorch_distributed_tpu.recipes.lm_pretrain --sp 4 \
+    python -m pytorch_distributed_tpu.recipes.lm_pretrain --sp 2 --tp 2 \
         --seq-len 8192 -b 8 --steps 20
+    python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 4 \
+        --n-layers 8 -b 16 --steps 20
 """
 
 from __future__ import annotations
@@ -45,6 +53,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel (ring) size")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel size (MoE MLPs, one expert/device)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel size (GPipe stages over a 'pipe' "
+                        "mesh axis; composes with the data axis)")
+    p.add_argument("--microbatches", type=int, default=0,
+                   help="pipeline microbatches (default: pp)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
@@ -63,10 +76,33 @@ def main(argv=None) -> float:
     args = build_parser().parse_args(argv)
     ctx = initialize()
     n = jax.device_count()
-    if sum(x > 1 for x in (args.tp, args.sp, args.ep)) > 1:
-        raise SystemExit("--tp/--sp/--ep cannot be combined yet (use one)")
-    if n % (args.tp * args.sp * args.ep):
-        raise SystemExit(f"{n} devices not divisible by tp*sp*ep")
+    if args.ep > 1 and (args.tp > 1 or args.sp > 1 or args.pp > 1):
+        raise SystemExit("--ep is exclusive (MoE model variant); "
+                         "--tp and --sp compose freely, --pp with dp")
+    if args.pp > 1 and (args.tp > 1 or args.sp > 1):
+        raise SystemExit("--pp composes with the data axis only (dp x pp); "
+                         "tp/sp inside a pipeline stage is future work")
+    if n % (args.tp * args.sp * args.ep * args.pp):
+        raise SystemExit(f"{n} devices not divisible by tp*sp*ep*pp")
+    if args.pp > 1 and args.n_layers % args.pp:
+        raise SystemExit(f"--n-layers {args.n_layers} not divisible by "
+                         f"--pp {args.pp} stages")
+    if args.pp > 1:
+        micro = args.microbatches or args.pp
+        pp_dp = n // args.pp
+        if args.batch_size % micro:
+            raise SystemExit(f"-b {args.batch_size} not divisible by "
+                             f"{micro} pipeline microbatches")
+        if (args.batch_size // micro) % pp_dp:
+            raise SystemExit(
+                f"per-microbatch batch {args.batch_size // micro} not "
+                f"divisible by the data axis ({pp_dp} replicas)")
+    if args.tp > 1 and args.sp > 1 and args.n_heads % args.tp:
+        # Composed with ring SP the attention heads are explicitly sharded
+        # over 'model' (ring.py shard_map specs); pure GSPMD TP has no such
+        # constraint.
+        raise SystemExit(f"--n-heads {args.n_heads} not divisible by "
+                         f"--tp {args.tp} (required when combined with --sp)")
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
 
     if args.ep > 1:
@@ -76,20 +112,36 @@ def main(argv=None) -> float:
             n_layers=args.n_layers, dtype=dtype, moe_experts=args.ep,
         )
         specs = "ep"
-    elif args.sp > 1:
-        mesh = build_mesh(MeshSpec(("data", "seq"), (n // args.sp, args.sp)))
-        model = TransformerLM(
-            vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
-            n_layers=args.n_layers, dtype=dtype, mesh=mesh, ring=True,
+    elif args.pp > 1:
+        from pytorch_distributed_tpu.models.pipeline_lm import (
+            PipelinedTransformerLM,
         )
-        specs = None  # params replicated; sequence axis carries the sharding
+
+        mesh = build_mesh(MeshSpec(("data", "pipe"), (n // args.pp, args.pp)))
+        model = PipelinedTransformerLM(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=args.n_layers,
+            n_stages=args.pp,
+            n_microbatches=args.microbatches or args.pp,
+            mesh=mesh, dtype=dtype,
+        )
+        specs = "pp"
     else:
-        axes = ("data", "model") if args.tp > 1 else ("data",)
-        shape = (n // args.tp, args.tp) if args.tp > 1 else (n,)
-        mesh = build_mesh(MeshSpec(axes, shape))
+        # Composable dp × sp × tp mesh: the data axis takes the remaining
+        # devices; 'model' is innermost so Megatron's per-block all-reduces
+        # ride the fastest ICI hops (parallel/mesh.py note).
+        axes, shape = ["data"], [n // (args.tp * args.sp)]
+        if args.sp > 1:
+            axes.append("seq")
+            shape.append(args.sp)
+        if args.tp > 1:
+            axes.append("model")
+            shape.append(args.tp)
+        mesh = build_mesh(MeshSpec(tuple(axes), tuple(shape)))
         model = TransformerLM(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, dtype=dtype,
+            mesh=mesh if args.sp > 1 else None, ring=args.sp > 1,
         )
         specs = "tp" if args.tp > 1 else None
 
@@ -97,13 +149,20 @@ def main(argv=None) -> float:
         args.dataset_length, args.seq_len, args.vocab, seed=args.seed
     )
     with mesh:
-        tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
-        if specs in ("tp", "ep"):
+        # Init batch must cover the data axis (the ring shard_map divides the
+        # batch dim during init tracing too).
+        tokens0 = jnp.zeros((dict(mesh.shape).get("data", 1), args.seq_len),
+                            jnp.int32)
+        if specs in ("tp", "ep", "pp"):
             params_shape = jax.eval_shape(
                 lambda: model.init(jax.random.PRNGKey(args.seed), tokens0)
             )["params"]
             if specs == "tp":
                 specs = tp_specs(params_shape)
+            elif specs == "pp":
+                from pytorch_distributed_tpu.models.pipeline_lm import pp_specs
+
+                specs = pp_specs(params_shape)
             else:
                 from pytorch_distributed_tpu.models.moe import moe_specs
 

@@ -107,8 +107,15 @@ def test_lm_pretrain_recipe_learns(kind, tmp_path, capsys):
     assert (tmp_path / "checkpoint.msgpack").exists()
 
 
-def test_lm_pretrain_rejects_tp_plus_sp():
+def test_lm_pretrain_rejects_ep_combined():
     from pytorch_distributed_tpu.recipes import lm_pretrain
 
     with pytest.raises(SystemExit):
-        lm_pretrain.main(["--tp", "2", "--sp", "2"])
+        lm_pretrain.main(["--ep", "2", "--tp", "2"])
+
+
+def test_lm_pretrain_rejects_indivisible_heads():
+    from pytorch_distributed_tpu.recipes import lm_pretrain
+
+    with pytest.raises(SystemExit):
+        lm_pretrain.main(["--tp", "4", "--n-heads", "2", "--sp", "2"])
