@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput: what can the host actually feed?
+
+Round-1 gap (VERDICT "What's missing" #3): the train-step bench excludes
+host IO, and nothing measured whether the loader can sustain chip feed
+rates (~2,500 img/s for ResNet-50 bf16 on one v5e chip).  This bench
+generates an ImageNet-shaped synthetic JPEG ImageFolder (real JPEG decode
+work) and measures ``DataLoader`` throughput in every wire mode, both
+decode backends.
+
+Writes ``RESULTS_loader.json`` at the repo root and prints one line per
+mode.  Pure host work — runs anywhere:
+
+    PYTHONPATH=/root/repo python experiments/loader_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_IMAGES = int(os.environ.get("LOADER_BENCH_IMAGES", "512"))
+SRC = int(os.environ.get("LOADER_BENCH_SRC", "320"))  # source jpeg size
+BATCH = 64
+IMAGE = 224
+
+
+def make_tree(root: str, n: int) -> None:
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    per = n // 4
+    for c in range(4):
+        d = os.path.join(root, "train", f"c{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per):
+            arr = rng.integers(0, 256, size=(SRC, SRC, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i:04d}.jpg"),
+                                      quality=85)
+
+
+def bench_mode(root: str, batch_mode: str, transform_kind: str,
+               workers: int) -> float:
+    from pytorch_distributed_tpu.data import DataLoader, ImageFolder
+    from pytorch_distributed_tpu.data import transforms as T
+
+    if transform_kind == "f32":
+        tf = T.train_transform(IMAGE)
+    elif transform_kind == "u8":
+        tf = T.train_transform_u8(IMAGE)
+    else:
+        tf = None  # native decode path supplies its own
+    ds = ImageFolder(os.path.join(root, "train"), transform=tf,
+                     native_decode=transform_kind == "native",
+                     image_size=IMAGE)
+    loader = DataLoader(ds, BATCH, num_workers=workers, drop_last=True,
+                        batch_mode=batch_mode,
+                        random_flip=batch_mode != "f32")
+    # warm one epoch fragment, then time a full pass
+    it = iter(loader)
+    next(it)
+    t0 = time.perf_counter()
+    n = 0
+    for batch in loader:
+        n += int(batch["weights"].sum())
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main() -> int:
+    import tempfile
+
+    workers = int(os.environ.get("LOADER_BENCH_WORKERS",
+                                 str(os.cpu_count() or 2)))
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        make_tree(tmp, N_IMAGES)
+        for name, mode, kind in (
+            ("pil_f32", "f32", "f32"),
+            ("pil_u8_host_native_norm", "u8_host", "u8"),
+            ("pil_u8_wire", "u8_wire", "u8"),
+            ("native_decode_u8_host", "u8_host", "native"),
+            ("native_decode_u8_wire", "u8_wire", "native"),
+        ):
+            try:
+                rate = bench_mode(tmp, mode, kind, workers)
+            except Exception as e:  # modes may be unavailable (no .so)
+                print(f"{name}: SKIP ({e})")
+                continue
+            results[name] = round(rate, 1)
+            print(f"{name}: {rate:,.0f} img/s ({workers} workers)", flush=True)
+
+    out = {
+        "meta": {
+            "images": N_IMAGES, "src_px": SRC, "out_px": IMAGE,
+            "batch": BATCH, "workers": workers,
+            "cpus": os.cpu_count(),
+            "note": "synthetic ImageNet-shaped JPEGs; feed target is "
+                    "~2500 img/s/chip (ResNet-50 bf16, BENCH_r01)",
+        },
+        "img_per_sec": results,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "..", "RESULTS_loader.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

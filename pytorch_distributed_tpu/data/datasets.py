@@ -22,9 +22,45 @@ IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp")
 
 
 class ImageFolder:
-    def __init__(self, root: str, transform: Optional[Callable] = None):
+    def __init__(
+        self,
+        root: str,
+        transform: Optional[Callable] = None,
+        native_decode: bool = False,
+        image_size: int = 224,
+        native_augment: bool = True,
+    ):
+        """``native_decode=True``: samples come back as raw JPEG bytes plus
+        crop-draw parameters, and the loader decodes the whole batch in the
+        C++ data plane (libjpeg + DCT-scaled crop/resize) — the expensive
+        half of the input pipeline off Python (round-1 left only
+        normalize/flip native).  Train augmentation (``native_augment``) is
+        single-attempt RandomResizedCrop (torchvision draws with clamping
+        instead of 10-attempt rejection — documented delta); eval is
+        short-side-256/224·size + center crop.  Non-JPEG files fall back to
+        the PIL u8 transform per sample."""
         self.root = root
         self.transform = transform
+        self.native_decode = native_decode
+        self.image_size = image_size
+        self.native_augment = native_augment
+        if native_decode:
+            from pytorch_distributed_tpu.data.transforms import (
+                eval_transform_u8,
+                train_transform_u8,
+            )
+
+            # flip lives at the batch level (loader random_flip); the u8
+            # stacks are already flip-free.  Eval resize scales with the
+            # output size (256/224 ratio), matching the native JPEG path so
+            # mixed JPEG/PNG val sets get one consistent preprocessing.
+            self._fallback_tf = (
+                train_transform_u8(image_size)
+                if native_augment
+                else eval_transform_u8(
+                    image_size, resize=int(image_size * 256 / 224)
+                )
+            )
         classes = sorted(
             d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
         )
@@ -43,7 +79,7 @@ class ImageFolder:
     def __len__(self) -> int:
         return len(self.samples)
 
-    def get(self, index: int, rng: Optional[np.random.Generator] = None) -> Tuple[np.ndarray, int]:
+    def get(self, index: int, rng: Optional[np.random.Generator] = None):
         """Fetch with an explicit augmentation RNG; the loader passes a
         ``(seed, epoch, index)``-keyed generator so augmentations differ per
         epoch yet stay reproducible."""
@@ -52,6 +88,23 @@ class ImageFolder:
         path, label = self.samples[index]
         if rng is None:
             rng = np.random.default_rng(index)
+        if self.native_decode:
+            if path.lower().endswith((".jpg", ".jpeg")):
+                with open(path, "rb") as f:
+                    blob = f.read()
+                if self.native_augment:
+                    params = np.array(
+                        [rng.uniform(0.08, 1.0),
+                         rng.uniform(np.log(3 / 4), np.log(4 / 3)),
+                         rng.random(), rng.random()],
+                        np.float32,
+                    )
+                else:
+                    params = None
+                return ("jpeg", blob, params, label)
+            with Image.open(path) as im:
+                arr = np.asarray(self._fallback_tf(rng, im.convert("RGB")))
+            return ("u8", arr, None, label)
         with Image.open(path) as im:
             img = im.convert("RGB")
             if self.transform is not None:

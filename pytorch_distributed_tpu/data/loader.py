@@ -88,6 +88,51 @@ class DataLoader:
             return self.dataset[int(index)]
         return None  # padding slot
 
+    def _assemble_native(self, samples):
+        """Batch the ("jpeg", blob, params, label) / ("u8", arr, None, label)
+        samples of a native_decode dataset: one C++ call decodes, crops and
+        resizes every JPEG in the batch (libjpeg, multithreaded, GIL-free).
+
+        Returns ``(images, labels, dead)`` — ``dead`` lists batch slots whose
+        JPEG failed to decode; the caller zeroes their weights so corrupt
+        files drop out of loss/metrics instead of training as black images."""
+        from pytorch_distributed_tpu.data.native import decode_crop_resize_batch
+
+        size = self.dataset.image_size
+        images = np.zeros((self.batch_size, size, size, 3), np.uint8)
+        labels = np.zeros(self.batch_size, dtype=np.int32)
+        blobs, params, slots = [], [], []
+        dead: list = []
+        for i, s in enumerate(samples):
+            if s is None:
+                continue
+            kind, payload, p, label = s
+            labels[i] = label
+            if kind == "jpeg":
+                slots.append(i)
+                blobs.append(payload)
+                params.append(p)
+            else:
+                images[i] = payload
+        if blobs:
+            params_arr = (
+                np.stack(params) if params[0] is not None else None
+            )
+            decoded, failed = decode_crop_resize_batch(
+                blobs, size, params=params_arr, return_failed=True
+            )
+            images[slots] = decoded
+            if failed.any():
+                dead = [slots[j] for j in np.nonzero(failed)[0]]
+                import warnings
+
+                warnings.warn(
+                    f"{len(dead)} corrupt JPEG(s) in batch — samples masked "
+                    f"out of loss/metrics",
+                    stacklevel=2,
+                )
+        return images, labels, dead
+
     def __iter__(self) -> Iterator[Batch]:
         indices, valid = self.sampler.shard()
         nb = len(self)
@@ -102,19 +147,35 @@ class DataLoader:
                     idx = np.concatenate([idx, np.zeros(pad, dtype=idx.dtype)])
                     val = np.concatenate([val, np.zeros(pad, dtype=val.dtype)])
                 samples = list(pool.map(self._fetch, idx, val))
-                proto = next(s for s in samples if s is not None)
-                img_dtype = np.uint8 if self.batch_mode != "f32" else np.float32
-                if self.batch_mode != "f32" and proto[0].dtype != np.uint8:
-                    raise TypeError(
-                        f"batch_mode {self.batch_mode!r} needs uint8 samples "
-                        f"(use the *_transform_u8 stacks), got {proto[0].dtype}"
+                if getattr(self.dataset, "native_decode", False):
+                    if self.batch_mode == "f32":
+                        raise TypeError(
+                            "native_decode datasets produce uint8 batches; "
+                            "use batch_mode 'u8_host' or 'u8_wire'"
+                        )
+                    images, labels, dead = self._assemble_native(samples)
+                    if dead:
+                        val = val.copy()
+                        val[dead] = 0
+                else:
+                    proto = next(s for s in samples if s is not None)
+                    img_dtype = (
+                        np.uint8 if self.batch_mode != "f32" else np.float32
                     )
-                images = np.zeros((self.batch_size,) + proto[0].shape, dtype=img_dtype)
-                labels = np.zeros(self.batch_size, dtype=np.int32)
-                for i, s in enumerate(samples):
-                    if s is not None:
-                        images[i] = s[0]
-                        labels[i] = s[1]
+                    if self.batch_mode != "f32" and proto[0].dtype != np.uint8:
+                        raise TypeError(
+                            f"batch_mode {self.batch_mode!r} needs uint8 "
+                            f"samples (use the *_transform_u8 stacks), got "
+                            f"{proto[0].dtype}"
+                        )
+                    images = np.zeros(
+                        (self.batch_size,) + proto[0].shape, dtype=img_dtype
+                    )
+                    labels = np.zeros(self.batch_size, dtype=np.int32)
+                    for i, s in enumerate(samples):
+                        if s is not None:
+                            images[i] = s[0]
+                            labels[i] = s[1]
                 if self.batch_mode != "f32":
                     flip_rng = np.random.default_rng(
                         (self.seed, self.sampler.epoch, b, 1)

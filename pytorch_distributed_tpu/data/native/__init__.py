@@ -1,8 +1,15 @@
-"""Native (C++) host-side data plane.  See native.py for the ctypes binding."""
+"""Native (C++) host data plane — see ptd_data.cpp / binding.py."""
 
 from pytorch_distributed_tpu.data.native.binding import (
+    decode_crop_resize_batch,
+    jpeg_native_available,
     native_available,
     normalize_batch,
 )
 
-__all__ = ["native_available", "normalize_batch"]
+__all__ = [
+    "decode_crop_resize_batch",
+    "jpeg_native_available",
+    "native_available",
+    "normalize_batch",
+]

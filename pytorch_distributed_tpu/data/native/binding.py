@@ -24,15 +24,32 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+_ABI = 3
+_ABI_SIDECAR = _LIB_PATH + ".abi"
+
+
 def _build() -> bool:
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-        "-o", _LIB_PATH, _SRC, "-lpthread",
-    ]
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", _LIB_PATH, _SRC, "-lpthread"]
+    for cmd in (base + ["-ljpeg"], base + ["-DPTD_NO_JPEG"]):
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            # ABI sidecar lets _load verify the artifact WITHOUT dlopening:
+            # dlopen dedupes by pathname, so a rebuild after a bad in-process
+            # load could never take effect (round-2 review finding).
+            with open(_ABI_SIDECAR, "w") as f:
+                f.write(str(_ABI))
+            return True
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return False
+
+
+def _sidecar_ok() -> bool:
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (OSError, subprocess.SubprocessError):
+        with open(_ABI_SIDECAR) as f:
+            return int(f.read().strip()) == _ABI
+    except (OSError, ValueError):
         return False
 
 
@@ -42,26 +59,39 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) or os.path.getmtime(
-            _LIB_PATH
-        ) < os.path.getmtime(_SRC):
-            if not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
+        stale = (
+            not os.path.exists(_LIB_PATH)
+            or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+            or not _sidecar_ok()
+        )
+        if stale and not _build():
             return None
-        lib.ptd_normalize_batch.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
-        ]
-        lib.ptd_normalize_batch.restype = None
-        lib.ptd_data_abi_version.restype = ctypes.c_int
-        if lib.ptd_data_abi_version() != 1:
-            return None
+        lib = _open()
+        if lib is not None and lib.ptd_data_abi_version() != _ABI:
+            lib = None  # sidecar lied (hand-copied .so); disable
         _lib = lib
         return _lib
+
+
+def _open() -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.ptd_normalize_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.ptd_normalize_batch.restype = None
+    lib.ptd_decode_crop_resize_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.ptd_decode_crop_resize_batch.restype = ctypes.c_int
+    lib.ptd_data_abi_version.restype = ctypes.c_int
+    return lib
 
 
 def native_available() -> bool:
@@ -106,3 +136,59 @@ def normalize_batch(
         idx = np.nonzero(flip)[0]
         imgs[idx] = imgs[idx, :, ::-1, :]
     return (imgs - mean) / std
+
+
+def jpeg_native_available() -> bool:
+    """True when the library is loaded AND was built against libjpeg."""
+    lib = _load()
+    if lib is None:
+        return False
+    # A PTD_NO_JPEG build returns -1 unconditionally; probe with n=0.
+    empty = np.zeros(1, np.int64)
+    return lib.ptd_decode_crop_resize_batch(
+        None, empty.ctypes.data, 0, None, 1, 1, 1, None, None, 1) == 0
+
+
+def decode_crop_resize_batch(
+    blobs,
+    out_size: int,
+    params: Optional[np.ndarray] = None,
+    resize_short: int = 0,
+    n_threads: int = 0,
+    return_failed: bool = False,
+):
+    """Batch JPEG decode + crop + bilinear resize → uint8 [n, S, S, 3].
+
+    ``blobs``: list of JPEG byte strings.  ``params``: [n, 4] float32 train
+    crop draws (area_frac, log_ratio, u, v) for single-attempt
+    RandomResizedCrop semantics; None = eval (short-side ``resize_short`` +
+    center crop).  Corrupt blobs come back as zeroed slots; pass
+    ``return_failed=True`` to also get the per-image failure mask (the
+    loader uses it to zero those samples' weights so they drop out of
+    loss/metrics instead of training on black images).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native data plane unavailable (no compiler?)")
+    n = len(blobs)
+    offsets = np.zeros(n + 1, np.int64)
+    for i, b in enumerate(blobs):
+        offsets[i + 1] = offsets[i] + len(b)
+    concat = np.frombuffer(b"".join(blobs), dtype=np.uint8) if n else np.zeros(0, np.uint8)
+    out = np.empty((n, out_size, out_size, 3), np.uint8)
+    failed = np.zeros(n, np.uint8)
+    p = None
+    if params is not None:
+        p = np.ascontiguousarray(params, dtype=np.float32)
+        assert p.shape == (n, 4)
+    rc = lib.ptd_decode_crop_resize_batch(
+        concat.ctypes.data if n else None,
+        offsets.ctypes.data, n,
+        p.ctypes.data if p is not None else None,
+        out_size, out_size,
+        resize_short or int(out_size * 256 / 224),
+        out.ctypes.data, failed.ctypes.data, n_threads,
+    )
+    if rc < 0:
+        raise RuntimeError("native library built without libjpeg")
+    return (out, failed.astype(bool)) if return_failed else out

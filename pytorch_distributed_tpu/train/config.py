@@ -112,11 +112,13 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="accepted for reference launch-line parity "
                    "(distributed.py:73-76); process identity on TPU comes "
                    "from PTD_TPU_PROCESS_ID / pod metadata instead")
-    p.add_argument("--wire", default=d.wire, choices=("f32", "u8host", "u8"),
+    p.add_argument("--wire", default=d.wire,
+                   choices=("f32", "u8host", "u8", "native"),
                    help="input pipeline format: f32 = per-sample normalize "
                    "(reference-shaped); u8host = native C++ batch "
                    "flip+normalize; u8 = uint8 over the wire, normalize on "
-                   "device (4x fewer host->device bytes)")
+                   "device (4x fewer host->device bytes); native = C++ JPEG "
+                   "decode+crop+resize AND uint8 wire (full native path)")
     p.add_argument("--resume", default=d.resume, type=str, metavar="PATH",
                    help="path to checkpoint to resume from")
     p.add_argument("--checkpoint-dir", default=d.checkpoint_dir, type=str,

@@ -171,6 +171,28 @@ class Trainer:
                 transform=None,
                 seed=seed + 1,
             )
+        elif cfg.wire == "native":
+            # Full native host path: C++ JPEG decode + crop/resize, batch
+            # flip host-side, uint8 across the wire, normalize on device.
+            from pytorch_distributed_tpu.data.native import (
+                jpeg_native_available,
+            )
+
+            if not jpeg_native_available():
+                raise RuntimeError(
+                    "--wire native needs the C++ data plane built against "
+                    "libjpeg (g++ and libjpeg-dev); use --wire u8 or u8host "
+                    "on this host"
+                )
+            self.train_set = ImageFolder(
+                f"{cfg.data}/train", native_decode=True,
+                image_size=cfg.image_size, native_augment=True,
+            )
+            self.val_set = ImageFolder(
+                f"{cfg.data}/val", native_decode=True,
+                image_size=cfg.image_size, native_augment=False,
+            )
+            cfg.num_classes = len(self.train_set.classes)
         else:
             if cfg.wire == "f32":
                 ttf, vtf = train_transform(cfg.image_size), eval_transform(cfg.image_size)
@@ -197,7 +219,8 @@ class Trainer:
         # Eval keeps padding + masks so metrics stay exact (SURVEY §7.4 it.3).
         # Synthetic datasets emit f32 directly; wire modes apply to the
         # ImageFolder (u8-transform) path.
-        batch_mode = {"f32": "f32", "u8host": "u8_host", "u8": "u8_wire"}[cfg.wire]
+        batch_mode = {"f32": "f32", "u8host": "u8_host", "u8": "u8_wire",
+                      "native": "u8_wire"}[cfg.wire]
         if cfg.synthetic:
             batch_mode = "f32"
         self.train_loader = DataLoader(

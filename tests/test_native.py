@@ -54,3 +54,162 @@ def test_fallback_path_same_semantics(monkeypatch):
     monkeypatch.setattr(binding, "_load", lambda: None)
     slow = normalize_batch(u8, MEAN, STD, flip=flip)
     np.testing.assert_allclose(fast, slow, rtol=1e-6, atol=1e-6)
+
+
+# ------------------------------------------------------- native JPEG decode
+
+def _jpeg_bytes(arr):
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def test_decode_eval_matches_pil_resize_centercrop():
+    """Eval semantics: short-side resize + center crop ≈ the PIL u8 stack
+    (different bilinear kernels ⇒ tolerance, not equality)."""
+    from pytorch_distributed_tpu.data.native import (
+        decode_crop_resize_batch,
+        jpeg_native_available,
+    )
+
+    if not jpeg_native_available():
+        import pytest
+
+        pytest.skip("libjpeg not available")
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    # smooth image: decode/resample differences stay small
+    base = rng.normal(0.5, 0.2, size=(13, 17))
+    src = np.clip(
+        np.kron(base, np.ones((24, 24)))[None].repeat(3, 0).transpose(1, 2, 0),
+        0, 1)
+    src = (src * 255).astype(np.uint8)[:280, :360]
+    blob = _jpeg_bytes(src)
+    out = decode_crop_resize_batch([blob], 224, params=None)[0]
+    assert out.shape == (224, 224, 3) and out.dtype == np.uint8
+
+    with Image.open(__import__("io").BytesIO(blob)) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        scale = 256 / min(w, h)
+        im = im.resize((round(w * scale), round(h * scale)), Image.BILINEAR)
+        left = (im.width - 224) // 2
+        top = (im.height - 224) // 2
+        ref = np.asarray(im.crop((left, top, left + 224, top + 224)))
+    diff = np.abs(out.astype(np.float32) - ref.astype(np.float32))
+    assert diff.mean() < 4.0, diff.mean()
+
+
+def test_decode_train_params_deterministic_and_full_area():
+    from pytorch_distributed_tpu.data.native import (
+        decode_crop_resize_batch,
+        jpeg_native_available,
+    )
+
+    if not jpeg_native_available():
+        import pytest
+
+        pytest.skip("libjpeg not available")
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 256, size=(160, 200, 3), dtype=np.uint8)
+    blob = _jpeg_bytes(src)
+    # area_frac=1, ratio=0 -> full-ish crop; u,v irrelevant
+    params = np.array([[1.0, 0.0, 0.3, 0.7]], np.float32)
+    a = decode_crop_resize_batch([blob], 96, params=params)
+    b = decode_crop_resize_batch([blob], 96, params=params)
+    np.testing.assert_array_equal(a, b)
+    # different draw -> different crop
+    params2 = np.array([[0.2, 0.1, 0.1, 0.1]], np.float32)
+    c = decode_crop_resize_batch([blob], 96, params=params2)
+    assert np.abs(a.astype(int) - c.astype(int)).mean() > 1.0
+
+
+def test_decode_corrupt_blob_zeroed():
+    from pytorch_distributed_tpu.data.native import (
+        decode_crop_resize_batch,
+        jpeg_native_available,
+    )
+
+    if not jpeg_native_available():
+        import pytest
+
+        pytest.skip("libjpeg not available")
+    rng = np.random.default_rng(2)
+    good = _jpeg_bytes(rng.integers(0, 256, size=(64, 64, 3), dtype=np.uint8))
+    out = decode_crop_resize_batch([good, b"not a jpeg"], 32, params=None)
+    assert out[0].any()
+    assert not out[1].any()
+
+
+def test_native_loader_end_to_end(tmp_path):
+    """ImageFolder(native_decode) through DataLoader: u8 batches, flips,
+    padding mask — the --wire native path."""
+    from pytorch_distributed_tpu.data.native import jpeg_native_available
+
+    if not jpeg_native_available():
+        import pytest
+
+        pytest.skip("libjpeg not available")
+    from PIL import Image
+
+    from pytorch_distributed_tpu.data import DataLoader, ImageFolder
+
+    rng = np.random.default_rng(3)
+    for c in range(2):
+        d = tmp_path / "train" / f"c{c}"
+        d.mkdir(parents=True)
+        for i in range(5):
+            arr = rng.integers(0, 256, size=(90, 110, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.jpg")
+    ds = ImageFolder(str(tmp_path / "train"), native_decode=True,
+                     image_size=64, native_augment=True)
+    loader = DataLoader(ds, 4, num_workers=2, seed=0,
+                        batch_mode="u8_wire", random_flip=True)
+    batches = list(loader)
+    assert len(batches) == 3  # 10 samples / batch 4, padded tail
+    for b in batches:
+        assert b["images"].dtype == np.uint8
+        assert b["images"].shape == (4, 64, 64, 3)
+    assert batches[-1]["weights"].sum() == 2.0  # 10 = 4+4+2
+    # eval-mode dataset goes through the no-params path
+    ds_eval = ImageFolder(str(tmp_path / "train"), native_decode=True,
+                          image_size=64, native_augment=False)
+    b0 = next(iter(DataLoader(ds_eval, 4, num_workers=2,
+                              batch_mode="u8_host")))
+    assert b0["images"].dtype == np.float32  # u8_host normalizes on host
+
+
+def test_native_loader_masks_corrupt_files(tmp_path):
+    from pytorch_distributed_tpu.data.native import jpeg_native_available
+
+    if not jpeg_native_available():
+        import pytest
+
+        pytest.skip("libjpeg not available")
+    from PIL import Image
+
+    from pytorch_distributed_tpu.data import DataLoader, ImageFolder
+
+    rng = np.random.default_rng(5)
+    d = tmp_path / "train" / "c0"
+    d.mkdir(parents=True)
+    for i in range(3):
+        arr = rng.integers(0, 256, size=(70, 70, 3), dtype=np.uint8)
+        Image.fromarray(arr).save(d / f"{i}.jpg")
+    (d / "3.jpg").write_bytes(b"garbage not jpeg")
+    ds = ImageFolder(str(tmp_path / "train"), native_decode=True,
+                     image_size=32, native_augment=False)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        batches = list(DataLoader(ds, 4, num_workers=2,
+                                  batch_mode="u8_wire"))
+    assert len(batches) == 1
+    # 4 files, 1 corrupt -> 3 live weights
+    assert batches[0]["weights"].sum() == 3.0
