@@ -94,17 +94,25 @@ def main() -> int:
     import tempfile
 
     here = os.path.dirname(os.path.abspath(__file__))
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.abspath(os.path.join(here, "..",
+                                            "RESULTS_convergence.json"))
     with tempfile.TemporaryDirectory() as tmp:
         data_root = os.path.join(tmp, "data")
         make_dataset(data_root)
         results = {}
+        # accum=2: BATCH/2 microbatches stay divisible by the 8-shard mesh.
         for name, precision, accum in (
             ("fp32_accum1", "fp32", 1),
             ("bf16_accum1", "bf16", 1),
-            ("bf16_accum4", "bf16", 4),
+            ("bf16_accum2", "bf16", 2),
         ):
             print(f"=== {name} ===", flush=True)
             results[name] = run_config(data_root, precision, accum, tmp)
+            # Incremental write: a late-config failure must not lose the
+            # completed curves.
+            with open(out_path, "w") as f:
+                json.dump({"curves": results}, f, indent=1)
 
     meta = {
         "oracle": "per-epoch val top-1, sharded exact eval "
@@ -118,8 +126,7 @@ def main() -> int:
         "platform": os.environ.get("JAX_PLATFORMS", "device-default"),
     }
     out = {"meta": meta, "curves": results}
-    path = os.path.join(here, "..", "RESULTS_convergence.json")
-    with open(os.path.abspath(path), "w") as f:
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
 
     print(json.dumps(out, indent=1))

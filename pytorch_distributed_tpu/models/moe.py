@@ -1,7 +1,8 @@
 """Mixture-of-Experts MLP with expert parallelism over an ``expert`` mesh axis.
 
 Beyond-reference capability completing the framework's parallelism menu
-(dp / tp / sp / **ep**).  Switch-Transformer-style top-1 routing with a
+(dp / tp / sp / **ep**).  Switch-Transformer-style top-1 routing (or
+GShard/Mixtral-style top-k with renormalized gates, ``top_k > 1``) with a
 capacity limit, expressed as dense dispatch/combine einsums — the
 GSPMD-idiomatic formulation: expert parameters are stacked on a leading
 ``E`` axis and sharded ``P('expert', …)``; XLA lowers the dispatch einsum to
@@ -39,13 +40,17 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     aux_coef: float = 0.01
     dtype: Any = jnp.float32
+    # 1 = Switch (gate = raw top prob); >1 = GShard/Mixtral-style top-k with
+    # renormalized gates and sequential capacity (first choices queue first).
+    top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
         B, L, C = x.shape
         E = self.n_experts
         S = B * L
-        cap = max(1, int(self.capacity_factor * S / E))
+        k = min(self.top_k, E)
+        cap = max(1, int(self.capacity_factor * k * S / E))
         tokens = x.reshape(S, C)
 
         # Router runs in f32 (standard for stability).
@@ -53,26 +58,41 @@ class MoEMLP(nn.Module):
             tokens.astype(jnp.float32)
         )
         probs = jax.nn.softmax(logits, axis=-1)                  # [S, E]
-        expert_idx = jnp.argmax(probs, axis=-1)                  # [S]
-        gate = jnp.max(probs, axis=-1)                           # [S]
+        topk_probs, topk_idx = jax.lax.top_k(probs, k)           # [S, k]
+        if k == 1:
+            gates = topk_probs                                   # Switch
+        else:
+            gates = topk_probs / jnp.maximum(
+                topk_probs.sum(-1, keepdims=True), 1e-9
+            )
 
-        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [S, E]
-        # Position of each token within its expert's queue.
-        pos_in_expert = jnp.sum(
-            (jnp.cumsum(onehot, axis=0) - 1.0) * onehot, axis=-1
-        ).astype(jnp.int32)
-        keep = (pos_in_expert < cap).astype(jnp.float32)
+        # Dispatch/combine accumulated choice-by-choice: choice c's tokens
+        # take queue positions after all kept earlier-choice tokens (the
+        # priority ordering GShard prescribes).
+        dispatch = jnp.zeros((S, E, cap), jnp.float32)
+        combine = jnp.zeros((S, E, cap), jnp.float32)
+        counts = jnp.zeros((E,), jnp.float32)
+        for c in range(k):
+            onehot = jax.nn.one_hot(topk_idx[:, c], E, dtype=jnp.float32)
+            pos = (jnp.cumsum(onehot, axis=0) - 1.0) + counts[None, :]
+            pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+            keep = (pos_in_expert < cap).astype(jnp.float32)
+            d_c = (
+                onehot[:, :, None]
+                * jax.nn.one_hot(pos_in_expert, cap, dtype=jnp.float32)[:, None, :]
+                * keep[:, None, None]
+            )                                                     # [S, E, cap]
+            dispatch = dispatch + d_c
+            combine = combine + d_c * gates[:, c][:, None, None]
+            counts = counts + jnp.sum(onehot * keep[:, None], axis=0)
 
-        # Switch aux loss: fraction-routed × mean-probability per expert.
-        frac = jnp.mean(onehot, axis=0)
+        # Aux loss (Switch eq. 4) on the first-choice assignment.
+        frac = jnp.mean(
+            jax.nn.one_hot(topk_idx[:, 0], E, dtype=jnp.float32), axis=0
+        )
         imp = jnp.mean(probs, axis=0)
         self.sow("losses", "moe_aux", self.aux_coef * E * jnp.sum(frac * imp))
 
-        dispatch = (
-            onehot[:, :, None]
-            * jax.nn.one_hot(pos_in_expert, cap, dtype=jnp.float32)[:, None, :]
-            * keep[:, None, None]
-        )                                                         # [S, E, cap]
         expert_in = jnp.einsum(
             "sec,sd->ecd", dispatch, tokens.astype(jnp.float32)
         ).astype(self.dtype)                                      # [E, cap, C]
@@ -86,7 +106,6 @@ class MoEMLP(nn.Module):
         )(d_model=C, d_hidden=4 * C, dtype=self.dtype, name="experts")
         expert_out = experts(expert_in)                           # [E, cap, C]
 
-        combine = dispatch * gate[:, None, None]                  # [S, E, cap]
         out = jnp.einsum(
             "sec,ecd->sd", combine, expert_out.astype(jnp.float32)
         )

@@ -92,6 +92,7 @@ class Block(nn.Module):
     ring: bool = False
     attn_impl: str = "auto"
     moe_experts: int = 0  # >0 replaces the dense MLP with an MoE layer
+    moe_top_k: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -103,7 +104,8 @@ class Block(nn.Module):
         if self.moe_experts > 0:
             from pytorch_distributed_tpu.models.moe import MoEMLP
 
-            h = MoEMLP(self.moe_experts, dtype=self.dtype, name="moe")(h)
+            h = MoEMLP(self.moe_experts, dtype=self.dtype,
+                       top_k=self.moe_top_k, name="moe")(h)
         else:
             h = nn.Dense(4 * C, dtype=self.dtype, name="fc1")(h)
             h = nn.gelu(h)
@@ -126,6 +128,7 @@ class TransformerLM(nn.Module):
     #                      backward — O(sqrt) memory for long context
     #                      (the jax.checkpoint HBM/FLOPs trade, brief §HBM)
     moe_experts: int = 0  # >0: MoE MLP in every block (expert parallelism)
+    moe_top_k: int = 1    # 1 = Switch routing; 2 = Mixtral-style top-2
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -135,7 +138,7 @@ class TransformerLM(nn.Module):
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.n_layers):
             x = block_cls(self.n_heads, self.dtype, self.mesh, self.ring,
-                          self.attn_impl, self.moe_experts,
+                          self.attn_impl, self.moe_experts, self.moe_top_k,
                           name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied output head (embed.attend) keeps params lean at long context.

@@ -10,9 +10,12 @@ ring attention in parallel/ring.py (which this kernel's math mirrors).
 
 Forward: Pallas kernel, grid (batch·heads, q-blocks, kv-blocks), f32
 accumulators in VMEM scratch, causal blocks skipped via predication.
-Backward: custom VJP that recomputes attention blockwise from the saved
-logsumexp (flash-attention-2 style) in plain XLA — O(L·BK) memory, no
-[L, L] materialization; a Pallas backward kernel is the planned upgrade.
+Backward: fused Pallas kernels in the flash-attention-2 decomposition —
+a dq pass (grid bh × q-blocks × kv-blocks) and a dk/dv pass (grid
+bh × kv-blocks × q-blocks), both recomputing P online from the saved
+logsumexp with VMEM accumulators; O(L·BK) memory, every matmul on the MXU.
+``bwd_impl="xla"`` selects the plain-XLA blockwise recompute (the oracle
+the kernels are tested against).
 
 Layout: [B, L, H, D] like parallel/ring.py; block sizes default to the
 128-lane MXU tile.
@@ -134,11 +137,187 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
         ],
         interpret=interpret,
     )(qr, kr, vr)
+    # Residual lse is [B*H, L] (lane 0 of the kernel's lane-broadcast
+    # output) — saving the full 128-lane layout would hold 128x the bytes
+    # across the fwd->bwd interval; the backward re-broadcasts cheaply.
     return out.reshape(B, H, L, D).transpose(0, 2, 1, 3), lse[:, :, 0]
 
 
+def _causal_run(qi, kj, block_q, block_k):
+    """Whole-block predicate: any (q, k) pair in the block is unmasked."""
+    return kj * block_k <= qi * block_q + (block_q - 1)
+
+
+def _mask_scores(s, qi, kj, block_q, block_k):
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(kpos <= qpos, s, NEG_INF)
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qi, kj,
+                    scale, causal, block_q, block_k):
+    """Shared backward block math: online-recomputed (p, ds) plus the f32
+    block views — the single source for both the dq and dk/dv kernels (and
+    the same masking the forward kernel applies)."""
+    q = q_ref[0].astype(jnp.float32)             # [BQ, D]
+    k = k_ref[0].astype(jnp.float32)             # [BK, D]
+    v = v_ref[0].astype(jnp.float32)             # [BK, D]
+    do = do_ref[0].astype(jnp.float32)           # [BQ, D]
+    lse = lse_ref[0][:, :1]                      # [BQ, 1]
+    dlt = dlt_ref[0][:, :1]                      # [BQ, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                    # [BQ, BK]
+    if causal:
+        s = _mask_scores(s, qi, kj, block_q, block_k)
+    p = jnp.exp(s - lse)                         # [BQ, BK]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                            # [BQ, BK]
+    ds = p * (dp - dlt) * scale
+    return p, ds, q, k, do
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref,
+                   dq_scr, *, scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    """dq pass: grid (bh, qi, kj), accumulate dq_i over kv blocks."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = _causal_run(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(run)
+    def _block():
+        _, ds, _, k, _ = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qi, kj,
+            scale, causal, block_q, block_k)
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == nk - 1)
+    def _final():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, block_q: int, block_k: int):
+    """dk/dv pass: grid (bh, kj, qi), accumulate over q blocks."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # q block entirely before the kv block contributes nothing.
+    run = _causal_run(qi, kj, block_q, block_k) if causal else True
+
+    @pl.when(run)
+    def _block():
+        p, ds, q, _, do = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, qi, kj,
+            scale, causal, block_q, block_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # [BK, D]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                            # [BK, D]
+
+    @pl.when(qi == nq - 1)
+    def _final():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(res, g, causal: bool, block_q: int, block_k: int,
+                interpret: bool):
+    """Fused Pallas backward: dq pass + dk/dv pass, both with online
+    recompute from the saved lse — no [L, L] materialization, all matmuls
+    on the MXU (flash-attention-2 decomposition)."""
+    q, k, v, out, lse = res               # lse: [B*H, L] f32
+    B, L, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    bq = min(block_q, L)
+    bk = min(block_k, L)
+    f32 = jnp.float32
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    gr = g.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    of = out.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    delta = jnp.sum(of.astype(f32) * gr.astype(f32), axis=-1)     # [BH, L]
+    # Lane-broadcast for block slicing (transient, not a saved residual).
+    lse128 = jnp.broadcast_to(lse[:, :, None], (B * H, L, 128))
+    dlt128 = jnp.broadcast_to(delta[:, :, None], (B * H, L, 128))
+
+    def spec_q(pos_q):
+        return pl.BlockSpec((1, bq, D), lambda b, x, y: (b, (x, y)[pos_q], 0),
+                            memory_space=pltpu.VMEM)
+
+    def spec_k(pos_k):
+        return pl.BlockSpec((1, bk, D), lambda b, x, y: (b, (x, y)[pos_k], 0),
+                            memory_space=pltpu.VMEM)
+
+    def spec_l(pos_q):
+        return pl.BlockSpec((1, bq, 128), lambda b, x, y: (b, (x, y)[pos_q], 0),
+                            memory_space=pltpu.VMEM)
+
+    # dq: grid (bh, qi, kj) — q-side blocks keyed by grid pos 0, kv by 1.
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B * H, L // bq, L // bk),
+        in_specs=[spec_q(0), spec_k(1), spec_k(1), spec_q(0),
+                  spec_l(0), spec_l(0)],
+        out_specs=[spec_q(0)],
+        out_shape=[jax.ShapeDtypeStruct((B * H, L, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, D), f32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse128, dlt128)[0]
+
+    # dk/dv: grid (bh, kj, qi) — kv blocks keyed by grid pos 0, q by 1.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk),
+        grid=(B * H, L // bk, L // bq),
+        in_specs=[spec_q(1), spec_k(0), spec_k(0), spec_q(1),
+                  spec_l(1), spec_l(1)],
+        out_specs=[spec_k(0), spec_k(0)],
+        out_shape=[jax.ShapeDtypeStruct((B * H, L, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, L, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), f32),
+                        pltpu.VMEM((bk, D), f32)],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse128, dlt128)
+
+    def back(x):
+        return x.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+    return back(dq), back(dk), back(dv)
+
+
 def _bwd_blockwise(res, g, causal: bool, block_k: int):
-    """Memory-efficient backward: recompute P blockwise from saved lse."""
+    """Memory-efficient backward: recompute P blockwise from saved lse.
+    (Plain-XLA reference path, selected via ``bwd_impl="xla"`` — the
+    semantics oracle the Pallas backward kernels are tested against.)"""
     q, k, v, out, lse = res  # q,k,v,out: [B,L,H,D]; lse: [B*H, L]
     B, L, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
@@ -182,7 +361,7 @@ def _bwd_blockwise(res, g, causal: bool, block_k: int):
             back(dv).astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -191,9 +370,12 @@ def flash_attention(
     block_q: int = 256,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    bwd_impl: str = "pallas",
 ) -> jnp.ndarray:
     """Fused attention over [B, L, H, D].  ``interpret=None`` auto-selects
-    the Pallas interpreter off-TPU (slow, exact) and compiled mode on TPU."""
+    the Pallas interpreter off-TPU (slow, exact) and compiled mode on TPU.
+    ``bwd_impl``: "pallas" = fused dq/dk/dv kernels (default); "xla" = the
+    blockwise-recompute reference path."""
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_k,
                         _resolve_interpret(interpret))
     return out
@@ -205,13 +387,22 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
                           _resolve_interpret(interpret))
     return out, (q, k, v, out, lse)
 
 
-def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+def _fa_bwd(causal, block_q, block_k, interpret, bwd_impl, res, g):
+    if bwd_impl == "pallas":
+        # The dq pass reuses the forward's q-block size; the dk/dv pass
+        # accumulates over q blocks with the same tiling.
+        return _bwd_pallas(res, g, causal, block_q, block_k,
+                           _resolve_interpret(interpret))
+    if bwd_impl != "xla":
+        raise ValueError(
+            f"unknown bwd_impl {bwd_impl!r}: expected 'pallas' or 'xla'"
+        )
     return _bwd_blockwise(res, g, causal, block_k)
 
 

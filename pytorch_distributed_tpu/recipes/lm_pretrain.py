@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sequence-parallel (ring) size")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel size (MoE MLPs, one expert/device)")
+    p.add_argument("--moe-top-k", type=int, default=1,
+                   help="experts per token for --ep (1=Switch, 2=Mixtral-style)")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline-parallel size (GPipe stages over a 'pipe' "
                         "mesh axis; composes with the data axis)")
@@ -97,6 +99,11 @@ def main(argv=None) -> float:
             raise SystemExit(
                 f"per-microbatch batch {args.batch_size // micro} not "
                 f"divisible by the data axis ({pp_dp} replicas)")
+    if args.moe_top_k < 1:
+        raise SystemExit(f"--moe-top-k must be >= 1, got {args.moe_top_k}")
+    if args.moe_top_k > 1 and args.ep <= 1:
+        raise SystemExit("--moe-top-k requires --ep > 1 (it selects experts "
+                         "per token in the MoE model variant)")
     if args.tp > 1 and args.sp > 1 and args.n_heads % args.tp:
         # Composed with ring SP the attention heads are explicitly sharded
         # over 'model' (ring.py shard_map specs); pure GSPMD TP has no such
@@ -110,6 +117,7 @@ def main(argv=None) -> float:
         model = TransformerLM(
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, dtype=dtype, moe_experts=args.ep,
+            moe_top_k=args.moe_top_k,
         )
         specs = "ep"
     elif args.pp > 1:

@@ -65,3 +65,43 @@ def test_flash_rejects_indivisible_length():
     q, k, v = _qkv(L=96)
     with pytest.raises(AssertionError, match="must divide"):
         flash_attention(q, k, v, True, 64, 64, True)
+
+
+@pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bwd_impls_match_dense_multiblock(causal, bwd_impl):
+    """Both backward implementations, multi-block grid (the Pallas dq and
+    dk/dv kernels accumulate across 4x4 blocks here)."""
+    q, k, v = _qkv(L=128, H=2, D=32, seed=3)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal, 32, 32, True, bwd_impl) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_flash_bwd_pallas_matches_xla_bf16():
+    q, k, v = _qkv(L=128, H=1, D=64, seed=4)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss(impl):
+        def f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, 64, 64, True, impl)
+                .astype(jnp.float32) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(qb, kb, vb)
+
+    gp = loss("pallas")
+    gx = loss("xla")
+    for a, b, name in zip(gp, gx, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=0.05, atol=0.05, err_msg=name)

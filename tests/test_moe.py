@@ -79,3 +79,91 @@ def test_lm_pretrain_ep_recipe_learns(tmp_path, capsys):
     out = capsys.readouterr().out
     first = float(out.split("Loss ")[1].split(" ")[0])
     assert final < first
+
+
+# ------------------------------------------------------------ top-k routing
+
+def test_top2_gates_renormalized_and_finite():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.moe import MoEMLP
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 8, 16)).astype(np.float32))
+    m = MoEMLP(n_experts=4, top_k=2)
+    v = m.init(jax.random.PRNGKey(0), x)
+    y, _ = m.apply(v, x, mutable=["losses"])
+    assert y.shape == x.shape and np.isfinite(np.asarray(y)).all()
+    # top-2 output differs from top-1 with the same params
+    y1, _ = MoEMLP(n_experts=4, top_k=1).apply(v, x, mutable=["losses"])
+    assert np.abs(np.asarray(y) - np.asarray(y1)).max() > 1e-6
+
+
+def test_top2_single_expert_is_dense_ffn():
+    """With E=1, top-k clamps to 1 and the layer is exactly the dense FFN
+    (gate = softmax over one logit = 1.0)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.moe import MoEMLP, _FFN
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(1, 4, 8)).astype(np.float32))
+    m = MoEMLP(n_experts=1, top_k=2, capacity_factor=4.0)
+    v = m.init(jax.random.PRNGKey(0), x)
+    y, _ = m.apply(v, x, mutable=["losses"])
+    ffn = _FFN(d_model=8, d_hidden=32)
+    fv = {"params": jax.tree_util.tree_map(
+        lambda a: a[0], v["params"]["experts"])}
+    want = ffn.apply(fv, x.reshape(4, 8)).reshape(1, 4, 8)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_top2_capacity_never_exceeded():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models import moe as moe_mod
+    from pytorch_distributed_tpu.models.moe import MoEMLP
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 16, 8)).astype(np.float32))
+    # tiny capacity forces drops; dispatch per expert must stay <= cap
+    m = MoEMLP(n_experts=2, top_k=2, capacity_factor=0.25)
+    v = m.init(jax.random.PRNGKey(0), x)
+
+    captured = {}
+    orig = jnp.einsum
+
+    def spy(spec, *args, **kw):
+        if spec == "sec,sd->ecd":
+            captured["dispatch"] = args[0]
+        return orig(spec, *args, **kw)
+
+    try:
+        moe_mod.jnp.einsum = spy
+        m.apply(v, x, mutable=["losses"])
+    finally:
+        moe_mod.jnp.einsum = orig
+    d = np.asarray(captured["dispatch"])  # [S, E, cap]
+    per_expert = d.sum(axis=(0, 2))
+    assert (per_expert <= d.shape[2] + 1e-6).all()
+    # each (expert, slot) holds at most one token
+    slot_occupancy = d.sum(axis=0)
+    assert (slot_occupancy <= 1 + 1e-6).all()
+
+
+def test_lm_pretrain_moe_top2(tmp_path, capsys):
+    from pytorch_distributed_tpu.recipes import lm_pretrain
+
+    final = lm_pretrain.main([
+        "--vocab", "32", "--d-model", "32", "--n-heads", "2",
+        "--n-layers", "1", "--seq-len", "32", "-b", "8",
+        "--steps", "6", "--lr", "0.05", "-p", "2",
+        "--dataset-length", "8", "--precision", "fp32",
+        "--ep", "2", "--moe-top-k", "2", "--no-eval",
+        "--checkpoint-dir", str(tmp_path),
+    ])
+    assert np.isfinite(final)
