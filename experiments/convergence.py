@@ -94,7 +94,6 @@ def main() -> int:
     import tempfile
 
     here = os.path.dirname(os.path.abspath(__file__))
-    here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.path.abspath(os.path.join(here, "..",
                                             "RESULTS_convergence.json"))
     with tempfile.TemporaryDirectory() as tmp:

@@ -1,0 +1,72 @@
+"""Autoregressive generation for the LM family: KV-cached greedy decode.
+
+The serving-side counterpart of the training harness (the reference's
+inference story is ``--evaluate``; generation is the LM-family analogue).
+``TransformerLM(decode=True, max_len=N)`` switches attention into cached
+mode: the prompt prefills the per-layer key/value caches in one pass, then
+each generated token attends over the filled prefix — O(L) per token
+instead of O(L²), all under one jit (prefill + a ``lax.scan`` over steps,
+static shapes throughout).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+
+def greedy_generate(
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    vocab_size: int,
+    d_model: int,
+    n_heads: int,
+    n_layers: int,
+    dtype: Any = jnp.float32,
+) -> jnp.ndarray:
+    """Greedy-decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
+
+    ``params``: a trained TransformerLM's ``params`` tree (decode mode uses
+    the same parameter structure).  Returns ``[B, max_new_tokens]`` int32.
+    """
+    B, P = prompt.shape
+    model = TransformerLM(
+        vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, dtype=dtype, attn_impl="dense",
+        decode=True, max_len=P + max_new_tokens,
+    )
+    # init builds the zeroed cache collection (params discarded — the
+    # caller's trained tree is used for the actual apply).
+    cache0 = model.init(jax.random.PRNGKey(0), prompt)["cache"]
+
+    @jax.jit
+    def run(params, prompt, cache):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, prompt, mutable=["cache"]
+        )
+        cache = mut["cache"]
+        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+        def body(carry, _):
+            cache, tok = carry
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"],
+            )
+            ntok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            return (mut["cache"], ntok), ntok
+
+        if max_new_tokens == 1:
+            return tok[:, None]
+        (_, _), rest = jax.lax.scan(
+            body, (cache, tok), None, length=max_new_tokens - 1
+        )
+        return jnp.concatenate([tok[:, None], rest.T], axis=1)
+
+    return run(params, prompt, cache0)

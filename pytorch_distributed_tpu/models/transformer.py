@@ -26,14 +26,16 @@ from jax.sharding import Mesh
 from pytorch_distributed_tpu.parallel.ring import dense_attention, ring_self_attention
 
 
-def rope(x: jnp.ndarray, base: float = 10000.0) -> jnp.ndarray:
+def rope(x: jnp.ndarray, base: float = 10000.0, offset=0) -> jnp.ndarray:
     """Rotary position embedding over [B, L, H, D] (global positions — under
     GSPMD the position index is computed on the full array, so sequence
-    sharding stays transparent)."""
+    sharding stays transparent).  ``offset`` shifts positions for KV-cached
+    decoding (may be a traced scalar)."""
     B, L, H, D = x.shape
     half = D // 2
     freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    ang = jnp.arange(L, dtype=jnp.float32)[:, None] * freqs[None, :]  # [L, half]
+    pos = offset + jnp.arange(L, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]                               # [L, half]
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
@@ -61,6 +63,8 @@ class SelfAttention(nn.Module):
     mesh: Optional[Mesh] = None
     ring: bool = False
     attn_impl: str = "auto"  # auto | dense | flash
+    decode: bool = False     # KV-cached autoregressive mode
+    max_len: int = 0         # cache capacity (decode mode)
 
     @nn.compact
     def __call__(self, x):
@@ -70,6 +74,8 @@ class SelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, L, self.n_heads, D)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
+        if self.decode:
+            return self._decode_attend(q, k, v, B, L, C, D)
         q, k = rope(q), rope(k)
         if self.ring:
             if self.mesh is None:
@@ -84,6 +90,53 @@ class SelfAttention(nn.Module):
         out = out.reshape(B, L, C)
         return nn.Dense(C, use_bias=False, dtype=self.dtype, name="proj")(out)
 
+    def _decode_attend(self, q, k, v, B, L, C, D):
+        """KV-cached attention: new tokens' k/v land in the cache at the
+        running index (prefill writes the whole prompt at once, generation
+        steps write one token); q attends over the filled prefix with a
+        static-shape mask.  Cache lives in the flax "cache" collection —
+        created at ``init``, threaded by the caller via ``mutable``."""
+        if self.max_len <= 0:
+            raise ValueError("decode mode needs max_len > 0 (cache capacity)")
+        # During init this variable doesn't exist yet: create the zeroed
+        # cache but DON'T advance it — the returned cache must start at
+        # index 0, not wherever the init trace's dummy tokens left it.
+        initializing = not self.has_variable("cache", "cached_key")
+        ck = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((B, self.max_len, self.n_heads, D), self.dtype))
+        cv = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((B, self.max_len, self.n_heads, D), self.dtype))
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        if initializing:
+            q, k = rope(q), rope(k)
+            out = dense_attention(q, k, v, causal=True).reshape(B, L, C)
+            return nn.Dense(C, use_bias=False, dtype=self.dtype,
+                            name="proj")(out)
+        idx = ci.value
+        q = rope(q, offset=idx)
+        k = rope(k, offset=idx)
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(ck.value.dtype), (0, idx, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0))
+        ci.value = idx + L
+        keys, values = ck.value, cv.value                 # [B, Lmax, H, D]
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+            keys.astype(jnp.float32)) / (D ** 0.5)
+        kpos = jnp.arange(self.max_len)
+        qpos = idx + jnp.arange(L)
+        mask = kpos[None, None, None, :] <= qpos[None, None, :, None]
+        scores = jnp.where(mask, scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", w, values.astype(jnp.float32)
+        ).astype(q.dtype).reshape(B, L, C)
+        return nn.Dense(C, use_bias=False, dtype=self.dtype, name="proj")(out)
+
 
 class Block(nn.Module):
     n_heads: int
@@ -93,13 +146,16 @@ class Block(nn.Module):
     attn_impl: str = "auto"
     moe_experts: int = 0  # >0 replaces the dense MLP with an MoE layer
     moe_top_k: int = 1
+    decode: bool = False
+    max_len: int = 0
 
     @nn.compact
     def __call__(self, x):
         C = x.shape[-1]
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(self.n_heads, self.dtype, self.mesh, self.ring,
-                              self.attn_impl, name="attn")(h)
+                              self.attn_impl, decode=self.decode,
+                              max_len=self.max_len, name="attn")(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.moe_experts > 0:
             from pytorch_distributed_tpu.models.moe import MoEMLP
@@ -129,6 +185,8 @@ class TransformerLM(nn.Module):
     #                      (the jax.checkpoint HBM/FLOPs trade, brief §HBM)
     moe_experts: int = 0  # >0: MoE MLP in every block (expert parallelism)
     moe_top_k: int = 1    # 1 = Switch routing; 2 = Mixtral-style top-2
+    decode: bool = False  # KV-cached autoregressive inference mode
+    max_len: int = 0      # cache capacity (decode mode)
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -139,6 +197,7 @@ class TransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = block_cls(self.n_heads, self.dtype, self.mesh, self.ring,
                           self.attn_impl, self.moe_experts, self.moe_top_k,
+                          decode=self.decode, max_len=self.max_len,
                           name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied output head (embed.attend) keeps params lean at long context.

@@ -71,6 +71,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--eval-batches", type=int, default=8)
     p.add_argument("--no-eval", action="store_true",
                    help="disable the held-out eval entirely")
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, greedy-decode N tokens from a "
+                        "dataset prompt (plain dp runs only)")
     return p
 
 
@@ -104,6 +107,9 @@ def main(argv=None) -> float:
     if args.moe_top_k > 1 and args.ep <= 1:
         raise SystemExit("--moe-top-k requires --ep > 1 (it selects experts "
                          "per token in the MoE model variant)")
+    if args.generate > 0 and (args.tp > 1 or args.sp > 1 or args.ep > 1
+                              or args.pp > 1):
+        raise SystemExit("--generate supports plain dp runs only")
     if args.tp > 1 and args.sp > 1 and args.n_heads % args.tp:
         # Composed with ring SP the attention heads are explicitly sharded
         # over 'model' (ring.py shard_map specs); pure GSPMD TP has no such
@@ -189,6 +195,21 @@ def main(argv=None) -> float:
             eval_batches=args.eval_batches,
         )
         final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
+        if args.generate > 0:  # plain-dp only, validated with the args above
+            import jax as _jax
+            import numpy as _np
+
+            from pytorch_distributed_tpu.models.generate import greedy_generate
+
+            prompt = dataset.batch(0, 1)[:, : min(16, args.seq_len // 2)]
+            params = _jax.device_get(trainer.state.params)
+            toks = greedy_generate(
+                params, prompt, args.generate, vocab_size=args.vocab,
+                d_model=args.d_model, n_heads=args.n_heads,
+                n_layers=args.n_layers, dtype=dtype,
+            )
+            print(" * Generated:", " ".join(map(str, _np.asarray(toks)[0])),
+                  flush=True)
     print(f" * Final loss {final_loss:.4f}", flush=True)
     return final_loss
 
