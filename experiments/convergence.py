@@ -100,12 +100,24 @@ def main() -> int:
         data_root = os.path.join(tmp, "data")
         make_dataset(data_root)
         results = {}
+        if os.path.exists(out_path):  # accumulate across partial runs
+            try:
+                with open(out_path) as f:
+                    results = json.load(f).get("curves", {})
+            except ValueError:  # truncated by a killed writer: start fresh
+                results = {}
+        only = os.environ.get("CONV_ONLY", "")
         # accum=2: BATCH/2 microbatches stay divisible by the 8-shard mesh.
         for name, precision, accum in (
             ("fp32_accum1", "fp32", 1),
             ("bf16_accum1", "bf16", 1),
             ("bf16_accum2", "bf16", 2),
         ):
+            if only and name not in only.split(","):
+                continue
+            if name in results:
+                print(f"=== {name}: cached ===", flush=True)
+                continue
             print(f"=== {name} ===", flush=True)
             results[name] = run_config(data_root, precision, accum, tmp)
             # Incremental write: a late-config failure must not lose the

