@@ -14,6 +14,8 @@ compute policy — the same step the tpu_native recipe runs.
 """
 
 import json
+import sys
+import threading
 import time
 
 import jax
@@ -21,6 +23,35 @@ import jax.numpy as jnp
 import numpy as np
 
 REFERENCE_IMGS_PER_SEC_PER_DEVICE = 1281167 / 1186.5 / 4  # ≈ 269.9 (BASELINE.md)
+
+
+def _require_devices(timeout_s: float = 180.0):
+    """Device discovery with a watchdog: on this platform a wedged tunnel
+    makes ``jax.devices()`` block forever — fail loudly instead of hanging
+    the bench harness.  (Compile slowness is NOT guarded; only discovery.)"""
+    result = {}
+
+    def probe():
+        try:
+            result["devices"] = jax.devices()
+        except Exception as e:  # pragma: no cover
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" in result:
+        return result["devices"]
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "error": result.get(
+            "error", f"device discovery hung >{timeout_s:.0f}s "
+                     "(axon tunnel unreachable)"),
+    }))
+    sys.exit(1)
 
 
 def main() -> None:
@@ -32,6 +63,7 @@ def main() -> None:
 
     batch = 256
     image = 224
+    _require_devices()
     mesh = data_parallel_mesh()
     model = models.create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
     variables = model.init(

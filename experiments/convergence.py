@@ -100,12 +100,19 @@ def main() -> int:
         data_root = os.path.join(tmp, "data")
         make_dataset(data_root)
         results = {}
+        fingerprint = [CLASSES, PER_CLASS_TRAIN, PER_CLASS_VAL, IMAGE,
+                       EPOCHS, BATCH]
         if os.path.exists(out_path):  # accumulate across partial runs
             try:
                 with open(out_path) as f:
-                    results = json.load(f).get("curves", {})
+                    prior = json.load(f)
+                # Cached curves are only reusable for the SAME experiment
+                # configuration — stale-config curves under fresh meta would
+                # misdescribe themselves.
+                if prior.get("fingerprint") == fingerprint:
+                    results = prior.get("curves", {})
             except ValueError:  # truncated by a killed writer: start fresh
-                results = {}
+                pass
         only = os.environ.get("CONV_ONLY", "")
         # accum=2: BATCH/2 microbatches stay divisible by the 8-shard mesh.
         for name, precision, accum in (
@@ -123,7 +130,8 @@ def main() -> int:
             # Incremental write: a late-config failure must not lose the
             # completed curves.
             with open(out_path, "w") as f:
-                json.dump({"curves": results}, f, indent=1)
+                json.dump({"fingerprint": fingerprint, "curves": results},
+                          f, indent=1)
 
     meta = {
         "oracle": "per-epoch val top-1, sharded exact eval "
@@ -136,7 +144,7 @@ def main() -> int:
         "batch": BATCH,
         "platform": os.environ.get("JAX_PLATFORMS", "device-default"),
     }
-    out = {"meta": meta, "curves": results}
+    out = {"meta": meta, "fingerprint": fingerprint, "curves": results}
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
 

@@ -44,6 +44,12 @@ from pytorch_distributed_tpu.models.densenet import (  # noqa: F401
     densenet121, densenet161, densenet169, densenet201,
 )
 from pytorch_distributed_tpu.models.mobilenet import mobilenet_v2  # noqa: F401
+from pytorch_distributed_tpu.models.extra import (  # noqa: F401
+    mnasnet0_5, mnasnet0_75, mnasnet1_0, mnasnet1_3,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    squeezenet1_0, squeezenet1_1,
+)
 
 _REGISTRY: Dict[str, Callable] = {
     "alexnet": alexnet,
@@ -62,6 +68,13 @@ _REGISTRY: Dict[str, Callable] = {
     "wide_resnet101_2": wide_resnet101_2,
     "resnext50_32x4d": resnext50_32x4d,
     "resnext101_32x8d": resnext101_32x8d,
+    "squeezenet1_0": squeezenet1_0, "squeezenet1_1": squeezenet1_1,
+    "shufflenet_v2_x0_5": shufflenet_v2_x0_5,
+    "shufflenet_v2_x1_0": shufflenet_v2_x1_0,
+    "shufflenet_v2_x1_5": shufflenet_v2_x1_5,
+    "shufflenet_v2_x2_0": shufflenet_v2_x2_0,
+    "mnasnet0_5": mnasnet0_5, "mnasnet0_75": mnasnet0_75,
+    "mnasnet1_0": mnasnet1_0, "mnasnet1_3": mnasnet1_3,
 }
 
 

@@ -22,8 +22,6 @@ import threading
 import time
 from typing import Dict, Optional
 
-_peak_client_bytes: Dict[int, int] = {}
-
 
 def _client_side_bytes() -> Dict[int, int]:
     """Live device-buffer bytes per device id, from the client's array
@@ -42,7 +40,11 @@ def _client_side_bytes() -> Dict[int, int]:
     return per_dev
 
 
-def sample_devices():
+def sample_devices(peaks: Optional[Dict[int, int]] = None):
+    """One CSV row per local device.  ``peaks``: caller-owned running-peak
+    state for the client-side fallback (each sampler passes its own dict so
+    concurrent samplers don't corrupt one another's peak column); None
+    reports peak = current in-use."""
     import jax
 
     rows = []
@@ -60,10 +62,11 @@ def sample_devices():
             if client is None:
                 client = _client_side_bytes()
             in_use = client.get(d.id, 0)
-            _peak_client_bytes[d.id] = max(
-                _peak_client_bytes.get(d.id, 0), in_use
-            )
-            peak = _peak_client_bytes[d.id]
+            if peaks is not None:
+                peaks[d.id] = max(peaks.get(d.id, 0), in_use)
+                peak = peaks[d.id]
+            else:
+                peak = in_use
         rows.append(
             [now, i, stats.get("bytes_limit", 0), in_use, peak or 0]
         )
@@ -80,13 +83,12 @@ class TelemetrySampler:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "TelemetrySampler":
-        # Fresh peak tracking per sampling session — a previous run's peak
-        # must not bleed into this run's CSV.
-        _peak_client_bytes.clear()
+        # Per-instance peak tracking: concurrent samplers stay independent.
+        peaks: Dict[int, int] = {}
 
         def loop():
             while not self._stop.is_set():
-                rows = sample_devices()
+                rows = sample_devices(peaks)
                 with open(self.path, "a+", newline="") as f:
                     csv.writer(f).writerows(rows)
                 self._stop.wait(self.interval_s)

@@ -20,6 +20,10 @@ EXPECTED = {
     "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
     "wide_resnet50_2", "wide_resnet101_2",
     "resnext50_32x4d", "resnext101_32x8d",
+    "squeezenet1_0", "squeezenet1_1",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+    "mnasnet0_5", "mnasnet0_75", "mnasnet1_0", "mnasnet1_3",
 }
 
 
@@ -28,7 +32,9 @@ def test_registry_contains_expected_families():
 
 
 # Keep per-arch cost low: one light representative per family at tiny size.
-FWD_ARCHS = ["alexnet", "vgg11_bn", "densenet121", "mobilenet_v2", "resnet34"]
+FWD_ARCHS = ["alexnet", "vgg11_bn", "densenet121", "mobilenet_v2",
+             "resnet34", "squeezenet1_1", "shufflenet_v2_x0_5",
+             "mnasnet0_5"]
 
 
 @pytest.mark.parametrize("arch", FWD_ARCHS)
