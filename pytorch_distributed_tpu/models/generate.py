@@ -1,4 +1,5 @@
-"""Autoregressive generation for the LM family: KV-cached greedy decode.
+"""Autoregressive generation for the LM family: KV-cached decode with
+greedy or temperature/top-k sampling.
 
 The serving-side counterpart of the training harness (the reference's
 inference story is ``--evaluate``; generation is the LM-family analogue).
@@ -19,7 +20,7 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.models.transformer import TransformerLM
 
 
-def greedy_generate(
+def generate(
     params,
     prompt: jnp.ndarray,
     max_new_tokens: int,
@@ -29,11 +30,17 @@ def greedy_generate(
     n_heads: int,
     n_layers: int,
     dtype: Any = jnp.float32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    seed: int = 0,
 ) -> jnp.ndarray:
-    """Greedy-decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
+    """Decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
 
     ``params``: a trained TransformerLM's ``params`` tree (decode mode uses
-    the same parameter structure).  Returns ``[B, max_new_tokens]`` int32.
+    the same parameter structure).  ``temperature=0`` is greedy argmax;
+    ``temperature>0`` samples from softmax(logits/T), optionally truncated
+    to the ``top_k`` most likely tokens.  Returns ``[B, max_new_tokens]``
+    int32.
     """
     B, P = prompt.shape
     model = TransformerLM(
@@ -50,28 +57,51 @@ def greedy_generate(
         lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
     )
 
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][
+                ..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
     @jax.jit
-    def run(params, prompt, cache):
+    def run(params, prompt, cache, key):
         logits, mut = model.apply(
             {"params": params, "cache": cache}, prompt, mutable=["cache"]
         )
         cache = mut["cache"]
-        tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        key, sub = jax.random.split(key)
+        tok = pick(logits[:, -1, :], sub)
 
         def body(carry, _):
-            cache, tok = carry
+            cache, tok, key = carry
             logits, mut = model.apply(
                 {"params": params, "cache": cache}, tok[:, None],
                 mutable=["cache"],
             )
-            ntok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-            return (mut["cache"], ntok), ntok
+            key, sub = jax.random.split(key)
+            ntok = pick(logits[:, -1, :], sub)
+            return (mut["cache"], ntok, key), ntok
 
         if max_new_tokens == 1:
             return tok[:, None]
-        (_, _), rest = jax.lax.scan(
-            body, (cache, tok), None, length=max_new_tokens - 1
+        (_, _, _), rest = jax.lax.scan(
+            body, (cache, tok, key), None, length=max_new_tokens - 1
         )
         return jnp.concatenate([tok[:, None], rest.T], axis=1)
 
-    return run(params, prompt, cache0)
+    return run(params, prompt, cache0, jax.random.PRNGKey(seed))
+
+
+def greedy_generate(params, prompt, max_new_tokens, **kw):
+    """Greedy decode (``generate`` with temperature 0)."""
+    if kw.get("temperature"):
+        raise ValueError(
+            "greedy_generate is temperature-0 by definition; call generate() "
+            f"for sampling (got temperature={kw['temperature']})"
+        )
+    kw.pop("temperature", None)
+    return generate(params, prompt, max_new_tokens, temperature=0.0, **kw)

@@ -74,3 +74,23 @@ def test_generate_single_token():
     prompt = jnp.zeros((1, 3), jnp.int32)
     out = greedy_generate(params, prompt, 1, **CFG)
     assert out.shape == (1, 1)
+
+
+def test_sampled_generation_temperature_and_topk():
+    from pytorch_distributed_tpu.models.generate import generate
+
+    params = _trained_params(seed=3)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    # temperature=0 == greedy
+    g0 = generate(params, prompt, 5, **CFG, temperature=0.0)
+    gg = greedy_generate(params, prompt, 5, **CFG)
+    np.testing.assert_array_equal(np.asarray(g0), np.asarray(gg))
+    # sampling is reproducible per seed and varies across seeds
+    s1 = generate(params, prompt, 5, **CFG, temperature=1.5, seed=1)
+    s1b = generate(params, prompt, 5, **CFG, temperature=1.5, seed=1)
+    s2 = generate(params, prompt, 5, **CFG, temperature=1.5, seed=2)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s1b))
+    assert (np.asarray(s1) != np.asarray(s2)).any()
+    # top-k=1 collapses sampling back to greedy
+    k1 = generate(params, prompt, 5, **CFG, temperature=1.0, top_k=1, seed=7)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(gg))
