@@ -60,7 +60,12 @@ class PipelinedTransformerLM:
         mesh: Mesh,
         dtype: Any = jnp.float32,
         pipe_axis: str = "pipe",
+        tp_size: int = 1,
+        model_axis: str = "model",
     ):
+        """``tp_size > 1``: Megatron tensor parallelism INSIDE each stage
+        (``parallel/tp_stage.py`` — explicit psums under the pipeline's
+        shard_map) over ``model_axis``; the mesh must carry that axis."""
         if n_layers % n_stages:
             raise ValueError(
                 f"n_layers {n_layers} not divisible by n_stages {n_stages}"
@@ -70,17 +75,32 @@ class PipelinedTransformerLM:
                 f"mesh '{pipe_axis}' axis {dict(mesh.shape).get(pipe_axis)} "
                 f"!= n_stages {n_stages}"
             )
+        if tp_size > 1:
+            if dict(mesh.shape).get(model_axis) != tp_size:
+                raise ValueError(
+                    f"mesh '{model_axis}' axis "
+                    f"{dict(mesh.shape).get(model_axis)} != tp_size {tp_size}"
+                )
+            if n_heads % tp_size or d_model % tp_size:
+                raise ValueError(
+                    f"tp_size {tp_size} must divide both n_heads {n_heads} "
+                    f"and d_model {d_model}"
+                )
         self.vocab_size = vocab_size
         self.d_model = d_model
+        self.n_heads = n_heads
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
         self.mesh = mesh
         self.dtype = dtype
         self.pipe_axis = pipe_axis
+        self.tp_size = tp_size
+        self.model_axis = model_axis
+        self.n_blocks = n_layers // n_stages
         self._embed = nn.Embed(vocab_size, d_model, dtype=dtype, name="embed")
         self._ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
         self._stage = _Stage(
-            n_blocks=n_layers // n_stages, n_heads=n_heads, dtype=dtype
+            n_blocks=self.n_blocks, n_heads=n_heads, dtype=dtype
         )
 
     # ------------------------------------------------------------ flax-like
@@ -88,20 +108,51 @@ class PipelinedTransformerLM:
         r_embed, r_stage, r_ln = jax.random.split(rng, 3)
         embed_p = self._embed.init(r_embed, tokens)["params"]
         x0 = jnp.zeros(tokens.shape + (self.d_model,), self.dtype)
-        stage_p = jax.vmap(
-            lambda r: self._stage.init(r, x0)["params"]
-        )(jax.random.split(r_stage, self.n_stages))
+        if self.tp_size > 1:
+            from pytorch_distributed_tpu.parallel.tp_stage import (
+                init_stage_params,
+            )
+
+            stage_p = jax.vmap(
+                lambda r: init_stage_params(r, self.d_model, self.n_blocks,
+                                            dtype=self.dtype)
+            )(jax.random.split(r_stage, self.n_stages))
+        else:
+            stage_p = jax.vmap(
+                lambda r: self._stage.init(r, x0)["params"]
+            )(jax.random.split(r_stage, self.n_stages))
         ln_p = self._ln_f.init(r_ln, x0.astype(jnp.float32))["params"]
         return {"params": {"embed": embed_p, "stages": stage_p, "ln_f": ln_p}}
+
+    def _stage_fn(self):
+        if self.tp_size > 1:
+            from pytorch_distributed_tpu.parallel.tp_stage import (
+                tp_stage_apply,
+            )
+
+            return lambda sp, xb: tp_stage_apply(
+                sp, xb, self.n_heads, model_axis=self.model_axis)
+        return lambda sp, xb: self._stage.apply({"params": sp}, xb)
+
+    def _stage_specs(self):
+        if self.tp_size > 1:
+            from pytorch_distributed_tpu.parallel.tp_stage import (
+                stage_param_specs,
+            )
+
+            return stage_param_specs(self.n_blocks, self.pipe_axis,
+                                     self.model_axis)
+        return None
 
     def apply(self, variables, tokens: jnp.ndarray, mutable=None,
               train: bool = True):
         p = variables["params"]
         x = self._embed.apply({"params": p["embed"]}, tokens)
         x = pipeline_apply(
-            lambda sp, xb: self._stage.apply({"params": sp}, xb),
+            self._stage_fn(),
             p["stages"], x, self.n_microbatches, self.mesh,
             pipe_axis=self.pipe_axis,
+            stage_param_specs=self._stage_specs(),
         )
         x = self._ln_f.apply({"params": p["ln_f"]}, x.astype(jnp.float32))
         logits = self._embed.apply(
@@ -111,9 +162,24 @@ class PipelinedTransformerLM:
         return (logits, {}) if mutable is not None else logits
 
 
-def pp_specs(params, pipe_axis: str = "pipe"):
+def pp_specs(params, pipe_axis: str = "pipe", model_axis=None):
     """PartitionSpec tree for ``PipelinedTransformerLM`` params: the stacked
-    stage tree sharded on its leading (stage) axis, embed/ln replicated."""
+    stage tree sharded on its leading (stage) axis, embed/ln replicated.
+    With ``model_axis`` (tp_size > 1, tp_stage layout) the stage leaves get
+    the Megatron column/row specs from ``parallel/tp_stage.py``."""
+    stages = params["stages"]
+    if isinstance(stages, dict) and "blocks" in stages:
+        from pytorch_distributed_tpu.parallel.tp_stage import (
+            stage_param_specs,
+        )
+
+        spec_tree = {
+            k: jax.tree_util.tree_map(lambda _: P(), v)
+            for k, v in params.items() if k != "stages"
+        }
+        spec_tree["stages"] = stage_param_specs(
+            len(stages["blocks"]), pipe_axis, model_axis)
+        return spec_tree
 
     def spec(path, leaf):
         names = [getattr(k, "key", str(k)) for k in path]

@@ -33,6 +33,7 @@ def pipeline_apply(
     mesh: Mesh,
     pipe_axis: str = "pipe",
     data_axis: str = "data",
+    stage_param_specs: Pytree = None,
 ) -> jnp.ndarray:
     """Run ``x`` through ``n_stages`` of ``stage_fn`` as a GPipe pipeline.
 
@@ -44,6 +45,11 @@ def pipeline_apply(
     Composes with data parallelism: when the mesh has ``data_axis``, the
     microbatch batch dim stays sharded over it (each data-parallel replica
     runs its own pipeline; activations hop only along ``pipe_axis``).
+
+    ``stage_param_specs``: optional PartitionSpec tree matching
+    ``stage_params`` for additional within-stage sharding (e.g. Megatron TP
+    over a ``model`` axis — ``parallel/tp_stage.py``); each spec must still
+    lead with ``pipe_axis``.  Default: every leaf ``P(pipe_axis)``.
     """
     n_stages = mesh.shape[pipe_axis]
     B = x.shape[0]
@@ -91,10 +97,15 @@ def pipeline_apply(
     micro_spec = (
         P(None, data_axis) if data_axis in mesh.axis_names else P()
     )
+    param_specs = (
+        stage_param_specs
+        if stage_param_specs is not None
+        else jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params)
+    )
     sharded = jax.shard_map(
         per_stage,
         mesh=mesh,
-        in_specs=(P(pipe_axis), micro_spec),  # params sharded by stage
+        in_specs=(param_specs, micro_spec),  # params sharded by stage (+TP)
         out_specs=micro_spec,
         check_vma=False,
     )(stage_params, micro)

@@ -1,0 +1,134 @@
+"""Tensor parallelism INSIDE pipeline stages (shard_map-level Megatron).
+
+The GSPMD TP of ``parallel/tp.py`` cannot reach inside ``pipeline_apply``'s
+``shard_map`` (no sharding constraints under manual collectives), so the
+pipelined LM gets its model parallelism from this module instead: a
+pure-function transformer stage whose parameters are column/row-sliced over
+a ``model`` mesh axis and whose two per-block all-reduces are written as
+explicit ``lax.psum`` — exactly Megatron's decomposition, composed with the
+``pipe`` axis (and ``data``) in one mesh.
+
+Layout choices:
+- q/k/v are separate ``[C, C]`` kernels (NOT one fused ``[C, 3C]``): the
+  head dimension is then a contiguous column slice, so ``P(None, 'model')``
+  hands each rank its own head group with no shuffling.
+- proj ``[C, C]`` and fc2 ``[4C, C]`` are row-parallel (``P('model', None)``)
+  with the psum after; fc1 ``[C, 4C]`` and its bias are column-parallel;
+  LayerNorm params and fc2 bias are replicated (bias added after the psum).
+
+``tp_stage_apply(params, x, n_heads, model_axis=None)`` runs the same math
+replicated (no psum) when ``model_axis`` is None — the tp=1 oracle the
+sharded path is tested against.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.models.transformer import rope
+
+Params = Dict[str, Any]
+
+
+def init_stage_params(rng, d_model: int, n_blocks: int,
+                      dtype=jnp.float32) -> Params:
+    """Global (unsharded) parameters for one pipeline stage of ``n_blocks``
+    pre-LN transformer blocks (separate wq/wk/wv; fan-in-scaled normals,
+    flax-Dense-style lecun init)."""
+    C = d_model
+    blocks = []
+    for i in range(n_blocks):
+        keys = jax.random.split(jax.random.fold_in(rng, i), 6)
+
+        def dense(k, fan_in, shape):
+            return (jax.random.normal(k, shape, jnp.float32)
+                    / jnp.sqrt(fan_in)).astype(dtype)
+
+        blocks.append({
+            "ln1": {"scale": jnp.ones((C,), jnp.float32),
+                    "bias": jnp.zeros((C,), jnp.float32)},
+            "wq": dense(keys[0], C, (C, C)),
+            "wk": dense(keys[1], C, (C, C)),
+            "wv": dense(keys[2], C, (C, C)),
+            "proj": dense(keys[3], C, (C, C)),
+            "ln2": {"scale": jnp.ones((C,), jnp.float32),
+                    "bias": jnp.zeros((C,), jnp.float32)},
+            "fc1": {"kernel": dense(keys[4], C, (C, 4 * C)),
+                    "bias": jnp.zeros((4 * C,), jnp.float32)},
+            "fc2": {"kernel": dense(keys[5], 4 * C, (4 * C, C)),
+                    "bias": jnp.zeros((C,), jnp.float32)},
+        })
+    return {"blocks": blocks}
+
+
+def stage_param_specs(n_blocks: int, pipe_axis: str = "pipe",
+                      model_axis: Optional[str] = "model") -> Params:
+    """PartitionSpecs for STACKED stage params (leading ``pipe`` axis),
+    Megatron column/row layout over ``model_axis`` (None = replicated)."""
+    m = model_axis
+    col2 = P(pipe_axis, None, m)     # [S, C, C/4C] column-parallel
+    row2 = P(pipe_axis, m, None)     # [S, C/4C, C] row-parallel
+    rep1 = P(pipe_axis, None)
+    blocks = []
+    for _ in range(n_blocks):
+        blocks.append({
+            "ln1": {"scale": rep1, "bias": rep1},
+            "wq": col2, "wk": col2, "wv": col2,
+            "proj": row2,
+            "ln2": {"scale": rep1, "bias": rep1},
+            "fc1": {"kernel": col2, "bias": P(pipe_axis, m)},
+            "fc2": {"kernel": row2, "bias": rep1},
+        })
+    return {"blocks": blocks}
+
+
+def _layernorm(x, p):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + 1e-6) * p["scale"]
+            + p["bias"]).astype(x.dtype)
+
+
+def _attention(q, k, v):
+    """Causal dense attention over local heads [B, L, Hl, D]."""
+    D = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (D ** 0.5)
+    L = q.shape[1]
+    pos = jnp.arange(L)
+    s = jnp.where(pos[None, None, None, :] <= pos[None, None, :, None],
+                  s, -1e30)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def tp_stage_apply(params: Params, x: jnp.ndarray, n_heads: int,
+                   model_axis: Optional[str] = None) -> jnp.ndarray:
+    """Apply one stage.  Under ``shard_map`` with ``model_axis`` set, params
+    arrive as this rank's Megatron slices and the two per-block all-reduces
+    run as ``lax.psum``; with ``model_axis=None`` (replicated oracle) the
+    same math runs without collectives."""
+    tp = jax.lax.axis_size(model_axis) if model_axis else 1
+
+    def maybe_psum(t):
+        return jax.lax.psum(t, model_axis) if model_axis else t
+
+    B, L, C = x.shape
+    heads_local = n_heads // tp
+    for blk in params["blocks"]:
+        h = _layernorm(x, blk["ln1"])
+        q = (h @ blk["wq"]).reshape(B, L, heads_local, -1)
+        k = (h @ blk["wk"]).reshape(B, L, heads_local, -1)
+        v = (h @ blk["wv"]).reshape(B, L, heads_local, -1)
+        q, k = rope(q), rope(k)
+        att = _attention(q, k, v).reshape(B, L, -1)      # [B, L, C/tp]
+        x = x + maybe_psum(att @ blk["proj"])            # row-parallel + psum
+        h = _layernorm(x, blk["ln2"])
+        h = jax.nn.gelu(h @ blk["fc1"]["kernel"] + blk["fc1"]["bias"])
+        x = x + maybe_psum(h @ blk["fc2"]["kernel"]) + blk["fc2"]["bias"]
+    return x

@@ -83,10 +83,11 @@ def main(argv=None) -> float:
     n = jax.device_count()
     if args.ep > 1 and (args.tp > 1 or args.sp > 1 or args.pp > 1):
         raise SystemExit("--ep is exclusive (MoE model variant); "
-                         "--tp and --sp compose freely, --pp with dp")
-    if args.pp > 1 and (args.tp > 1 or args.sp > 1):
-        raise SystemExit("--pp composes with the data axis only (dp x pp); "
-                         "tp/sp inside a pipeline stage is future work")
+                         "--tp composes with --sp or --pp")
+    if args.pp > 1 and args.sp > 1:
+        raise SystemExit("--pp composes with --tp and the data axis "
+                         "(dp x pp x tp); ring SP inside a pipeline stage "
+                         "is future work")
     if n % (args.tp * args.sp * args.ep * args.pp):
         raise SystemExit(f"{n} devices not divisible by tp*sp*ep*pp")
     if args.pp > 1 and args.n_layers % args.pp:
@@ -94,7 +95,7 @@ def main(argv=None) -> float:
                          f"--pp {args.pp} stages")
     if args.pp > 1:
         micro = args.microbatches or args.pp
-        pp_dp = n // args.pp
+        pp_dp = n // (args.pp * args.tp)  # data axis of the pp(×tp) mesh
         if args.batch_size % micro:
             raise SystemExit(f"-b {args.batch_size} not divisible by "
                              f"{micro} pipeline microbatches")
@@ -131,13 +132,18 @@ def main(argv=None) -> float:
             PipelinedTransformerLM,
         )
 
-        mesh = build_mesh(MeshSpec(("data", "pipe"), (n // args.pp, args.pp)))
+        axes = ["data", "pipe"]
+        shape = [n // (args.pp * args.tp), args.pp]
+        if args.tp > 1:  # Megatron TP inside each stage (tp_stage.py)
+            axes.append("model")
+            shape.append(args.tp)
+        mesh = build_mesh(MeshSpec(tuple(axes), tuple(shape)))
         model = PipelinedTransformerLM(
             vocab_size=args.vocab, d_model=args.d_model,
             n_heads=args.n_heads, n_layers=args.n_layers,
             n_stages=args.pp,
             n_microbatches=args.microbatches or args.pp,
-            mesh=mesh, dtype=dtype,
+            mesh=mesh, dtype=dtype, tp_size=args.tp,
         )
         specs = "pp"
     else:
@@ -176,7 +182,10 @@ def main(argv=None) -> float:
             elif specs == "pp":
                 from pytorch_distributed_tpu.models.pipeline_lm import pp_specs
 
-                specs = pp_specs(params_shape)
+                specs = pp_specs(
+                    params_shape,
+                    model_axis="model" if args.tp > 1 else None,
+                )
             else:
                 from pytorch_distributed_tpu.models.moe import moe_specs
 
