@@ -62,10 +62,14 @@ class PipelinedTransformerLM:
         pipe_axis: str = "pipe",
         tp_size: int = 1,
         model_axis: str = "model",
+        sp_size: int = 1,
+        seq_axis: str = "seq",
     ):
         """``tp_size > 1``: Megatron tensor parallelism INSIDE each stage
         (``parallel/tp_stage.py`` — explicit psums under the pipeline's
-        shard_map) over ``model_axis``; the mesh must carry that axis."""
+        shard_map) over ``model_axis``; the mesh must carry that axis.
+        ``sp_size > 1``: ring sequence parallelism inside each stage over
+        ``seq_axis`` (composable with ``tp_size``)."""
         if n_layers % n_stages:
             raise ValueError(
                 f"n_layers {n_layers} not divisible by n_stages {n_stages}"
@@ -86,6 +90,14 @@ class PipelinedTransformerLM:
                     f"tp_size {tp_size} must divide both n_heads {n_heads} "
                     f"and d_model {d_model}"
                 )
+        if sp_size > 1:
+            if dict(mesh.shape).get(seq_axis) != sp_size:
+                raise ValueError(
+                    f"mesh '{seq_axis}' axis "
+                    f"{dict(mesh.shape).get(seq_axis)} != sp_size {sp_size}"
+                )
+        self.sp_size = sp_size
+        self.seq_axis = seq_axis
         self.vocab_size = vocab_size
         self.d_model = d_model
         self.n_heads = n_heads
@@ -108,7 +120,7 @@ class PipelinedTransformerLM:
         r_embed, r_stage, r_ln = jax.random.split(rng, 3)
         embed_p = self._embed.init(r_embed, tokens)["params"]
         x0 = jnp.zeros(tokens.shape + (self.d_model,), self.dtype)
-        if self.tp_size > 1:
+        if self.tp_size > 1 or self.sp_size > 1:
             from pytorch_distributed_tpu.parallel.tp_stage import (
                 init_stage_params,
             )
@@ -125,23 +137,26 @@ class PipelinedTransformerLM:
         return {"params": {"embed": embed_p, "stages": stage_p, "ln_f": ln_p}}
 
     def _stage_fn(self):
-        if self.tp_size > 1:
+        if self.tp_size > 1 or self.sp_size > 1:
             from pytorch_distributed_tpu.parallel.tp_stage import (
                 tp_stage_apply,
             )
 
+            model = self.model_axis if self.tp_size > 1 else None
+            seq = self.seq_axis if self.sp_size > 1 else None
             return lambda sp, xb: tp_stage_apply(
-                sp, xb, self.n_heads, model_axis=self.model_axis)
+                sp, xb, self.n_heads, model_axis=model, seq_axis=seq)
         return lambda sp, xb: self._stage.apply({"params": sp}, xb)
 
     def _stage_specs(self):
-        if self.tp_size > 1:
+        if self.tp_size > 1 or self.sp_size > 1:
             from pytorch_distributed_tpu.parallel.tp_stage import (
                 stage_param_specs,
             )
 
-            return stage_param_specs(self.n_blocks, self.pipe_axis,
-                                     self.model_axis)
+            return stage_param_specs(
+                self.n_blocks, self.pipe_axis,
+                self.model_axis if self.tp_size > 1 else None)
         return None
 
     def apply(self, variables, tokens: jnp.ndarray, mutable=None,
@@ -153,6 +168,7 @@ class PipelinedTransformerLM:
             p["stages"], x, self.n_microbatches, self.mesh,
             pipe_axis=self.pipe_axis,
             stage_param_specs=self._stage_specs(),
+            seq_axis=self.seq_axis if self.sp_size > 1 else None,
         )
         x = self._ln_f.apply({"params": p["ln_f"]}, x.astype(jnp.float32))
         logits = self._embed.apply(

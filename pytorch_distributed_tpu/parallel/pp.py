@@ -16,7 +16,7 @@ over stages, like models/moe.py's experts) and sharded ``P('pipe', …)``.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +34,7 @@ def pipeline_apply(
     pipe_axis: str = "pipe",
     data_axis: str = "data",
     stage_param_specs: Pytree = None,
+    seq_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Run ``x`` through ``n_stages`` of ``stage_fn`` as a GPipe pipeline.
 
@@ -93,9 +94,12 @@ def pipeline_apply(
         result = result.at[jnp.clip(out_idxs, 0, n_microbatches - 1)].add(outs)
         return jax.lax.psum(result, pipe_axis)
 
-    # micro is [M, mb, ...]: shard the per-microbatch batch dim over data.
-    micro_spec = (
-        P(None, data_axis) if data_axis in mesh.axis_names else P()
+    # micro is [M, mb, L, ...]: shard the per-microbatch batch dim over
+    # data and (for in-stage ring SP) the sequence dim over seq.
+    micro_spec = P(
+        None,
+        data_axis if data_axis in mesh.axis_names else None,
+        seq_axis if seq_axis and seq_axis in mesh.axis_names else None,
     )
     param_specs = (
         stage_param_specs

@@ -108,25 +108,39 @@ def _attention(q, k, v):
 
 
 def tp_stage_apply(params: Params, x: jnp.ndarray, n_heads: int,
-                   model_axis: Optional[str] = None) -> jnp.ndarray:
+                   model_axis: Optional[str] = None,
+                   seq_axis: Optional[str] = None) -> jnp.ndarray:
     """Apply one stage.  Under ``shard_map`` with ``model_axis`` set, params
     arrive as this rank's Megatron slices and the two per-block all-reduces
     run as ``lax.psum``; with ``model_axis=None`` (replicated oracle) the
-    same math runs without collectives."""
+    same math runs without collectives.
+
+    ``seq_axis``: ring sequence parallelism INSIDE the stage — activations
+    arrive sequence-sharded, RoPE uses global positions via the ring index,
+    and attention runs ``parallel/ring.py``'s ppermute ring over the local
+    heads.  Composes with ``model_axis`` (heads split over model, sequence
+    over seq — the shard_map mirror of the GSPMD dp×sp×tp composition)."""
     tp = jax.lax.axis_size(model_axis) if model_axis else 1
+    if seq_axis:
+        from pytorch_distributed_tpu.parallel.ring import ring_attention
 
     def maybe_psum(t):
         return jax.lax.psum(t, model_axis) if model_axis else t
 
     B, L, C = x.shape
+    offset = (jax.lax.axis_index(seq_axis) * L) if seq_axis else 0
     heads_local = n_heads // tp
     for blk in params["blocks"]:
         h = _layernorm(x, blk["ln1"])
         q = (h @ blk["wq"]).reshape(B, L, heads_local, -1)
         k = (h @ blk["wk"]).reshape(B, L, heads_local, -1)
         v = (h @ blk["wv"]).reshape(B, L, heads_local, -1)
-        q, k = rope(q), rope(k)
-        att = _attention(q, k, v).reshape(B, L, -1)      # [B, L, C/tp]
+        q, k = rope(q, offset=offset), rope(k, offset=offset)
+        if seq_axis:
+            att = ring_attention(q, k, v, axis_name=seq_axis, causal=True)
+        else:
+            att = _attention(q, k, v)
+        att = att.reshape(B, L, -1)                      # [B, L, C/tp]
         x = x + maybe_psum(att @ blk["proj"])            # row-parallel + psum
         h = _layernorm(x, blk["ln2"])
         h = jax.nn.gelu(h @ blk["fc1"]["kernel"] + blk["fc1"]["bias"])

@@ -9,8 +9,10 @@ of the TransformerLM with the framework's parallelism menu —
 - ``--sp N``  sequence parallelism (ring attention over the ``seq`` axis);
   **composes with --tp**: one ``(data, seq, model)`` mesh, heads sharded
   over ``model`` inside the ring
-- ``--pp N``  pipeline parallelism (GPipe stages over ``pipe``; composes
-  with the data axis)
+- ``--pp N``  pipeline parallelism (GPipe stages over ``pipe``); composes
+  with the data axis AND with ``--tp``/``--sp``, which then run *inside*
+  each stage (``parallel/tp_stage.py``) — up to all four axes in one
+  ``(data, pipe, seq, model)`` mesh
 - ``--ep N``  expert parallelism (MoE model variant; exclusive)
 - remaining devices form the ``data`` axis (gradient psum)
 
@@ -57,7 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="experts per token for --ep (1=Switch, 2=Mixtral-style)")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline-parallel size (GPipe stages over a 'pipe' "
-                        "mesh axis; composes with the data axis)")
+                        "mesh axis; composes with the data axis, --tp and "
+                        "--sp — Megatron TP / ring SP run inside each stage)")
     p.add_argument("--microbatches", type=int, default=0,
                    help="pipeline microbatches (default: pp)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
@@ -84,10 +87,9 @@ def main(argv=None) -> float:
     if args.ep > 1 and (args.tp > 1 or args.sp > 1 or args.pp > 1):
         raise SystemExit("--ep is exclusive (MoE model variant); "
                          "--tp composes with --sp or --pp")
-    if args.pp > 1 and args.sp > 1:
-        raise SystemExit("--pp composes with --tp and the data axis "
-                         "(dp x pp x tp); ring SP inside a pipeline stage "
-                         "is future work")
+    if args.sp > 1 and args.seq_len % args.sp:
+        raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
+                         f"--sp {args.sp}")
     if n % (args.tp * args.sp * args.ep * args.pp):
         raise SystemExit(f"{n} devices not divisible by tp*sp*ep*pp")
     if args.pp > 1 and args.n_layers % args.pp:
@@ -95,7 +97,8 @@ def main(argv=None) -> float:
                          f"--pp {args.pp} stages")
     if args.pp > 1:
         micro = args.microbatches or args.pp
-        pp_dp = n // (args.pp * args.tp)  # data axis of the pp(×tp) mesh
+        # data axis of the pp(×sp)(×tp) mesh
+        pp_dp = n // (args.pp * args.tp * args.sp)
         if args.batch_size % micro:
             raise SystemExit(f"-b {args.batch_size} not divisible by "
                              f"{micro} pipeline microbatches")
@@ -133,7 +136,10 @@ def main(argv=None) -> float:
         )
 
         axes = ["data", "pipe"]
-        shape = [n // (args.pp * args.tp), args.pp]
+        shape = [n // (args.pp * args.tp * args.sp), args.pp]
+        if args.sp > 1:  # ring SP inside each stage (tp_stage.py)
+            axes.append("seq")
+            shape.append(args.sp)
         if args.tp > 1:  # Megatron TP inside each stage (tp_stage.py)
             axes.append("model")
             shape.append(args.tp)
@@ -143,7 +149,7 @@ def main(argv=None) -> float:
             n_heads=args.n_heads, n_layers=args.n_layers,
             n_stages=args.pp,
             n_microbatches=args.microbatches or args.pp,
-            mesh=mesh, dtype=dtype, tp_size=args.tp,
+            mesh=mesh, dtype=dtype, tp_size=args.tp, sp_size=args.sp,
         )
         specs = "pp"
     else:

@@ -118,3 +118,73 @@ def test_lm_pretrain_pp_tp_runs_and_learns(capsys, tmp_path):
     first = float(out.split("Loss ")[1].split(" ")[0])
     assert final < first  # learns through the dp x pipe x model mesh
     assert (tmp_path / "checkpoint.msgpack").exists()
+
+
+def test_pipelined_sp_lm_matches_sp1():
+    """Ring SP inside pipeline stages: data×pipe×seq forward ≡ the
+    replicated stagewise oracle with the same params."""
+    from pytorch_distributed_tpu.models.pipeline_lm import (
+        PipelinedTransformerLM,
+    )
+    from pytorch_distributed_tpu.parallel.tp_stage import tp_stage_apply
+
+    mesh = build_mesh(MeshSpec(("data", "pipe", "seq"), (2, 2, 2)),
+                      jax.devices()[:8])
+    model = PipelinedTransformerLM(
+        vocab_size=64, d_model=C, n_heads=HEADS, n_layers=2, n_stages=2,
+        n_microbatches=2, mesh=mesh, sp_size=2,
+    )
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(4, 16)).astype(np.int32))
+    with mesh:
+        variables = model.init(jax.random.PRNGKey(0), tokens)
+        got = model.apply(variables, tokens)
+
+    p = variables["params"]
+    x = model._embed.apply({"params": p["embed"]}, tokens)
+    for s in range(2):
+        sp = jax.tree_util.tree_map(lambda a: a[s], p["stages"])
+        x = tp_stage_apply(sp, x, HEADS, model_axis=None, seq_axis=None)
+    x = model._ln_f.apply({"params": p["ln_f"]}, x.astype(jnp.float32))
+    want = model._embed.apply(
+        {"params": p["embed"]}, x, method=__import__("flax").linen.Embed.attend)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_quad_mesh_dp_pp_sp_tp_trains():
+    """ALL FOUR axes in one mesh: data×pipe×seq×model (1×2×2×2) through the
+    full LMTrainer train step + eval."""
+    from pytorch_distributed_tpu.models.pipeline_lm import (
+        PipelinedTransformerLM,
+        pp_specs,
+    )
+    from pytorch_distributed_tpu.train.lm import LMTrainer, SyntheticTokenDataset
+
+    mesh = build_mesh(
+        MeshSpec(("data", "pipe", "seq", "model"), (1, 2, 2, 2)),
+        jax.devices()[:8])
+    model = PipelinedTransformerLM(
+        vocab_size=32, d_model=C, n_heads=HEADS, n_layers=2, n_stages=2,
+        n_microbatches=2, mesh=mesh, tp_size=2, sp_size=2,
+    )
+    tokens0 = jnp.zeros((2, 16), jnp.int32)
+    specs = pp_specs(model.init(jax.random.PRNGKey(0), tokens0)["params"],
+                     model_axis="model")
+    # dataset-length == batch: same memorizable batch every step, so a few
+    # steps must reduce the loss — exercising the backward through ring
+    # attention nested in the pipeline scan, not just finiteness.
+    ds = SyntheticTokenDataset(4, 16, 32, seed=0)
+    with mesh:
+        t = LMTrainer(model, mesh, ds, batch_size=4, lr=0.05,
+                      param_specs=specs, eval_dataset=ds, eval_batches=1)
+        first = None
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            loss = t.fit(12, print_freq=4)
+        first = float(buf.getvalue().split("Loss ")[1].split(" ")[0])
+    assert np.isfinite(loss)
+    assert loss < first  # it learns through the quad mesh
