@@ -1,0 +1,61 @@
+"""FSDP / ZeRO-3-style parameter sharding over the ``data`` axis.
+
+SURVEY.md §2.3 lists FSDP/ZeRO as explicitly absent from the reference;
+under GSPMD it is a *layout*, not a wrapper: shard every large parameter
+(and its momentum/optimizer state, via ``tp.state_specs`` reusing the same
+specs) across the data axis and let XLA insert the all-gathers before use
+and reduce-scatters for the gradients.  Per-device parameter + optimizer
+memory drops by ~the data-axis size; compute is unchanged.
+
+Composes with the ``model`` axis: leaves already sharded by a Megatron spec
+keep it — FSDP takes the largest still-unsharded dim.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+Pytree = Any
+
+
+def fsdp_specs(
+    params: Pytree,
+    mesh,
+    data_axis: str = "data",
+    min_size: int = 1024,
+    base_specs: Pytree = None,
+) -> Pytree:
+    """PartitionSpec tree sharding each parameter's largest free dim over
+    ``data_axis`` of ``mesh``.
+
+    - Leaves smaller than ``min_size`` elements stay replicated (scalars,
+      norm vectors — sharding them buys nothing and costs collectives).
+    - ``base_specs``: optional existing spec tree (e.g. ``tp_specs``) to
+      compose with — FSDP picks the largest dim the base spec leaves free.
+    Only dims divisible by the data-axis size are eligible; if none, the
+    leaf keeps its base spec.
+    """
+    n_shards = int(dict(mesh.shape)[data_axis])
+
+    def spec_for(leaf, base: P) -> P:
+        shape = np.shape(leaf)
+        if int(np.prod(shape, dtype=np.int64)) < min_size:
+            return base
+        entries = list(base) + [None] * (len(shape) - len(base))
+        candidates = [
+            (shape[i], i) for i in range(len(shape))
+            if entries[i] is None and shape[i] % n_shards == 0
+        ]
+        if not candidates:
+            return base
+        _, dim = max(candidates)
+        entries[dim] = data_axis
+        return P(*entries)
+
+    if base_specs is None:
+        return jax.tree_util.tree_map(lambda leaf: spec_for(leaf, P()), params)
+    return jax.tree_util.tree_map(spec_for, params, base_specs)

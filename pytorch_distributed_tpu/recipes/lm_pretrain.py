@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "--sp — Megatron TP / ring SP run inside each stage)")
     p.add_argument("--microbatches", type=int, default=0,
                    help="pipeline microbatches (default: pp)")
+    p.add_argument("--fsdp", action="store_true",
+                   help="shard parameters + optimizer state over the data "
+                        "axis (ZeRO-3 layout; GSPMD paths, composes with "
+                        "--tp/--sp)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
@@ -90,6 +94,11 @@ def main(argv=None) -> float:
     if args.sp > 1 and args.seq_len % args.sp:
         raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                          f"--sp {args.sp}")
+    if args.fsdp and args.pp > 1:
+        raise SystemExit("--fsdp applies to the GSPMD paths; the pipeline's "
+                         "shard_map stages manage their own sharding")
+    if args.fsdp and args.ep > 1:
+        raise SystemExit("--fsdp with --ep is not supported yet")
     if n % (args.tp * args.sp * args.ep * args.pp):
         raise SystemExit(f"{n} devices not divisible by tp*sp*ep*pp")
     if args.pp > 1 and args.n_layers % args.pp:
@@ -179,10 +188,12 @@ def main(argv=None) -> float:
         # batch dim during init tracing too).
         tokens0 = jnp.zeros((dict(mesh.shape).get("data", 1), args.seq_len),
                             jnp.int32)
-        if specs in ("tp", "ep", "pp"):
+        params_shape = None
+        if specs in ("tp", "ep", "pp") or args.fsdp:
             params_shape = jax.eval_shape(
                 lambda: model.init(jax.random.PRNGKey(args.seed), tokens0)
             )["params"]
+        if specs in ("tp", "ep", "pp"):
             if specs == "tp":
                 specs = tp_specs(params_shape)
             elif specs == "pp":
@@ -196,6 +207,10 @@ def main(argv=None) -> float:
                 from pytorch_distributed_tpu.models.moe import moe_specs
 
                 specs = moe_specs(params_shape)
+        if args.fsdp:
+            from pytorch_distributed_tpu.parallel.fsdp import fsdp_specs
+
+            specs = fsdp_specs(params_shape, mesh, base_specs=specs)
         eval_dataset = (
             None if args.no_eval else SyntheticTokenDataset(
                 max(args.dataset_length // 10, args.batch_size),
