@@ -33,6 +33,8 @@ python -m pytorch_distributed_tpu.recipes.lm_pretrain --tp 4 --seq-len 2048 -b 3
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --tp 2 --sp 2 --seq-len 8192 -b 8 --steps 1000   # composed mesh
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 4 --n-layers 8 -b 32 --steps 1000           # GPipe pipeline
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --ep 4 --moe-top-k 2 -b 32 --steps 1000          # MoE top-2
+# python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 2 --sp 2 --tp 2 -b 16 --steps 1000          # quad mesh
+# python -m pytorch_distributed_tpu.recipes.lm_pretrain --fsdp --tp 2 -b 32 --steps 1000                 # ZeRO-3 + TP
 
 # 9. full native input path on real data (C++ JPEG decode + u8 wire)
 # python -m pytorch_distributed_tpu.recipes.tpu_native --data "$DATA" -a resnet50 --wire native
