@@ -36,7 +36,11 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.models.transformer import TransformerLM
 from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh, initialize
 from pytorch_distributed_tpu.parallel.tp import replicated_like, tp_specs
-from pytorch_distributed_tpu.train.lm import LMTrainer, SyntheticTokenDataset
+from pytorch_distributed_tpu.train.lm import (
+    LMTrainer,
+    SyntheticTokenDataset,
+    TextFileDataset,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -72,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-p", "--print-freq", type=int, default=10)
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--dataset-length", type=int, default=4096)
+    p.add_argument("--text-glob", type=str, default=None,
+                   help="train on real files: byte-level LM over this glob "
+                        "(e.g. 'src/**/*.py'); forces --vocab 256 and "
+                        "replaces the synthetic dataset")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -130,6 +138,8 @@ def main(argv=None) -> float:
         raise SystemExit(f"--n-heads {args.n_heads} not divisible by "
                          f"--tp {args.tp} (required when combined with --sp)")
     dtype = jnp.bfloat16 if args.precision == "bf16" else jnp.float32
+    if args.text_glob:
+        args.vocab = TextFileDataset.vocab  # before the model is built
 
     if args.ep > 1:
         mesh = build_mesh(MeshSpec(("data", "expert"), (n // args.ep, args.ep)))
@@ -180,9 +190,15 @@ def main(argv=None) -> float:
         )
         specs = "tp" if args.tp > 1 else None
 
-    dataset = SyntheticTokenDataset(
-        args.dataset_length, args.seq_len, args.vocab, seed=args.seed
-    )
+    if args.text_glob:
+        # hold out the 10% tail for eval only when eval will run
+        train_span = (0.0, 1.0) if args.no_eval else (0.0, 0.9)
+        dataset = TextFileDataset(args.text_glob, args.seq_len,
+                                  span=train_span)
+    else:
+        dataset = SyntheticTokenDataset(
+            args.dataset_length, args.seq_len, args.vocab, seed=args.seed
+        )
     with mesh:
         # Init batch must cover the data axis (the ring shard_map divides the
         # batch dim during init tracing too).
@@ -211,12 +227,23 @@ def main(argv=None) -> float:
             from pytorch_distributed_tpu.parallel.fsdp import fsdp_specs
 
             specs = fsdp_specs(params_shape, mesh, base_specs=specs)
-        eval_dataset = (
-            None if args.no_eval else SyntheticTokenDataset(
+        if args.no_eval:
+            eval_dataset = None
+        elif args.text_glob:
+            try:
+                eval_dataset = TextFileDataset(  # held-out corpus tail
+                    args.text_glob, args.seq_len, span=(0.9, 1.0))
+            except ValueError as e:
+                raise SystemExit(
+                    f"the held-out 10% corpus tail is too small for "
+                    f"--seq-len {args.seq_len} ({e}); add files, shorten "
+                    f"--seq-len, or pass --no-eval to train on the full "
+                    f"corpus") from e
+        else:
+            eval_dataset = SyntheticTokenDataset(
                 max(args.dataset_length // 10, args.batch_size),
                 args.seq_len, args.vocab, seed=args.seed + 1,
             )
-        )
         trainer = LMTrainer(
             model, mesh, dataset, args.batch_size, lr=args.lr,
             param_specs=specs, seed=args.seed, is_primary=ctx.is_primary,

@@ -69,6 +69,51 @@ class SyntheticTokenDataset:
         )
 
 
+class TextFileDataset:
+    """Byte-level LM dataset over real files — vocab 256, sequences are
+    strided windows of the concatenated bytes.  The real-data counterpart
+    of ``SyntheticTokenDataset`` (zero tokenizer dependencies: bytes ARE the
+    tokens, the GPT-style fallback that works on any corpus)."""
+
+    vocab = 256
+
+    def __init__(self, paths, seq_len: int, stride: Optional[int] = None,
+                 span=(0.0, 1.0)):
+        """``span``: (start, end) fractions of the corpus — carve held-out
+        eval windows from the tail, e.g. train (0, .9) / eval (.9, 1)."""
+        import glob as _glob
+
+        if isinstance(paths, (str, bytes)):
+            paths = sorted(_glob.glob(paths, recursive=True))
+        blobs = []
+        for p in paths:
+            with open(p, "rb") as f:
+                blobs.append(f.read())
+        data = np.frombuffer(b"\n".join(blobs), dtype=np.uint8)
+        self.data = data[int(len(data) * span[0]):int(len(data) * span[1])]
+        if len(self.data) < seq_len + 1:
+            raise ValueError(
+                f"corpus has {len(self.data)} bytes < seq_len+1 "
+                f"({seq_len + 1}); add files"
+            )
+        self.seq_len = seq_len
+        self.stride = stride or seq_len
+        self.length = 1 + (len(self.data) - seq_len - 1) // self.stride
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, index: int) -> np.ndarray:
+        lo = index * self.stride
+        return self.data[lo:lo + self.seq_len].astype(np.int32)
+
+    def batch(self, step: int, batch_size: int) -> np.ndarray:
+        base = (step * batch_size) % max(1, self.length)
+        return np.stack(
+            [self[(base + i) % self.length] for i in range(batch_size)]
+        )
+
+
 def make_lm_train_step(
     model,
     mesh: Mesh,

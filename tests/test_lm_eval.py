@@ -79,3 +79,38 @@ def test_recipe_eval_flags(capsys):
     ])
     out = capsys.readouterr().out
     assert "* Eval loss" in out and "* Final loss" in out
+
+
+def test_text_file_dataset_real_bytes(tmp_path):
+    from pytorch_distributed_tpu.train.lm import TextFileDataset
+
+    (tmp_path / "a.txt").write_bytes(b"hello world " * 50)
+    (tmp_path / "b.txt").write_bytes(b"goodbye " * 40)
+    ds = TextFileDataset(str(tmp_path / "*.txt"), seq_len=32)
+    assert len(ds) >= 1
+    s = ds[0]
+    assert s.shape == (32,) and s.dtype == np.int32
+    assert (s >= 0).all() and (s < 256).all()
+    assert bytes(s[:5].astype(np.uint8)) == b"hello"
+    # span carves disjoint train/eval windows
+    train = TextFileDataset(str(tmp_path / "*.txt"), 32, span=(0.0, 0.9))
+    ev = TextFileDataset(str(tmp_path / "*.txt"), 32, span=(0.9, 1.0))
+    assert len(train.data) + len(ev.data) >= len(ds.data) - 1
+
+
+def test_lm_pretrain_on_real_text(capsys, tmp_path):
+    """Byte-level LM on actual files through the recipe: repeated text is
+    learnable, loss must drop."""
+    from pytorch_distributed_tpu.recipes import lm_pretrain
+
+    (tmp_path / "corpus.txt").write_bytes(b"the quick brown fox " * 300)
+    final = lm_pretrain.main([
+        "--text-glob", str(tmp_path / "*.txt"),
+        "--d-model", "32", "--n-heads", "2", "--n-layers", "1",
+        "--seq-len", "32", "-b", "8", "--steps", "15", "--lr", "0.1",
+        "-p", "4", "--precision", "fp32", "--eval-batches", "1",
+    ])
+    out = capsys.readouterr().out
+    assert "* Eval loss" in out
+    first = float(out.split("Loss ")[1].split(" ")[0])
+    assert final < first
