@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="global batch (sequences)")
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--warmup-steps", type=int, default=0,
+                   help=">0: linear warmup then cosine decay to 10%% of "
+                        "--lr over --steps (fixed lr otherwise)")
+    p.add_argument("--clip-grad-norm", type=float, default=0.0,
+                   help=">0: in-graph global-norm gradient clipping")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel (ring) size")
@@ -99,6 +104,9 @@ def main(argv=None) -> float:
     if args.ep > 1 and (args.tp > 1 or args.sp > 1 or args.pp > 1):
         raise SystemExit("--ep is exclusive (MoE model variant); "
                          "--tp composes with --sp or --pp")
+    if args.warmup_steps >= args.steps and args.warmup_steps > 0:
+        raise SystemExit(f"--warmup-steps {args.warmup_steps} must be < "
+                         f"--steps {args.steps} (no room for cosine decay)")
     if args.sp > 1 and args.seq_len % args.sp:
         raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                          f"--sp {args.sp}")
@@ -193,8 +201,14 @@ def main(argv=None) -> float:
     if args.text_glob:
         # hold out the 10% tail for eval only when eval will run
         train_span = (0.0, 1.0) if args.no_eval else (0.0, 0.9)
-        dataset = TextFileDataset(args.text_glob, args.seq_len,
-                                  span=train_span)
+        try:
+            dataset = TextFileDataset(args.text_glob, args.seq_len,
+                                      span=train_span)
+        except ValueError as e:
+            raise SystemExit(
+                f"--text-glob corpus too small for --seq-len "
+                f"{args.seq_len} ({e}); add files or shorten --seq-len"
+            ) from e
     else:
         dataset = SyntheticTokenDataset(
             args.dataset_length, args.seq_len, args.vocab, seed=args.seed
@@ -244,12 +258,18 @@ def main(argv=None) -> float:
                 max(args.dataset_length // 10, args.batch_size),
                 args.seq_len, args.vocab, seed=args.seed + 1,
             )
+        schedule = None
+        if args.warmup_steps > 0:
+            from pytorch_distributed_tpu.train.lm import warmup_cosine_lr
+
+            schedule = warmup_cosine_lr(args.lr, args.warmup_steps, args.steps)
         trainer = LMTrainer(
             model, mesh, dataset, args.batch_size, lr=args.lr,
             param_specs=specs, seed=args.seed, is_primary=ctx.is_primary,
             checkpoint_dir=args.checkpoint_dir,
             eval_dataset=eval_dataset, eval_every=args.eval_every,
             eval_batches=args.eval_batches,
+            lr_schedule=schedule, clip_grad_norm=args.clip_grad_norm,
         )
         final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
         if args.generate > 0:  # plain-dp only, validated with the args above

@@ -63,10 +63,13 @@ class SyntheticTokenDataset:
         return x
 
     def batch(self, step: int, batch_size: int) -> np.ndarray:
-        base = (step * batch_size) % max(1, self.length)
-        return np.stack(
-            [self[(base + i) % self.length] for i in range(batch_size)]
-        )
+        return _wraparound_batch(self, step, batch_size)
+
+
+def _wraparound_batch(ds, step: int, batch_size: int) -> np.ndarray:
+    """Sequential wrap-around batching shared by the LM datasets."""
+    base = (step * batch_size) % max(1, len(ds))
+    return np.stack([ds[(base + i) % len(ds)] for i in range(batch_size)])
 
 
 class TextFileDataset:
@@ -90,7 +93,9 @@ class TextFileDataset:
             with open(p, "rb") as f:
                 blobs.append(f.read())
         data = np.frombuffer(b"\n".join(blobs), dtype=np.uint8)
-        self.data = data[int(len(data) * span[0]):int(len(data) * span[1])]
+        # .copy(): a bare view would keep the whole joined corpus resident
+        # just to serve a 10% eval tail.
+        self.data = data[int(len(data) * span[0]):int(len(data) * span[1])].copy()
         if len(self.data) < seq_len + 1:
             raise ValueError(
                 f"corpus has {len(self.data)} bytes < seq_len+1 "
@@ -108,10 +113,25 @@ class TextFileDataset:
         return self.data[lo:lo + self.seq_len].astype(np.int32)
 
     def batch(self, step: int, batch_size: int) -> np.ndarray:
-        base = (step * batch_size) % max(1, self.length)
-        return np.stack(
-            [self[(base + i) % self.length] for i in range(batch_size)]
-        )
+        return _wraparound_batch(self, step, batch_size)
+
+
+def warmup_cosine_lr(base_lr: float, warmup_steps: int, total_steps: int,
+                     min_frac: float = 0.1):
+    """Standard LM-pretraining schedule: linear warmup then cosine decay to
+    ``min_frac·base_lr``.  Returns ``step -> lr`` for ``LMTrainer``'s
+    ``lr_schedule`` (computed host-side; the step takes lr as a live scalar
+    operand, so no retrace)."""
+
+    def schedule(step: int) -> float:
+        if warmup_steps > 0 and step < warmup_steps:
+            return base_lr * (step + 1) / warmup_steps
+        span = max(1, total_steps - warmup_steps)
+        t = min(1.0, (step - warmup_steps) / span)
+        cos = 0.5 * (1.0 + np.cos(np.pi * t))
+        return base_lr * (min_frac + (1.0 - min_frac) * cos)
+
+    return schedule
 
 
 def make_lm_train_step(
@@ -121,9 +141,12 @@ def make_lm_train_step(
     momentum: float = 0.9,
     weight_decay: float = 1e-4,
     data_axis: str = "data",
+    clip_grad_norm: float = 0.0,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
-    parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP)."""
+    parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
+    ``clip_grad_norm > 0`` rescales gradients to that global L2 norm
+    (in-graph, before the update — the torch ``clip_grad_norm_`` analogue)."""
 
     def step(state: TrainState, tokens: jnp.ndarray, lr: jnp.ndarray):
         def loss_fn(params):
@@ -149,6 +172,16 @@ def make_lm_train_step(
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
         )
+        if clip_grad_norm > 0.0:
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree_util.tree_leaves(grads)
+            ))
+            scale = jnp.minimum(1.0, clip_grad_norm / jnp.maximum(gnorm, 1e-12))
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                grads,
+            )
         new_params, new_momentum = sgd_update(
             grads, state.momentum, state.params, lr,
             momentum=momentum, weight_decay=weight_decay,
@@ -227,7 +260,12 @@ class LMTrainer:
         eval_dataset: Optional[SyntheticTokenDataset] = None,
         eval_every: int = 0,
         eval_batches: int = 8,
+        lr_schedule=None,
+        clip_grad_norm: float = 0.0,
     ):
+        """``lr_schedule``: optional ``step -> lr`` callable (e.g.
+        ``warmup_cosine_lr``) overriding the fixed ``lr``;
+        ``clip_grad_norm``: in-graph global-norm gradient clipping."""
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -252,7 +290,9 @@ class LMTrainer:
         )
         state = TrainState.create({"params": params}, sgd_init(params))
         self.state = shard_state(state, self.param_specs, mesh)
-        self.step_fn = make_lm_train_step(model, mesh, self.param_specs)
+        self.lr_schedule = lr_schedule
+        self.step_fn = make_lm_train_step(model, mesh, self.param_specs,
+                                          clip_grad_norm=clip_grad_norm)
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every
@@ -299,6 +339,8 @@ class LMTrainer:
             tokens = jax.device_put(
                 self.dataset.batch(i, self.batch_size), self.token_sharding
             )
+            if self.lr_schedule is not None:
+                lr = jnp.float32(self.lr_schedule(i))
             self.state, metrics = self.step_fn(self.state, tokens, lr)
             losses.update(metrics["loss"], self.batch_size)
             accs.update(metrics["acc"], self.batch_size)

@@ -114,3 +114,53 @@ def test_lm_pretrain_on_real_text(capsys, tmp_path):
     assert "* Eval loss" in out
     first = float(out.split("Loss ")[1].split(" ")[0])
     assert final < first
+
+
+def test_warmup_cosine_schedule_shape():
+    from pytorch_distributed_tpu.train.lm import warmup_cosine_lr
+
+    sched = warmup_cosine_lr(1.0, warmup_steps=10, total_steps=110,
+                             min_frac=0.1)
+    assert sched(0) == pytest.approx(0.1)      # warmup start
+    assert sched(9) == pytest.approx(1.0)      # warmup end
+    assert sched(10) == pytest.approx(1.0)     # cosine start
+    assert sched(60) == pytest.approx(0.55, abs=0.02)  # mid-decay
+    assert sched(109) == pytest.approx(0.1, abs=0.01)  # floor
+    assert sched(500) == pytest.approx(0.1, abs=1e-6)  # clamped past end
+
+
+def test_clip_grad_norm_bounds_update():
+    """With an absurdly small clip norm the parameter update magnitude is
+    bounded by lr * clip; without clipping it is much larger."""
+    from pytorch_distributed_tpu.train.lm import make_lm_train_step
+    from pytorch_distributed_tpu.parallel.tp import replicated_like, shard_state
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+
+    mesh = _mesh()
+    model = _tiny_model()
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(8, 32)).astype(np.int32))
+
+    def run(clip):
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 32), jnp.int32))["params"]
+        # host copy first: the jitted step donates (consumes) its input state
+        orig = jax.tree_util.tree_map(np.asarray, params)
+        sp = replicated_like(params)
+        state = shard_state(
+            TrainState.create({"params": params}, sgd_init(params)), sp, mesh)
+        step = make_lm_train_step(model, mesh, sp, weight_decay=0.0,
+                                  clip_grad_norm=clip)
+        with mesh:
+            new_state, _ = step(state, tokens, jnp.float32(1.0))
+        delta = np.sqrt(sum(
+            float(jnp.sum((a - b) ** 2)) for a, b in zip(
+                jax.tree_util.tree_leaves(new_state.params),
+                jax.tree_util.tree_leaves(orig))))
+        return float(delta)
+
+    clipped = run(1e-3)
+    unclipped = run(0.0)
+    assert clipped <= 1e-3 + 1e-6   # ||Δparams|| = lr * ||clipped grads||
+    assert unclipped > 10 * clipped
