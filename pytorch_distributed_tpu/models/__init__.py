@@ -44,6 +44,9 @@ from pytorch_distributed_tpu.models.densenet import (  # noqa: F401
     densenet121, densenet161, densenet169, densenet201,
 )
 from pytorch_distributed_tpu.models.mobilenet import mobilenet_v2  # noqa: F401
+from pytorch_distributed_tpu.models.inception import (  # noqa: F401
+    googlenet, inception_v3,
+)
 from pytorch_distributed_tpu.models.extra import (  # noqa: F401
     mnasnet0_5, mnasnet0_75, mnasnet1_0, mnasnet1_3,
     shufflenet_v2_x0_5, shufflenet_v2_x1_0,
@@ -59,6 +62,8 @@ _REGISTRY: Dict[str, Callable] = {
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
     "mobilenet_v2": mobilenet_v2,
+    "googlenet": googlenet,
+    "inception_v3": inception_v3,
     "resnet18": resnet18,
     "resnet34": resnet34,
     "resnet50": resnet50,

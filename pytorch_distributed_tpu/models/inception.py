@@ -1,0 +1,350 @@
+"""GoogLeNet and Inception-v3 (flax.linen, NHWC) — the last two archs of the
+reference's pinned torchvision-0.4 zoo namespace (reference
+requirements.txt:2, introspected at distributed.py:21-23) missing from the
+registry.
+
+Structure follows the torchvision definitions (same branch widths, BN
+``eps=1e-3``, bias-free convs) so top-1 oracles are comparable; TPU-first
+choices are the same as the rest of the zoo: NHWC layout, bf16-capable
+compute ``dtype`` with f32 BN statistics and an f32 classifier head.
+
+Deliberate deltas (documented, not silent):
+
+- **Aux classifiers are off by default** (``aux_logits=False``).  The
+  reference's harness feeds a single logits tensor to the criterion
+  (reference distributed.py:250-251); torchvision's train-mode tuple output
+  would crash it.  With ``aux_logits=True`` the aux parameter trees exist
+  (created at init, shapes input-size-independent) but the aux *compute*
+  runs only under ``capture_aux=True``, which returns the aux logits for
+  users who want the regularizer; ordinary forwards return main logits only
+  and pay nothing for the heads.
+- ``ceil_mode=True`` max pools are emulated with asymmetric (0,1) padding —
+  identical arithmetic for the 224/299 input sizes these nets define
+  (flax pools pad with ``-inf`` so the extra column never wins the max).
+- torchvision's ``transform_input`` renormalization (a pretrained-weights
+  compatibility shim) is not replicated; inputs follow the framework's own
+  normalization pipeline (data/transforms.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.models.simple import _adaptive_avg_pool
+
+
+class BasicConv2d(nn.Module):
+    """conv(bias=False) + BN(eps=1e-3) + ReLU — both nets' unit cell."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = ((0, 0), (0, 0))
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.features, self.kernel, self.strides, padding=self.padding,
+            use_bias=False, dtype=self.dtype, name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-3,
+            dtype=self.dtype, name="bn",
+        )(x)
+        return nn.relu(x)
+
+
+def _ceil_max_pool(x):
+    """3x3/s2 max pool with torch ``ceil_mode=True`` arithmetic."""
+    return nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(0, 1), (0, 1)])
+
+
+# ------------------------------------------------------------------ GoogLeNet
+class _Inception(nn.Module):
+    """GoogLeNet inception block: 1x1 / 1x1→3x3 / 1x1→3x3 / pool→1x1.
+
+    (torchvision implements the historical "5x5" branch as 3x3 — a known,
+    kept quirk; widths below match it.)
+    """
+
+    c1: int
+    c3r: int
+    c3: int
+    c5r: int
+    c5: int
+    cp: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        b1 = conv(self.c1, (1, 1), name="branch1")(x, train)
+        b2 = conv(self.c3r, (1, 1), name="branch2_0")(x, train)
+        b2 = conv(self.c3, (3, 3), padding=((1, 1), (1, 1)),
+                  name="branch2_1")(b2, train)
+        b3 = conv(self.c5r, (1, 1), name="branch3_0")(x, train)
+        b3 = conv(self.c5, (3, 3), padding=((1, 1), (1, 1)),
+                  name="branch3_1")(b3, train)
+        b4 = nn.max_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)])
+        b4 = conv(self.cp, (1, 1), name="branch4_1")(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class _GoogLeNetAux(nn.Module):
+    """Aux head: adaptive-4x4-avg-pool → 1x1 conv 128 → fc1024 → dropout .7
+    → fc (torchvision geometry; adaptive pool keeps the fc1 shape 2048
+    whatever the input size)."""
+
+    num_classes: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = _adaptive_avg_pool(x, 4)
+        x = BasicConv2d(128, (1, 1), dtype=self.dtype, name="conv")(x, train)
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.relu(nn.Dense(1024, name="fc1")(x))
+        x = nn.Dropout(0.7, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, name="fc2")(x)
+
+
+class GoogLeNet(nn.Module):
+    """GoogLeNet (Inception v1), torchvision widths."""
+
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, capture_aux: bool = False):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        inc = functools.partial(_Inception, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(64, (7, 7), (2, 2), ((3, 3), (3, 3)), name="conv1")(x, train)
+        x = _ceil_max_pool(x)
+        x = conv(64, (1, 1), name="conv2")(x, train)
+        x = conv(192, (3, 3), padding=((1, 1), (1, 1)), name="conv3")(x, train)
+        x = _ceil_max_pool(x)
+        x = inc(64, 96, 128, 16, 32, 32, name="inception3a")(x, train)
+        x = inc(128, 128, 192, 32, 96, 64, name="inception3b")(x, train)
+        x = _ceil_max_pool(x)
+        x = inc(192, 96, 208, 16, 48, 64, name="inception4a")(x, train)
+        aux1 = aux2 = None
+        # Aux heads materialize their params at init but skip the (discarded)
+        # compute on ordinary forwards — only capture_aux pays for them.
+        want_aux = self.aux_logits and (capture_aux or self.is_initializing())
+        if want_aux:
+            aux1 = _GoogLeNetAux(self.num_classes, self.dtype,
+                                 name="aux1")(x, train)
+        x = inc(160, 112, 224, 24, 64, 64, name="inception4b")(x, train)
+        x = inc(128, 128, 256, 24, 64, 64, name="inception4c")(x, train)
+        x = inc(112, 144, 288, 32, 64, 64, name="inception4d")(x, train)
+        if want_aux:
+            aux2 = _GoogLeNetAux(self.num_classes, self.dtype,
+                                 name="aux2")(x, train)
+        x = inc(256, 160, 320, 32, 128, 128, name="inception4e")(x, train)
+        x = _ceil_max_pool(x)
+        x = inc(256, 160, 320, 32, 128, 128, name="inception5a")(x, train)
+        x = inc(384, 192, 384, 48, 128, 128, name="inception5b")(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32)
+        )
+        if capture_aux and self.aux_logits:
+            return logits, (aux1, aux2)
+        return logits
+
+
+# --------------------------------------------------------------- Inception v3
+class _InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        b1 = conv(64, (1, 1), name="branch1x1")(x, train)
+        b5 = conv(48, (1, 1), name="branch5x5_1")(x, train)
+        b5 = conv(64, (5, 5), padding=((2, 2), (2, 2)),
+                  name="branch5x5_2")(b5, train)
+        b3 = conv(64, (1, 1), name="branch3x3dbl_1")(x, train)
+        b3 = conv(96, (3, 3), padding=((1, 1), (1, 1)),
+                  name="branch3x3dbl_2")(b3, train)
+        b3 = conv(96, (3, 3), padding=((1, 1), (1, 1)),
+                  name="branch3x3dbl_3")(b3, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)],
+                         count_include_pad=True)
+        bp = conv(self.pool_features, (1, 1), name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class _InceptionB(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        b3 = conv(384, (3, 3), (2, 2), name="branch3x3")(x, train)
+        bd = conv(64, (1, 1), name="branch3x3dbl_1")(x, train)
+        bd = conv(96, (3, 3), padding=((1, 1), (1, 1)),
+                  name="branch3x3dbl_2")(bd, train)
+        bd = conv(96, (3, 3), (2, 2), name="branch3x3dbl_3")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class _InceptionC(nn.Module):
+    c7: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        c7 = self.c7
+        p71 = ((0, 0), (3, 3))  # 1x7
+        p17 = ((3, 3), (0, 0))  # 7x1
+        b1 = conv(192, (1, 1), name="branch1x1")(x, train)
+        b7 = conv(c7, (1, 1), name="branch7x7_1")(x, train)
+        b7 = conv(c7, (1, 7), padding=p71, name="branch7x7_2")(b7, train)
+        b7 = conv(192, (7, 1), padding=p17, name="branch7x7_3")(b7, train)
+        bd = conv(c7, (1, 1), name="branch7x7dbl_1")(x, train)
+        bd = conv(c7, (7, 1), padding=p17, name="branch7x7dbl_2")(bd, train)
+        bd = conv(c7, (1, 7), padding=p71, name="branch7x7dbl_3")(bd, train)
+        bd = conv(c7, (7, 1), padding=p17, name="branch7x7dbl_4")(bd, train)
+        bd = conv(192, (1, 7), padding=p71, name="branch7x7dbl_5")(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)],
+                         count_include_pad=True)
+        bp = conv(192, (1, 1), name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class _InceptionD(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        b3 = conv(192, (1, 1), name="branch3x3_1")(x, train)
+        b3 = conv(320, (3, 3), (2, 2), name="branch3x3_2")(b3, train)
+        b7 = conv(192, (1, 1), name="branch7x7x3_1")(x, train)
+        b7 = conv(192, (1, 7), padding=((0, 0), (3, 3)),
+                  name="branch7x7x3_2")(b7, train)
+        b7 = conv(192, (7, 1), padding=((3, 3), (0, 0)),
+                  name="branch7x7x3_3")(b7, train)
+        b7 = conv(192, (3, 3), (2, 2), name="branch7x7x3_4")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class _InceptionE(nn.Module):
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        b1 = conv(320, (1, 1), name="branch1x1")(x, train)
+        b3 = conv(384, (1, 1), name="branch3x3_1")(x, train)
+        b3 = jnp.concatenate([
+            conv(384, (1, 3), padding=((0, 0), (1, 1)),
+                 name="branch3x3_2a")(b3, train),
+            conv(384, (3, 1), padding=((1, 1), (0, 0)),
+                 name="branch3x3_2b")(b3, train),
+        ], axis=-1)
+        bd = conv(448, (1, 1), name="branch3x3dbl_1")(x, train)
+        bd = conv(384, (3, 3), padding=((1, 1), (1, 1)),
+                  name="branch3x3dbl_2")(bd, train)
+        bd = jnp.concatenate([
+            conv(384, (1, 3), padding=((0, 0), (1, 1)),
+                 name="branch3x3dbl_3a")(bd, train),
+            conv(384, (3, 1), padding=((1, 1), (0, 0)),
+                 name="branch3x3dbl_3b")(bd, train),
+        ], axis=-1)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=[(1, 1), (1, 1)],
+                         count_include_pad=True)
+        bp = conv(192, (1, 1), name="branch_pool")(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class _InceptionAux(nn.Module):
+    """v3 aux head: 5x5 avg pool s3 → 128 1x1 → 768 5x5 → fc.
+
+    Kernel shapes are FIXED (conv1 is always 5x5) so the parameter tree is
+    input-size-independent and matches torchvision's at any size; at the
+    canonical 299 input the math is exactly torchvision's (17x17 feature map
+    → 5x5 pooled → VALID 5x5 conv → 1x1).  Smaller maps clamp the pool
+    window and switch conv1 to SAME padding so the head stays well-defined.
+    """
+
+    num_classes: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        H, W = x.shape[1:3]
+        x = nn.avg_pool(x, (min(5, H), min(5, W)), strides=(3, 3))
+        x = BasicConv2d(128, (1, 1), dtype=self.dtype, name="conv0")(x, train)
+        pad = "VALID" if min(x.shape[1:3]) >= 5 else "SAME"
+        x = BasicConv2d(768, (5, 5), padding=pad, dtype=self.dtype,
+                        name="conv1")(x, train)
+        x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+class InceptionV3(nn.Module):
+    """Inception v3 (299x299 canonical input; any size ≥ 75 works — the
+    classifier head is a global mean pool and the aux head clamps its pool
+    window on small feature maps)."""
+
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, capture_aux: bool = False):
+        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(32, (3, 3), (2, 2), name="Conv2d_1a_3x3")(x, train)
+        x = conv(32, (3, 3), name="Conv2d_2a_3x3")(x, train)
+        x = conv(64, (3, 3), padding=((1, 1), (1, 1)),
+                 name="Conv2d_2b_3x3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(80, (1, 1), name="Conv2d_3b_1x1")(x, train)
+        x = conv(192, (3, 3), name="Conv2d_4a_3x3")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _InceptionA(32, self.dtype, name="Mixed_5b")(x, train)
+        x = _InceptionA(64, self.dtype, name="Mixed_5c")(x, train)
+        x = _InceptionA(64, self.dtype, name="Mixed_5d")(x, train)
+        x = _InceptionB(self.dtype, name="Mixed_6a")(x, train)
+        x = _InceptionC(128, self.dtype, name="Mixed_6b")(x, train)
+        x = _InceptionC(160, self.dtype, name="Mixed_6c")(x, train)
+        x = _InceptionC(160, self.dtype, name="Mixed_6d")(x, train)
+        x = _InceptionC(192, self.dtype, name="Mixed_6e")(x, train)
+        aux = None
+        if self.aux_logits and (capture_aux or self.is_initializing()):
+            aux = _InceptionAux(self.num_classes, self.dtype,
+                                name="AuxLogits")(x, train)
+        x = _InceptionD(self.dtype, name="Mixed_7a")(x, train)
+        x = _InceptionE(self.dtype, name="Mixed_7b")(x, train)
+        x = _InceptionE(self.dtype, name="Mixed_7c")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32)
+        )
+        if capture_aux and self.aux_logits:
+            return logits, aux
+        return logits
+
+
+def googlenet(num_classes: int = 1000, dtype: Any = jnp.float32, **kw):
+    return GoogLeNet(num_classes=num_classes, dtype=dtype, **kw)
+
+
+def inception_v3(num_classes: int = 1000, dtype: Any = jnp.float32, **kw):
+    return InceptionV3(num_classes=num_classes, dtype=dtype, **kw)

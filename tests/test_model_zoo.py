@@ -24,6 +24,7 @@ EXPECTED = {
     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
     "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
     "mnasnet0_5", "mnasnet0_75", "mnasnet1_0", "mnasnet1_3",
+    "googlenet", "inception_v3",
 }
 
 
@@ -46,6 +47,54 @@ def test_forward_shapes(arch):
     out = model.apply(variables, x, train=False)
     assert out.shape == (2, 7)
     assert out.dtype == jnp.float32
+
+
+def test_googlenet_forward_and_aux():
+    """96px keeps the test cheap (aux adaptive-pool keeps param shapes
+    size-independent); aux logits are returned only under capture_aux."""
+    model = models.create_model("googlenet", num_classes=5)
+    x = jnp.zeros((2, 96, 96, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 5)
+
+    aux_model = models.create_model("googlenet", num_classes=5, aux_logits=True)
+    variables = aux_model.init(jax.random.PRNGKey(0), x, train=False)
+    logits, (a1, a2) = aux_model.apply(
+        variables, x, train=False, capture_aux=True,
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    assert logits.shape == a1.shape == a2.shape == (2, 5)
+
+
+def test_inception_v3_forward():
+    model = models.create_model("inception_v3", num_classes=5)
+    x = jnp.zeros((1, 96, 96, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 5)
+
+
+def test_inception_v3_aux_small_input_and_stats_tree():
+    """Aux head must init at sub-299 sizes (clamped pool window) and the
+    gated-out aux compute must not change the batch_stats tree structure
+    across a mutable train-mode apply."""
+    model = models.create_model("inception_v3", num_classes=5, aux_logits=True)
+    x = jnp.zeros((1, 96, 96, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits, aux = model.apply(
+        variables, x, train=False, capture_aux=True,
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    assert logits.shape == aux.shape == (1, 5)
+    _, mutated = model.apply(
+        variables, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    assert (
+        jax.tree_util.tree_structure(mutated["batch_stats"])
+        == jax.tree_util.tree_structure(variables["batch_stats"])
+    )
 
 
 def test_dropout_arch_trains():
