@@ -64,6 +64,14 @@ def _ceil_max_pool(x):
     return nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(0, 1), (0, 1)])
 
 
+def _ceil_max_pool2(x):
+    """2x2/s2 max pool with ``ceil_mode=True`` (torchvision GoogLeNet's
+    maxpool4): pad odd spatial dims by one so the last element still forms
+    a window (flax pads with -inf, so padding never wins the max)."""
+    ph, pw = x.shape[1] % 2, x.shape[2] % 2
+    return nn.max_pool(x, (2, 2), strides=(2, 2), padding=[(0, ph), (0, pw)])
+
+
 # ------------------------------------------------------------------ GoogLeNet
 class _Inception(nn.Module):
     """GoogLeNet inception block: 1x1 / 1x1→3x3 / 1x1→3x3 / pool→1x1.
@@ -148,7 +156,7 @@ class GoogLeNet(nn.Module):
             aux2 = _GoogLeNetAux(self.num_classes, self.dtype,
                                  name="aux2")(x, train)
         x = inc(256, 160, 320, 32, 128, 128, name="inception4e")(x, train)
-        x = _ceil_max_pool(x)
+        x = _ceil_max_pool2(x)
         x = inc(256, 160, 320, 32, 128, 128, name="inception5a")(x, train)
         x = inc(384, 192, 384, 48, 128, 128, name="inception5b")(x, train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool

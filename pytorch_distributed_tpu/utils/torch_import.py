@@ -1,0 +1,184 @@
+"""Import torch/torchvision ResNet checkpoints into this framework.
+
+Migration path for users of the reference: its recipes save
+``checkpoint.pth.tar`` holding ``{'epoch', 'arch', 'state_dict', 'best_acc1'}``
+(reference distributed.py:219-225, 327-330) where ``state_dict`` is a
+torchvision ResNet in torch naming/layout.  This module converts that tree —
+or a bare torchvision ``model.state_dict()`` / downloaded zoo weights file —
+into this framework's flax variables, so ``--pretrained`` and ``--resume``
+work on checkpoints produced by the reference (reference ``--pretrained``
+pulls the same torchvision weights, distributed.py:95-98,134-136).
+
+Scope: the ResNet family (resnet18/34/50/101/152, wide_*, resnext_*) — the
+arch surface of BASELINE.json and every reference launch line.  The block
+structure is derived from the state_dict itself (``conv3`` presence ⇒
+Bottleneck; block count by key scan), so any torchvision-shaped ResNet
+variant imports without an arch table.
+
+Layout conversions (torch → flax/TPU):
+- conv ``weight`` OIHW → HWIO ``kernel`` (grouped convs keep the same
+  transpose: torch [O, I/g, kh, kw] → flax [kh, kw, I/g, O]);
+- linear ``weight`` [out, in] → ``kernel`` [in, out];
+- BN ``weight/bias/running_mean/running_var`` →
+  ``scale/bias`` (params) + ``mean/var`` (batch_stats);
+  ``num_batches_tracked`` is dropped (torch bookkeeping with no flax
+  equivalent — EMA momentum is a constant here).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+
+def _np(x: Any) -> np.ndarray:
+    """Accept torch tensors or arrays without importing torch."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def unwrap_reference_checkpoint(payload: Mapping) -> Tuple[Mapping, Dict]:
+    """Split a loaded reference checkpoint into (state_dict, meta).
+
+    Accepts the reference's payload dict (distributed.py:219-225), a bare
+    state_dict, and DataParallel/DDP ``module.``-prefixed keys.
+    """
+    meta: Dict[str, Any] = {}
+    sd = payload
+    if "state_dict" in payload and not hasattr(payload["state_dict"], "shape"):
+        sd = payload["state_dict"]
+        for k in ("epoch", "arch", "best_acc1"):
+            if k in payload:
+                v = payload[k]
+                # best_acc1 may be a 0-d (or shape-(1,)) tensor in reference
+                # checkpoints (distributed.py:214 keeps it as a tensor).
+                meta[k] = float(_np(v).reshape(())) if k == "best_acc1" else v
+    sd = {re.sub(r"^module\.", "", k): v for k, v in sd.items()}
+    return sd, meta
+
+
+def _conv(sd: Mapping, key: str) -> np.ndarray:
+    # f32 cast: a half-precision checkpoint (model.half()) must not smuggle
+    # fp16 master weights into the f32 param tree.
+    return _np(sd[key]).transpose(2, 3, 1, 0).astype(np.float32)  # OIHW->HWIO
+
+
+def _bn(sd: Mapping, prefix: str):
+    params = {
+        "scale": _np(sd[f"{prefix}.weight"]).astype(np.float32),
+        "bias": _np(sd[f"{prefix}.bias"]).astype(np.float32),
+    }
+    stats = {
+        "mean": _np(sd[f"{prefix}.running_mean"]).astype(np.float32),
+        "var": _np(sd[f"{prefix}.running_var"]).astype(np.float32),
+    }
+    return params, stats
+
+
+def import_resnet_state_dict(state_dict: Mapping) -> Dict[str, Dict]:
+    """torchvision-ResNet state_dict → ``{"params", "batch_stats"}``.
+
+    Raises ``KeyError``/``ValueError`` with the offending key on anything
+    that is not torchvision-ResNet-shaped.
+    """
+    sd = {re.sub(r"^module\.", "", k): v for k, v in state_dict.items()}
+    if "conv1.weight" not in sd:
+        raise ValueError(
+            "not a torchvision ResNet state_dict: missing 'conv1.weight' "
+            f"(got keys like {sorted(sd)[:3]}...)"
+        )
+    params: Dict[str, Any] = {"conv_init": {"kernel": _conv(sd, "conv1.weight")}}
+    stats: Dict[str, Any] = {}
+    params["bn_init"], stats["bn_init"] = _bn(sd, "bn1")
+
+    # Discover stage/block structure from the keys.
+    block_re = re.compile(r"^layer(\d+)\.(\d+)\.conv1\.weight$")
+    stages: Dict[int, int] = {}
+    for k in sd:
+        m = block_re.match(k)
+        if m:
+            s, i = int(m.group(1)), int(m.group(2))
+            stages[s] = max(stages.get(s, 0), i + 1)
+    if sorted(stages) != list(range(1, len(stages) + 1)):
+        raise ValueError(f"non-contiguous layer indices: {sorted(stages)}")
+    bottleneck = "layer1.0.conv3.weight" in sd
+    block_cls = "Bottleneck" if bottleneck else "BasicBlock"
+    n_convs = 3 if bottleneck else 2
+
+    k_global = 0
+    for s in sorted(stages):
+        for i in range(stages[s]):
+            t = f"layer{s}.{i}"
+            name = f"{block_cls}_{k_global}"
+            bp: Dict[str, Any] = {}
+            bs: Dict[str, Any] = {}
+            for c in range(n_convs):
+                bp[f"Conv_{c}"] = {"kernel": _conv(sd, f"{t}.conv{c + 1}.weight")}
+                (bp[f"FusedBatchNormAct_{c}"],
+                 bs[f"FusedBatchNormAct_{c}"]) = _bn(sd, f"{t}.bn{c + 1}")
+            if f"{t}.downsample.0.weight" in sd:
+                bp[f"Conv_{n_convs}"] = {
+                    "kernel": _conv(sd, f"{t}.downsample.0.weight")
+                }
+                (bp[f"FusedBatchNormAct_{n_convs}"],
+                 bs[f"FusedBatchNormAct_{n_convs}"]) = _bn(
+                    sd, f"{t}.downsample.1")
+            params[name] = bp
+            stats[name] = bs
+            k_global += 1
+
+    params["fc"] = {
+        "kernel": _np(sd["fc.weight"]).transpose(1, 0).astype(np.float32),
+        "bias": _np(sd["fc.bias"]).astype(np.float32),
+    }
+    return {"params": params, "batch_stats": stats}
+
+
+def import_torch_checkpoint(payload: Mapping) -> Tuple[Dict[str, Dict], Dict]:
+    """Reference ``checkpoint.pth.tar`` payload (already ``torch.load``-ed)
+    → ``(variables, meta)``."""
+    sd, meta = unwrap_reference_checkpoint(payload)
+    return import_resnet_state_dict(sd), meta
+
+
+def save_as_pretrained(
+    directory: str, arch: str, variables: Dict[str, Dict], meta: Dict
+) -> str:
+    """Write imported variables as ``<dir>/<arch>.msgpack`` in the trainer's
+    checkpoint format, so ``--pretrained`` finds it
+    (train/trainer.py _load_pretrained)."""
+    import os
+
+    from flax import serialization
+
+    params = variables["params"]
+    payload = {
+        "epoch": int(meta.get("epoch", 0)),
+        "arch": arch,
+        "best_acc1": float(meta.get("best_acc1", 0.0)),
+        "state": {
+            "step": np.int32(0),
+            "params": params,
+            "batch_stats": variables["batch_stats"],
+            # torch-parity SGD momentum buffers start at zero
+            # (train/optim.py sgd_init).
+            "momentum": _tree_zeros(params),
+        },
+    }
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{arch}.msgpack")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(payload))
+    os.replace(tmp, path)
+    return path
+
+
+def _tree_zeros(tree: Any) -> Any:
+    if isinstance(tree, Mapping):
+        return {k: _tree_zeros(v) for k, v in tree.items()}
+    a = _np(tree)
+    return np.zeros_like(a, dtype=np.float32)

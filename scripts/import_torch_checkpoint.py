@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Convert a reference/torchvision ResNet checkpoint to this framework.
+
+Usage:
+    python scripts/import_torch_checkpoint.py \
+        --input checkpoint.pth.tar --arch resnet50 --out-dir pretrained
+
+Reads the reference's ``checkpoint.pth.tar`` (payload layout of reference
+distributed.py:219-225) or a bare torchvision ``state_dict`` file, converts
+layouts (see utils/torch_import.py), validates the tree against a fresh
+``create_model(arch)`` init, and writes ``<out-dir>/<arch>.msgpack`` — ready
+for ``--pretrained`` (with ``PTD_TPU_PRETRAINED_DIR=<out-dir>``).
+"""
+
+import argparse
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input", required=True, help="torch .pth/.pth.tar file")
+    ap.add_argument("--arch", default=None,
+                    help="arch name (defaults to the checkpoint's own "
+                         "'arch' field)")
+    ap.add_argument("--out-dir", default="pretrained")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    args = ap.parse_args()
+
+    import torch  # CPU build is enough
+
+    payload = torch.load(args.input, map_location="cpu", weights_only=False)
+    from pytorch_distributed_tpu.utils.torch_import import (
+        import_torch_checkpoint, save_as_pretrained,
+    )
+
+    variables, meta = import_torch_checkpoint(payload)
+    arch = args.arch or meta.get("arch")
+    if not arch:
+        sys.exit("--arch required: checkpoint has no 'arch' field")
+
+    # Validate against a fresh init of the same arch (shape + structure).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu import models
+
+    model = models.create_model(arch, num_classes=args.num_classes)
+    ref = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+    )
+    for coll in ("params", "batch_stats"):
+        import flax
+
+        want = flax.traverse_util.flatten_dict(ref[coll])
+        got = flax.traverse_util.flatten_dict(variables[coll])
+        if set(want) != set(got):
+            missing = sorted("/".join(k) for k in set(want) - set(got))[:5]
+            extra = sorted("/".join(k) for k in set(got) - set(want))[:5]
+            sys.exit(f"{coll} tree mismatch vs {arch}: "
+                     f"missing={missing} extra={extra}")
+        for k in want:
+            if tuple(want[k].shape) != tuple(got[k].shape):
+                sys.exit(f"shape mismatch at {'/'.join(k)}: "
+                         f"checkpoint {got[k].shape} vs model {want[k].shape}")
+
+    path = save_as_pretrained(args.out_dir, arch, variables, meta)
+    print(f"wrote {path} (epoch={meta.get('epoch', 0)}, "
+          f"best_acc1={meta.get('best_acc1', 0.0)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

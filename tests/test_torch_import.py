@@ -1,0 +1,201 @@
+"""Torch-checkpoint import: layout conversion + numerical architecture parity.
+
+The strongest correctness oracle available without network egress: build a
+random torchvision-shaped resnet18 state_dict, run it through an independent
+torch-functional forward (eval semantics), import it with
+utils/torch_import.py, and require this framework's resnet18 eval forward to
+produce the same logits.  Any stride/padding/layout/BN mismatch between our
+flax ResNet and the torchvision definition (the arch the reference
+instantiates, reference distributed.py:134-139) shows up here as a numeric
+diff — architecture parity becomes a tested property instead of a claim.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu import models
+from pytorch_distributed_tpu.utils.torch_import import (
+    import_resnet_state_dict,
+    import_torch_checkpoint,
+    save_as_pretrained,
+)
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+_R18_STAGES = [2, 2, 2, 2]
+
+
+def _rand_resnet18_state_dict(num_classes=13, seed=0):
+    """torchvision-resnet18-shaped random weights (torch tensors)."""
+    g = torch.Generator().manual_seed(seed)
+
+    def w(*shape, scale=0.1):
+        return torch.randn(*shape, generator=g) * scale
+
+    sd = {"conv1.weight": w(64, 3, 7, 7)}
+
+    def bn(prefix, c):
+        sd[f"{prefix}.weight"] = 1.0 + 0.1 * torch.randn(c, generator=g)
+        sd[f"{prefix}.bias"] = 0.1 * torch.randn(c, generator=g)
+        sd[f"{prefix}.running_mean"] = 0.1 * torch.randn(c, generator=g)
+        sd[f"{prefix}.running_var"] = (0.5 + torch.rand(c, generator=g))
+        sd[f"{prefix}.num_batches_tracked"] = torch.tensor(7)
+
+    bn("bn1", 64)
+    widths = [64, 128, 256, 512]
+    in_c = 64
+    for s, (blocks, c) in enumerate(zip(_R18_STAGES, widths), start=1):
+        for i in range(blocks):
+            t = f"layer{s}.{i}"
+            stride_block = s > 1 and i == 0
+            sd[f"{t}.conv1.weight"] = w(c, in_c, 3, 3)
+            bn(f"{t}.bn1", c)
+            sd[f"{t}.conv2.weight"] = w(c, c, 3, 3)
+            bn(f"{t}.bn2", c)
+            if stride_block:
+                sd[f"{t}.downsample.0.weight"] = w(c, in_c, 1, 1)
+                bn(f"{t}.downsample.1", c)
+            in_c = c
+    sd["fc.weight"] = w(num_classes, 512)
+    sd["fc.bias"] = 0.1 * torch.randn(num_classes, generator=g)
+    return sd
+
+
+def _torch_resnet18_eval(sd, x):
+    """Independent torch-functional eval forward (torchvision semantics:
+    stride on the first block of stages 2-4, BN eps 1e-5, 3x3/s2/p1
+    maxpool, global avg pool, linear head)."""
+
+    def bn(h, p):
+        return F.batch_norm(
+            h, sd[f"{p}.running_mean"], sd[f"{p}.running_var"],
+            sd[f"{p}.weight"], sd[f"{p}.bias"], training=False, eps=1e-5,
+        )
+
+    h = F.conv2d(x, sd["conv1.weight"], stride=2, padding=3)
+    h = F.relu(bn(h, "bn1"))
+    h = F.max_pool2d(h, 3, stride=2, padding=1)
+    for s, blocks in enumerate(_R18_STAGES, start=1):
+        for i in range(blocks):
+            t = f"layer{s}.{i}"
+            stride = 2 if (s > 1 and i == 0) else 1
+            idn = h
+            out = F.conv2d(h, sd[f"{t}.conv1.weight"], stride=stride,
+                           padding=1)
+            out = F.relu(bn(out, f"{t}.bn1"))
+            out = F.conv2d(out, sd[f"{t}.conv2.weight"], padding=1)
+            out = bn(out, f"{t}.bn2")
+            if f"{t}.downsample.0.weight" in sd:
+                idn = bn(
+                    F.conv2d(h, sd[f"{t}.downsample.0.weight"], stride=stride),
+                    f"{t}.downsample.1",
+                )
+            h = F.relu(out + idn)
+    h = h.mean(dim=(2, 3))
+    return h @ sd["fc.weight"].T + sd["fc.bias"]
+
+
+def test_resnet18_forward_parity_with_torch():
+    sd = _rand_resnet18_state_dict()
+    variables = import_resnet_state_dict(sd)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 64, 64, 3)).astype(np.float32)
+
+    with torch.no_grad():
+        want = _torch_resnet18_eval(
+            sd, torch.from_numpy(x.transpose(0, 3, 1, 2))
+        ).numpy()
+
+    model = models.create_model("resnet18", num_classes=13)
+    got = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-3)
+
+
+def test_reference_payload_unwrap_and_pretrained_roundtrip(tmp_path):
+    """Reference checkpoint layout {'epoch','arch','state_dict','best_acc1'}
+    with DDP 'module.' prefixes imports, saves as <arch>.msgpack, and loads
+    back through the framework's own load_checkpoint."""
+    sd = _rand_resnet18_state_dict(seed=1)
+    payload = {
+        "epoch": 3,
+        "arch": "resnet18",
+        "best_acc1": torch.tensor(71.25),
+        "state_dict": {f"module.{k}": v for k, v in sd.items()},
+    }
+    variables, meta = import_torch_checkpoint(payload)
+    assert meta == {"epoch": 3, "arch": "resnet18", "best_acc1": 71.25}
+
+    path = save_as_pretrained(str(tmp_path), "resnet18", variables, meta)
+
+    from pytorch_distributed_tpu.train.checkpoint import load_checkpoint
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+
+    model = models.create_model("resnet18", num_classes=13)
+    init = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)),
+                      train=False)
+    template = TrainState.create(init, sgd_init(init["params"]))
+    state, meta2 = load_checkpoint(path, template)
+    assert meta2["arch"] == "resnet18" and meta2["best_acc1"] == 71.25
+    got = np.asarray(
+        state.params["conv_init"]["kernel"]
+    )
+    want = sd["conv1.weight"].numpy().transpose(2, 3, 1, 0)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_bottleneck_structure_import_matches_model_tree():
+    """resnet50-shaped keys (conv3 ⇒ Bottleneck) produce exactly the
+    flax tree create_model('resnet50') builds."""
+    import flax
+
+    g = torch.Generator().manual_seed(2)
+
+    def w(*shape):
+        return torch.randn(*shape, generator=g) * 0.05
+
+    sd = {"conv1.weight": w(64, 3, 7, 7)}
+
+    def bn(prefix, c):
+        for k, v in (("weight", torch.ones(c)), ("bias", torch.zeros(c)),
+                     ("running_mean", torch.zeros(c)),
+                     ("running_var", torch.ones(c))):
+            sd[f"{prefix}.{k}"] = v
+
+    bn("bn1", 64)
+    stages, widths = [3, 4, 6, 3], [64, 128, 256, 512]
+    in_c = 64
+    for s, (blocks, c) in enumerate(zip(stages, widths), start=1):
+        for i in range(blocks):
+            t = f"layer{s}.{i}"
+            stride_block = i == 0
+            sd[f"{t}.conv1.weight"] = w(c, in_c, 1, 1)
+            bn(f"{t}.bn1", c)
+            sd[f"{t}.conv2.weight"] = w(c, c, 3, 3)
+            bn(f"{t}.bn2", c)
+            sd[f"{t}.conv3.weight"] = w(4 * c, c, 1, 1)
+            bn(f"{t}.bn3", 4 * c)
+            if stride_block:
+                sd[f"{t}.downsample.0.weight"] = w(4 * c, in_c, 1, 1)
+                bn(f"{t}.downsample.1", 4 * c)
+            in_c = 4 * c
+    sd["fc.weight"] = w(5, 2048)
+    sd["fc.bias"] = torch.zeros(5)
+
+    variables = import_resnet_state_dict(sd)
+    model = models.create_model("resnet50", num_classes=5)
+    ref = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), train=False)
+    )
+    for coll in ("params", "batch_stats"):
+        want = flax.traverse_util.flatten_dict(ref[coll])
+        got = flax.traverse_util.flatten_dict(variables[coll])
+        assert set(want) == set(got), coll
+        for k in want:
+            assert tuple(want[k].shape) == tuple(got[k].shape), k
