@@ -52,6 +52,9 @@ class Config:
     local_rank: int = -1  # launch-line parity only; unused on TPU
     image_size: int = 224
     num_classes: int = 1000
+    # ResNet stem variant: "space_to_depth" is the MLPerf-style packed stem
+    # (identical math/params, faster MXU tiling); other archs ignore it.
+    stem: str = "conv7"
     resume: Optional[str] = None
     checkpoint_dir: str = "."
     ckpt_backend: str = "msgpack"
@@ -135,6 +138,10 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")
+    p.add_argument("--stem", default=d.stem,
+                   choices=("conv7", "space_to_depth"),
+                   help="ResNet stem: torchvision conv7 or the numerically "
+                   "identical space-to-depth packing (TPU MXU-friendly)")
     return p
 
 

@@ -72,8 +72,17 @@ class Trainer:
         self._build_data()
 
         dtype = jnp.bfloat16 if cfg.precision == "bf16" else jnp.float32
+        # --stem is a ResNet-family knob; only forwarded when non-default.
+        extra = {} if cfg.stem == "conv7" else {"stem": cfg.stem}
+        if extra and getattr(
+            models._REGISTRY.get(cfg.arch), "func", None
+        ) is not models.ResNet:
+            raise ValueError(
+                f"--stem {cfg.stem} only applies to the ResNet family; "
+                f"arch {cfg.arch!r} has no stem variant"
+            )
         self.model = models.create_model(
-            cfg.arch, num_classes=cfg.num_classes, dtype=dtype
+            cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra
         )
 
         seed = cfg.seed if cfg.seed is not None else 0

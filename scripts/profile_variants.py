@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""A/B the ResNet-50 train-step variants on the real chip.
+
+Variants: conv7 vs space_to_depth stem, batch 256 vs 512.  Run on TPU:
+    python scripts/profile_variants.py [b256,b512,s2d256,s2d512]
+Prints ms/step and img/s for each; use to decide what bench.py should run.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench(name, batch, stem):
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+
+    image = 224
+    mesh = data_parallel_mesh()
+    model = models.create_model(
+        "resnet50", num_classes=1000, dtype=jnp.bfloat16, stem=stem
+    )
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)), train=False
+    )
+    state = TrainState.create(variables, sgd_init(variables["params"]))
+    step = make_train_step(model, mesh)
+    rng = np.random.default_rng(0)
+    b = {
+        "images": jnp.asarray(
+            rng.normal(size=(batch, image, image, 3)).astype(np.float32)
+        ),
+        "labels": jnp.asarray(rng.integers(0, 1000, size=batch).astype(np.int32)),
+        "weights": jnp.ones((batch,), jnp.float32),
+    }
+    lr = jnp.float32(0.1)
+    for _ in range(3):
+        state, met = step(state, b, lr)
+    float(met["loss"])
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, met = step(state, b, lr)
+    float(met["loss"])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name}: {dt*1e3:.1f} ms/step -> {batch/dt:.0f} img/s", flush=True)
+
+
+VARIANTS = {
+    "b256": (256, "conv7"),
+    "b512": (512, "conv7"),
+    "s2d256": (256, "space_to_depth"),
+    "s2d512": (512, "space_to_depth"),
+}
+
+if __name__ == "__main__":
+    names = sys.argv[1].split(",") if len(sys.argv) > 1 else list(VARIANTS)
+    for n in names:
+        bench(n, *VARIANTS[n])

@@ -134,6 +134,28 @@ def test_vgg_trains_through_explicit_collectives():
     assert np.isfinite(float(m["loss"]))
 
 
+def test_space_to_depth_stem_equivalence():
+    """The packed stem must be numerically identical to the conv7 stem on
+    the SAME parameters (both read conv_init/kernel (7,7,3,64))."""
+    m_std = models.create_model("resnet50", num_classes=6)
+    m_s2d = models.create_model("resnet50", num_classes=6,
+                                stem="space_to_depth")
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 64, 64, 3)).astype(np.float32)
+    )
+    variables = m_std.init(jax.random.PRNGKey(0), x, train=False)
+    v2 = m_s2d.init(jax.random.PRNGKey(0), x, train=False)
+    assert (
+        jax.tree_util.tree_structure(v2) ==
+        jax.tree_util.tree_structure(variables)
+    )
+    out_std = m_std.apply(variables, x, train=False)
+    out_s2d = m_s2d.apply(variables, x, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_s2d), np.asarray(out_std), rtol=2e-4, atol=2e-5
+    )
+
+
 def test_adaptive_avg_pool_matches_torch():
     """Non-divisible sizes must follow torch AdaptiveAvgPool2d bin edges
     (regression: earlier fallback collapsed to a global mean)."""
