@@ -10,7 +10,18 @@ our images/sec/chip divided by that per-device number.
 
 Synthetic in-device data (no host IO) so the number isolates the compiled
 step: forward + loss + backward + SGD update at global batch 256, bf16
-compute policy — the same step the tpu_native recipe runs.
+compute policy — the same step the tpu_native recipe runs, with the
+space-to-depth stem (mathematically identical to conv7, see models/resnet.py)
+and bf16 image feed (what the u8-wire loader path delivers after device-side
+normalize).
+
+Roofline note (round-2 profile, scripts/profile_trace.py on the real v5e):
+the step moves ~68 GB/step at ~690-750 GB/s effective against a ~819 GB/s
+HBM peak — ResNet-50 b256 bf16 is **memory-bound** on this chip (arithmetic
+intensity ~29-60 FLOP/byte vs the chip's ~240 balance point), so throughput
+is capped near ~3,080 img/s at current traffic; conv fusions alone account
+for 55.4 GB/step already running at 699 GB/s.  Batch 512, larger scoped
+VMEM, and f32 feeds all measured slower (scripts/bench_variants.py).
 """
 
 import json
@@ -65,7 +76,9 @@ def main() -> None:
     image = 224
     _require_devices()
     mesh = data_parallel_mesh()
-    model = models.create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    model = models.create_model(
+        "resnet50", num_classes=1000, dtype=jnp.bfloat16, stem="space_to_depth"
+    )
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)), train=False
     )
@@ -75,7 +88,7 @@ def main() -> None:
     rng = np.random.default_rng(0)
     device_batch = {
         "images": jnp.asarray(
-            rng.normal(size=(batch, image, image, 3)).astype(np.float32)
+            rng.normal(size=(batch, image, image, 3)), dtype=jnp.bfloat16
         ),
         "labels": jnp.asarray(rng.integers(0, 1000, size=batch).astype(np.int32)),
         "weights": jnp.ones((batch,), jnp.float32),

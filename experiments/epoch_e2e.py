@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Real-data epoch wall-clock: the reference's only published experiment,
+end-to-end on this framework.
+
+The reference times full ImageNet epochs (per-epoch CSV, reference
+dataparallel.py:205-213) — JPEG decode, augmentation, H2D, train step.
+This experiment does the same on a synthetic ImageNet-shaped JPEG
+ImageFolder: real decode (PIL or the native C++ plane), real augmentation,
+real async DeviceFeeder into the real compiled train step, one timed epoch
+per wire mode.
+
+Writes RESULTS_epoch.json.  Run on the TPU chip:
+    PYTHONPATH=/root/repo python experiments/epoch_e2e.py
+
+Honest-scaling note recorded in the output: this host has os.cpu_count()
+cores (1 in the bench container, vs a real TPU-VM's ~100+); the loader
+ceiling measured in RESULTS_loader.json is per-core, so the epoch number
+here is host-IO-bound by construction.  The "compute_only_s" column is what
+the same epoch costs with the chip never starving (step time × steps), i.e.
+the epoch time on a host with enough loader cores.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_IMAGES = int(os.environ.get("EPOCH_IMAGES", "2048"))
+SRC = int(os.environ.get("EPOCH_SRC", "320"))
+BATCH = int(os.environ.get("EPOCH_BATCH", "128"))
+IMAGE = 224
+ARCH = os.environ.get("EPOCH_ARCH", "resnet50")
+
+
+def make_tree(root: str, n: int) -> int:
+    """Writes ~n JPEGs over 8 classes; returns the actual count written."""
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    per = n // 8
+    for c in range(8):
+        d = os.path.join(root, "train", f"c{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per):
+            arr = rng.integers(0, 256, size=(SRC, SRC, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(
+                os.path.join(d, f"{i:04d}.jpg"), quality=85)
+    return per * 8
+
+
+def run_epoch(root: str, mode: str, kind: str, step, state, lr, feeder,
+              workers: int):
+    from pytorch_distributed_tpu.data import DataLoader, ImageFolder
+    from pytorch_distributed_tpu.data import transforms as T
+
+    tf = None if kind == "native" else T.train_transform_u8(IMAGE)
+    ds = ImageFolder(os.path.join(root, "train"), transform=tf,
+                     native_decode=kind == "native", image_size=IMAGE)
+    loader = DataLoader(ds, BATCH, num_workers=workers, drop_last=True,
+                        batch_mode=mode, random_flip=True)
+    # Warm: compile + fill the prefetch queue, then stop the feeder early
+    # (a few batches — not a full decode epoch).
+    it = feeder(iter(loader))
+    state, met = step(state, next(it), lr)
+    float(met["loss"])
+    for _ in itertools.islice(it, 2):
+        pass
+    close = getattr(it, "close", None)
+    if close:
+        close()
+    # Timed epoch.
+    t0 = time.perf_counter()
+    steps = 0
+    for batch in feeder(iter(loader)):
+        state, met = step(state, batch, lr)
+        steps += 1
+    assert np.isfinite(float(met["loss"]))  # drains the device queue
+    dt = time.perf_counter() - t0
+    return state, dt, steps
+
+
+def main() -> int:
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.data.loader import DeviceFeeder
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+
+    workers = int(os.environ.get("EPOCH_WORKERS", str(os.cpu_count() or 1)))
+    mesh = data_parallel_mesh()
+    model = models.create_model(ARCH, num_classes=1000, dtype=jnp.bfloat16,
+                                stem="space_to_depth")
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, IMAGE, IMAGE, 3)), train=False)
+    # The train step donates its state argument; every epoch needs a fresh
+    # device tree, so keep the initial variables on host.
+    host_vars = jax.tree.map(np.asarray, variables)
+
+    def fresh_state():
+        v = jax.tree.map(jnp.asarray, host_vars)
+        return TrainState.create(v, sgd_init(v["params"]))
+
+    step = make_train_step(model, mesh)
+    feeder = DeviceFeeder(mesh)
+    lr = jnp.float32(0.1)
+
+    # Chip-only step time for the compute_only_s column.
+    rng = np.random.default_rng(0)
+    dev_b = {
+        "images": jnp.asarray(rng.normal(size=(BATCH, IMAGE, IMAGE, 3)),
+                              dtype=jnp.bfloat16),
+        "labels": jnp.asarray(rng.integers(0, 1000, BATCH).astype(np.int32)),
+        "weights": jnp.ones((BATCH,), jnp.float32),
+    }
+    st = fresh_state()
+    for _ in range(3):
+        st, met = step(st, dev_b, lr)
+    float(met["loss"])
+    t0 = time.perf_counter()
+    for _ in range(10):
+        st, met = step(st, dev_b, lr)
+    float(met["loss"])
+    step_s = (time.perf_counter() - t0) / 10
+
+    results = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        make_tree(tmp, N_IMAGES)
+        for name, mode, kind in (
+            ("pil_u8_host", "u8_host", "u8"),
+            ("pil_u8_wire", "u8_wire", "u8"),
+            ("native_u8_wire", "u8_wire", "native"),
+        ):
+            state = fresh_state()
+            try:
+                state, dt, steps = run_epoch(
+                    tmp, mode, kind, step, state, lr, feeder, workers)
+            except Exception as e:  # native .so may be absent
+                print(f"{name}: SKIP ({e})", flush=True)
+                continue
+            imgs = steps * BATCH
+            results[name] = {
+                "epoch_s": round(dt, 2),
+                "img_per_sec": round(imgs / dt, 1),
+                "steps": steps,
+                "compute_only_s": round(steps * step_s, 2),
+            }
+            print(f"{name}: {dt:.1f} s epoch ({imgs / dt:,.0f} img/s; "
+                  f"compute-only {steps * step_s:.1f} s)", flush=True)
+
+    out = {
+        "meta": {
+            "images": N_IMAGES, "src_px": SRC, "batch": BATCH, "arch": ARCH,
+            "workers": workers, "cpus": os.cpu_count(),
+            "platform": jax.default_backend(),
+            "chip_step_ms": round(step_s * 1e3, 2),
+            "note": "per-epoch wall-clock incl. JPEG decode/augment/H2D "
+                    "(reference methodology, dataparallel.py:205-213); this "
+                    "host is loader-bound at 1 core — compute_only_s is the "
+                    "same epoch with enough loader cores",
+        },
+        "epochs": results,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "..", "RESULTS_epoch.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Time ResNet-50 train-step variants on the real chip.
+
+Variants probe the levers the round-2 profile surfaced (the step is
+HBM-roofline-bound at ~690 GB/s effective):
+  - batch 256 vs 512           (amortize fixed/latency costs)
+  - conv7 vs space_to_depth    (stem MXU packing)
+  - f32 vs bf16 input images   (stem read traffic)
+"""
+
+import itertools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(step, state, batch, lr, iters=20):
+    for _ in range(3):
+        state, met = step(state, batch, lr)
+    float(met["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, met = step(state, batch, lr)
+    assert np.isfinite(float(met["loss"]))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+
+    mesh = data_parallel_mesh()
+    image = 224
+    rng = np.random.default_rng(0)
+    lr = jnp.float32(0.1)
+
+    combos = itertools.product(
+        (256, 512), ("conv7", "space_to_depth"), (np.float32, jnp.bfloat16))
+    only = sys.argv[1:] or None
+    for batch, stem, in_dtype in combos:
+        tag = f"b{batch}-{stem}-{np.dtype(in_dtype).name}"
+        if only and not any(o in tag for o in only):
+            continue
+        model = models.create_model(
+            "resnet50", num_classes=1000, dtype=jnp.bfloat16, stem=stem)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)), train=False)
+        state = TrainState.create(variables, sgd_init(variables["params"]))
+        step = make_train_step(model, mesh)
+        b = {
+            "images": jnp.asarray(
+                rng.normal(size=(batch, image, image, 3)), dtype=in_dtype),
+            "labels": jnp.asarray(
+                rng.integers(0, 1000, size=batch).astype(np.int32)),
+            "weights": jnp.ones((batch,), jnp.float32),
+        }
+        dt = timeit(step, state, b, lr)
+        print(f"{tag:34s} {dt*1e3:8.2f} ms/step  {batch/dt:8.1f} img/s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
