@@ -268,24 +268,41 @@ class DeviceFeeder:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = object()
 
+        dead = threading.Event()
+
+        def offer(item) -> bool:
+            """Put with a liveness check so an abandoned consumer (early
+            ``break``/``close()`` out of the epoch loop) can't leave this
+            thread blocked forever on a full queue."""
+            while not dead.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer():
             # Exceptions must surface at the consumer, not die in the thread —
             # otherwise a bad batch silently truncates the epoch.
             try:
                 for batch in host_iter:
-                    q.put(self._put(batch))
-                q.put(stop)
+                    if dead.is_set() or not offer(self._put(batch)):
+                        return
+                offer(stop)
             except BaseException as e:  # noqa: BLE001 — re-raised at consumer
-                q.put(e)
+                offer(e)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            if isinstance(item, BaseException):
-                t.join()
-                raise item
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            dead.set()
+            t.join(timeout=5.0)

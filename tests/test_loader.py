@@ -36,6 +36,25 @@ def test_feeder_raises_on_indivisible_batch_in_consumer():
         next(iter(feeder(iter(_loader(bsz=12)))))
 
 
+def test_feeder_early_exit_stops_producer_thread():
+    """Breaking out of the epoch loop (or closing the generator) must not
+    leave the producer thread blocked on a full prefetch queue."""
+    import threading
+
+    before = {t.ident for t in threading.enumerate()}
+    feeder = DeviceFeeder(data_parallel_mesh())
+    it = feeder(iter(_loader(n=64)))
+    next(it)  # producer running, queue filling
+    it.close()  # early exit mid-epoch
+    leaked = [
+        t for t in threading.enumerate()
+        if t.ident not in before and t.is_alive()
+    ]
+    for t in leaked:
+        t.join(timeout=5.0)
+    assert not any(t.is_alive() for t in leaked)
+
+
 def test_final_batch_padding_and_mask():
     loader = _loader(n=20, bsz=8)  # 3 batches, last has 4 real samples
     batches = list(iter(loader))
