@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""TransformerLM training throughput + MFU on the real chip.
+
+The ResNet headline is HBM-roofline-bound (see ROADMAP); the LM family is
+where the MXU earns its keep — large matmuls, high arithmetic intensity.
+This bench measures the full compiled LM train step (fwd+bwd+SGD, bf16
+compute) and reports tokens/sec and **model FLOPs utilization** against the
+chip's advertised bf16 peak, across context lengths and attention
+implementations (dense vs the Pallas flash kernel, remat on/off).
+
+MFU counts standard transformer model FLOPs: 6·P_active·T for the matmul
+stack plus 12·L·T·d per token... simplified to the PaLM convention:
+    flops/token = 6·N + 12·n_layers·d_model·seq_len
+(N = non-embedding params; causal attention halves the 12·L·d term, we use
+6·L·d.)  Writes RESULTS_lm.json.
+
+Run on the TPU chip:
+    PYTHONPATH=/root/repo python experiments/lm_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+PEAK_TFLOPS = float(os.environ.get("LM_BENCH_PEAK_TFLOPS", "197"))  # v5e bf16
+D_MODEL = int(os.environ.get("LM_BENCH_D", "1024"))
+N_LAYERS = int(os.environ.get("LM_BENCH_LAYERS", "12"))
+N_HEADS = int(os.environ.get("LM_BENCH_HEADS", "16"))
+VOCAB = int(os.environ.get("LM_BENCH_VOCAB", "32000"))
+ITERS = int(os.environ.get("LM_BENCH_ITERS", "10"))
+
+
+def count_params(params) -> int:
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def bench(L: int, batch: int, attn_impl: str, remat: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.parallel.tp import replicated_like
+    from pytorch_distributed_tpu.train.lm import make_lm_train_step
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+
+    mesh = data_parallel_mesh()
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=D_MODEL, n_heads=N_HEADS,
+        n_layers=N_LAYERS, dtype=jnp.bfloat16, attn_impl=attn_impl,
+        remat=remat,
+    )
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, VOCAB, size=(batch, L)).astype(np.int32))
+    variables = model.init(jax.random.PRNGKey(0), tokens[:1, :8])
+    params = variables["params"]
+    n_params = count_params(params)
+    n_embed = params["embed"]["embedding"].size
+    state = TrainState.create({"params": params}, sgd_init(params))
+    step = make_lm_train_step(model, mesh, replicated_like(params))
+    lr = jnp.float32(1e-3)
+
+    for _ in range(3):
+        state, met = step(state, tokens, lr)
+    float(met["loss"])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, met = step(state, tokens, lr)
+    assert np.isfinite(float(met["loss"]))
+    dt = (time.perf_counter() - t0) / ITERS
+
+    toks = batch * L
+    # PaLM-convention model FLOPs (fwd+bwd = 3x fwd matmul FLOPs), causal
+    # attention at half the full-L^2 score/value work.
+    flops_per_tok = 6 * (n_params - n_embed) + 6 * n_layers_d() * L
+    # embedding lookup is a gather (no matmul flops); the tied head IS a
+    # matmul over the vocab:
+    flops_per_tok += 6 * n_embed
+    total_flops = flops_per_tok * toks
+    # The step shards over every device in the mesh; normalize peak to match.
+    mfu = total_flops / dt / (PEAK_TFLOPS * 1e12 * jax.device_count())
+    return {
+        "ms_per_step": round(dt * 1e3, 2),
+        "tokens_per_sec": round(toks / dt, 0),
+        "mfu_pct": round(100 * mfu, 1),
+        "params_m": round(n_params / 1e6, 1),
+    }
+
+
+def n_layers_d() -> int:
+    return N_LAYERS * D_MODEL
+
+
+def main() -> int:
+    import jax
+
+    results = {}
+    # Dense batches are capped by the materialized f32 score tensor
+    # (B·H·L² · 4B: 4.3 GB at L=1024 b=4 — b=16 would want 17 GB).
+    for L, batch, attn, remat in (
+        (1024, 4, "dense", False),
+        (1024, 4, "flash", False),
+        (2048, 1, "dense", False),
+        (2048, 8, "flash", False),
+        (4096, 4, "flash", False),
+        (4096, 4, "flash", True),
+        (8192, 2, "flash", True),
+    ):
+        tag = f"L{L}_b{batch}_{attn}{'_remat' if remat else ''}"
+        try:
+            row = bench(L, batch, attn, remat)
+        except Exception as e:
+            print(f"{tag}: FAILED {repr(e)[:200]}", flush=True)
+            continue
+        results[tag] = row
+        print(f"{tag}: {row['ms_per_step']} ms  "
+              f"{row['tokens_per_sec']:,.0f} tok/s  MFU {row['mfu_pct']}%",
+              flush=True)
+
+    out = {
+        "meta": {
+            "d_model": D_MODEL, "n_layers": N_LAYERS, "n_heads": N_HEADS,
+            "vocab": VOCAB, "peak_tflops": PEAK_TFLOPS,
+            "platform": jax.default_backend(),
+            "what": "full LM train step (fwd+bwd+SGD), bf16, PaLM-convention "
+                    "MFU vs chip bf16 peak",
+        },
+        "configs": results,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "..", "RESULTS_lm.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -262,10 +262,14 @@ class LMTrainer:
         eval_batches: int = 8,
         lr_schedule=None,
         clip_grad_norm: float = 0.0,
+        preempt=None,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
-        ``clip_grad_norm``: in-graph global-norm gradient clipping."""
+        ``clip_grad_norm``: in-graph global-norm gradient clipping;
+        ``preempt``: optional installed ``utils.preempt.PreemptionGuard`` —
+        when it triggers, ``fit`` stops at the next step boundary and the
+        end-of-fit checkpoint captures the state."""
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -278,6 +282,7 @@ class LMTrainer:
         self.lr = lr
         self.is_primary = is_primary
         self.checkpoint_dir = checkpoint_dir
+        self.preempt = preempt
 
         # Init batch must divide the data axis (ring attention shard_maps the
         # batch dim during init tracing too).
@@ -298,11 +303,23 @@ class LMTrainer:
         self.eval_every = eval_every
         self.eval_batches = eval_batches
         self.best_ppl = float("inf")
+        self._agree = None  # lazy PreemptionAgreement (see utils/preempt.py)
         self._eval_fn = (
             make_lm_eval_step(model, mesh, self.param_specs)
             if eval_dataset is not None
             else None
         )
+
+    def _preempt_agreed(self) -> bool:
+        """Cross-process 'any rank flagged?' — every rank calls this at the
+        same step (it runs a collective on multi-process meshes)."""
+        if self._agree is None:
+            from pytorch_distributed_tpu.utils.preempt import (
+                PreemptionAgreement,
+            )
+
+            self._agree = PreemptionAgreement(self.mesh)
+        return self._agree(self.preempt.triggered)
 
     def evaluate(self) -> Tuple[float, float, float]:
         """Held-out ``(loss, perplexity, next-token acc%)`` over
@@ -335,7 +352,17 @@ class LMTrainer:
         lr = jnp.float32(self.lr)
         end = time.time()
         final_ppl = None  # ppl from an interval eval on the very last step
+        preempted = False
         for i in range(steps):
+            # print_freq cadence: the cross-process agreement collective
+            # (see utils/preempt.py) must run at the same step on every
+            # rank, and stays off the per-step hot path.
+            if (self.preempt is not None and i % print_freq == 0
+                    and self._preempt_agreed()):
+                print(f"=> preemption signal: stopping at step {i}",
+                      flush=True)
+                preempted = True
+                break
             tokens = jax.device_put(
                 self.dataset.batch(i, self.batch_size), self.token_sharding
             )
@@ -359,7 +386,10 @@ class LMTrainer:
             else:
                 final_ppl = None
         is_best = False
-        if self._eval_fn is not None:
+        if self._eval_fn is not None and not preempted:
+            # Preempted runs skip the final eval: the SIGTERM grace window
+            # belongs to the checkpoint, and a partial-state eval must not
+            # contend for the best-checkpoint slot.
             if final_ppl is None:  # last step didn't land on an eval boundary
                 _, final_ppl, _ = self.evaluate()
             # <= so the final state is marked best when it ties the best seen

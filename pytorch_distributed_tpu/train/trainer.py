@@ -47,10 +47,17 @@ class Trainer:
         wire_dtype=None,
         data_axis: str = "data",
         tx=None,
+        preempt=None,
     ):
         """``tx``: optional optax GradientTransformation replacing the
-        default torch-parity SGD (see train/steps.py docstring)."""
+        default torch-parity SGD (see train/steps.py docstring).
+
+        ``preempt``: optional ``utils.preempt.PreemptionGuard`` (already
+        installed) polled between steps; ``fit()`` installs a SIGTERM guard
+        by default when none is given."""
         self.cfg = cfg
+        self.preempt = preempt
+        self._agree = None  # built lazily (PreemptionAgreement over the mesh)
         self.ctx = ctx or DistContext(
             jax.process_index(), jax.process_count(), None
         )
@@ -269,6 +276,13 @@ class Trainer:
         lr_arr = jnp.float32(lr)
         end = time.time()
         for i, batch in enumerate(self.feeder(iter(self.train_loader))):
+            # Polled at print_freq cadence so the agreement collective (a
+            # tiny any-rank-flagged all-reduce every rank runs at the same
+            # step — signal skew across hosts must not break ranks at
+            # different boundaries) stays off the per-step hot path.
+            if (self.preempt is not None and i % cfg.print_freq == 0
+                    and self._preempt_agreed()):
+                break
             n = self.cfg.batch_size
             self.state, metrics = self.train_step(self.state, batch, lr_arr)
             # Unready device scalars: meters convert lazily at display time,
@@ -328,11 +342,39 @@ class Trainer:
             from pytorch_distributed_tpu.utils.telemetry import TelemetrySampler
 
             telemetry = TelemetrySampler(cfg.telemetry_csv).start()
+        import threading
+
+        from pytorch_distributed_tpu.utils.preempt import PreemptionGuard
+
+        # Default guard: SIGTERM (the pod-reclaim grace signal) triggers a
+        # checkpoint-and-exit at the next safe boundary (SURVEY §5.3
+        # upgrade).  Callers may pass their own guard to Trainer().  Signal
+        # handlers are main-thread-only in Python, so off-main-thread fit()
+        # callers simply run unguarded unless they pass one in.
+        installed = (self.preempt is None
+                     and threading.current_thread() is threading.main_thread())
+        if installed:
+            self.preempt = PreemptionGuard().install()
         try:
             return self._fit_epochs()
         finally:
+            if installed:
+                self.preempt.uninstall()
+                self.preempt = None
             if telemetry is not None:
                 telemetry.stop()
+
+    def _preempt_agreed(self) -> bool:
+        """Cross-process 'any rank flagged?' — see utils/preempt.py.  Every
+        rank must call this at the same loop boundary (it runs a collective
+        on multi-process meshes)."""
+        if self._agree is None:
+            from pytorch_distributed_tpu.utils.preempt import (
+                PreemptionAgreement,
+            )
+
+            self._agree = PreemptionAgreement(self.mesh, self.data_axis)
+        return self._agree(self.preempt.triggered)
 
     def _fit_epochs(self) -> float:
         cfg = self.cfg
@@ -346,6 +388,18 @@ class Trainer:
             if profiling:
                 jax.profiler.stop_trace()
                 print(f"=> wrote profiler trace to '{cfg.profile_dir}'")
+            if self.preempt is not None and self._preempt_agreed():
+                # Preempted mid-epoch: the epoch is incomplete, so record the
+                # previous one — resume reruns this epoch from its start.
+                print(f"=> preemption signal: checkpointing at epoch {epoch} "
+                      f"and exiting", flush=True)
+                save_checkpoint(
+                    cfg.checkpoint_dir, self.state, epoch - 1, cfg.arch,
+                    self.best_acc1, is_best=False,
+                    is_primary=self.ctx.is_primary, backend=cfg.ckpt_backend,
+                    metric=0.0,
+                )
+                break
             acc1 = self.validate()
             elapsed = self.csv.epoch_end()
             print(f"Epoch {epoch} took {elapsed:.1f}s", flush=True)

@@ -1,0 +1,111 @@
+"""Preemption-aware training — the failure-detection subsystem.
+
+TPU pods get reclaimed (maintenance events, spot preemption) with a SIGTERM
+grace window.  The reference's entire fault-tolerance story is a manual
+``--start-epoch`` restart flag (SURVEY.md §5.3; reference distributed.py:
+48-52): no detection, no reaction.  Here a signal flips a flag, the epoch/
+step drivers poll it at safe boundaries (between compiled steps — never
+mid-collective, so every rank exits at the same step), checkpoint, and
+leave; ``--resume`` then continues from the last completed epoch.
+
+Signal handlers are process-global state, so installation is explicit and
+reversible (``install()``/``uninstall()``); the previous handler is chained,
+not clobbered.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Dict, Tuple
+
+
+class PreemptionGuard:
+    """Flag-on-signal with handler chaining.
+
+    >>> guard = PreemptionGuard().install()
+    >>> ...  # training loop polls guard.triggered between steps
+    >>> guard.uninstall()
+
+    Polling is a local ``Event`` check — no collective, no device sync.  All
+    processes of a job receive the platform's preemption signal, so each
+    rank observes the flag independently and breaks at the same loop
+    boundary (the next step's collective never starts anywhere).
+    """
+
+    def __init__(self, signals: Tuple[int, ...] = (signal.SIGTERM,)):
+        self._signals = signals
+        self._flag = threading.Event()
+        self._prev: Dict[int, object] = {}
+
+    def _handler(self, signum, frame):
+        self._flag.set()
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    def install(self) -> "PreemptionGuard":
+        """Install handlers (main thread only — a Python restriction)."""
+        for s in self._signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+
+    @property
+    def triggered(self) -> bool:
+        return self._flag.is_set()
+
+    def trigger(self) -> None:
+        """Set the flag directly (tests; cooperative shutdown)."""
+        self._flag.set()
+
+
+class PreemptionAgreement:
+    """Cross-process agreement on the preemption flag.
+
+    Signal delivery skews across hosts: rank 0's flag may set just before a
+    loop-boundary check while rank 1's sets just after, so per-rank local
+    polling would break the ranks at *different* boundaries and deadlock the
+    next collective.  This wraps the decision in a tiny compiled all-reduce
+    (any-rank-flagged → everyone stops) that every process executes at the
+    same cadence, making the stop decision itself bulk-synchronous — the
+    same reasoning that lets the framework drop the reference's explicit
+    ``barrier()`` (SURVEY.md §5.8).
+
+    Single-process meshes skip the device round-trip entirely.
+    """
+
+    def __init__(self, mesh, data_axis: str = "data"):
+        import jax
+
+        self._single = jax.process_count() == 1
+        if self._single:
+            return
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._mesh = mesh
+        self._sharding = NamedSharding(mesh, P(data_axis))
+        n_local = len(mesh.local_devices)
+        self._ones = {
+            flag: jnp.full((n_local,), 1.0 if flag else 0.0, jnp.float32)
+            for flag in (False, True)
+        }
+        self._any = jax.jit(
+            lambda x: jnp.sum(x) > 0,
+            out_shardings=NamedSharding(mesh, P()),
+        )
+
+    def __call__(self, flag: bool) -> bool:
+        if self._single:
+            return flag
+        import jax
+
+        arr = jax.make_array_from_process_local_data(
+            self._sharding, self._ones[bool(flag)]
+        )
+        return bool(self._any(arr))
