@@ -53,6 +53,9 @@ from pytorch_distributed_tpu.models.extra import (  # noqa: F401
     shufflenet_v2_x1_5, shufflenet_v2_x2_0,
     squeezenet1_0, squeezenet1_1,
 )
+from pytorch_distributed_tpu.models.vit import (  # noqa: F401
+    VisionTransformer, vit_b_16, vit_b_32, vit_l_16,
+)
 
 _REGISTRY: Dict[str, Callable] = {
     "alexnet": alexnet,
@@ -80,6 +83,8 @@ _REGISTRY: Dict[str, Callable] = {
     "shufflenet_v2_x2_0": shufflenet_v2_x2_0,
     "mnasnet0_5": mnasnet0_5, "mnasnet0_75": mnasnet0_75,
     "mnasnet1_0": mnasnet1_0, "mnasnet1_3": mnasnet1_3,
+    # Beyond the torchvision-0.4 namespace: the MXU-native image family.
+    "vit_b_16": vit_b_16, "vit_b_32": vit_b_32, "vit_l_16": vit_l_16,
 }
 
 

@@ -1,0 +1,144 @@
+"""Vision Transformer family (flax.linen, TPU-first).
+
+Beyond the reference's torchvision-0.4 zoo (its requirements.txt:2 predates
+ViT), but squarely inside this framework's brief: where ResNet-50 training
+is HBM-roofline-bound on TPU (see ROADMAP.md), a ViT is the MXU-native image
+model — the whole network is large matmuls.  Architecture follows
+torchvision's ``vit_b_16``-style encoder (class token, learned position
+embeddings, pre-LN blocks, GELU MLP) so the ``-a vit_b_16`` gesture matches
+what torchvision users expect.
+
+TPU-first choices:
+- patchify as reshape + one Dense (a pure-layout transform feeding a single
+  [N·P², 3·p²]×[3·p², D] matmul — no conv im2col, tiles straight onto the
+  MXU);
+- bf16 compute policy with f32 LayerNorm/softmax accumulation and an f32
+  head (same policy as the rest of the zoo);
+- static shapes throughout: position embeddings take their grid shape from
+  the init-time input (no image-size constructor knob to keep in sync); the
+  class token rides as sequence position 0.
+
+Reference anchor for the zoo surface: reference distributed.py:21-23
+(arch-by-name instantiation); harness contract: ``__call__(images, train)``
+like every image model here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class EncoderBlock(nn.Module):
+    n_heads: int
+    mlp_dim: int
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.n_heads,
+            dtype=self.dtype,
+            dropout_rate=self.dropout,
+            deterministic=not train,
+            name="self_attention",
+        )(h, h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="mlp_fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_fc2")(h)
+        h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class VisionTransformer(nn.Module):
+    patch_size: int = 16
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        N, H, W, C = x.shape
+        p = self.patch_size
+        if H % p or W % p:
+            raise ValueError(
+                f"image {H}x{W} not divisible by patch size {p}")
+        x = x.astype(self.dtype)
+        # Patchify: [N, H/p, p, W/p, p, C] -> [N, L, p*p*C] (layout only),
+        # then embed with one Dense — the MXU-friendly conv-stem equivalent.
+        gh, gw = H // p, W // p
+        x = (
+            x.reshape(N, gh, p, gw, p, C)
+            .transpose(0, 1, 3, 2, 4, 5)
+            .reshape(N, gh * gw, p * p * C)
+        )
+        x = nn.Dense(self.d_model, dtype=self.dtype, name="patch_embed")(x)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.d_model),
+            jnp.float32,
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (N, 1, self.d_model)).astype(x.dtype), x],
+            axis=1,
+        )
+        # Position embeddings are shaped by the init-time input: stored in
+        # GRID shape (1, gh, gw, D) — not flat token count — so applying at
+        # a different resolution OR a different aspect ratio with the same
+        # patch count fails loudly on param-shape mismatch instead of
+        # silently reusing geometrically wrong positions.
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, gh, gw, self.d_model),
+            jnp.float32,
+        )
+        cls_pos = self.param(
+            "cls_pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, 1, self.d_model),
+            jnp.float32,
+        )
+        pos_seq = jnp.concatenate(
+            [cls_pos, pos.reshape(1, gh * gw, self.d_model)], axis=1
+        )
+        x = x + pos_seq.astype(x.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+
+        for i in range(self.n_layers):
+            x = EncoderBlock(
+                self.n_heads, self.mlp_dim, self.dropout, self.dtype,
+                name=f"encoder_{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Classify from the class token (torchvision ViT convention).
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="head"
+        )(x[:, 0])
+
+
+vit_b_16 = functools.partial(
+    VisionTransformer, patch_size=16, d_model=768, n_layers=12, n_heads=12,
+    mlp_dim=3072,
+)
+vit_b_32 = functools.partial(
+    VisionTransformer, patch_size=32, d_model=768, n_layers=12, n_heads=12,
+    mlp_dim=3072,
+)
+vit_l_16 = functools.partial(
+    VisionTransformer, patch_size=16, d_model=1024, n_layers=24, n_heads=16,
+    mlp_dim=4096,
+)
