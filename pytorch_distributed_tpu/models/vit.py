@@ -46,6 +46,10 @@ class EncoderBlock(nn.Module):
             dtype=self.dtype,
             dropout_rate=self.dropout,
             deterministic=not train,
+            # Zoo-wide numerics policy: softmax accumulates in f32 even
+            # under the bf16 compute policy (same as transformer.py's
+            # explicit f32 score path).
+            force_fp32_for_softmax=True,
             name="self_attention",
         )(h, h)
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
