@@ -182,3 +182,74 @@ def _tree_zeros(tree: Any) -> Any:
         return {k: _tree_zeros(v) for k, v in tree.items()}
     a = _np(tree)
     return np.zeros_like(a, dtype=np.float32)
+
+
+# --------------------------------------------------------------------- export
+# The reverse direction: this framework's variables → a torchvision-shaped
+# state_dict / the reference's checkpoint payload, so models trained here
+# load back into the reference (torch.load + model.load_state_dict) — the
+# migration story runs both ways (docs/MIGRATION.md).
+
+
+def _inv_conv(kernel: Any) -> np.ndarray:
+    return _np(kernel).transpose(3, 2, 0, 1).astype(np.float32)  # HWIO->OIHW
+
+
+def _inv_bn(sd: Dict[str, np.ndarray], prefix: str, params: Mapping,
+            stats: Mapping) -> None:
+    sd[f"{prefix}.weight"] = _np(params["scale"]).astype(np.float32)
+    sd[f"{prefix}.bias"] = _np(params["bias"]).astype(np.float32)
+    sd[f"{prefix}.running_mean"] = _np(stats["mean"]).astype(np.float32)
+    sd[f"{prefix}.running_var"] = _np(stats["var"]).astype(np.float32)
+    # torch bookkeeping tensor; load_state_dict(strict=True) expects it.
+    sd[f"{prefix}.num_batches_tracked"] = np.asarray(0, dtype=np.int64)
+
+
+def export_resnet_state_dict(
+    variables: Mapping, stage_sizes
+) -> Dict[str, np.ndarray]:
+    """``{"params", "batch_stats"}`` → torchvision-ResNet ``state_dict``
+    (numpy values, torch naming/layout; exact inverse of
+    ``import_resnet_state_dict``).
+
+    ``stage_sizes`` supplies the flat-block → ``layer{s}.{i}`` naming split
+    (the flax tree is flat; e.g. ``[3, 4, 6, 3]`` for resnet50 — read it
+    from ``models._REGISTRY[arch].keywords["stage_sizes"]``).
+    """
+    params, stats = variables["params"], variables["batch_stats"]
+    blocks = sorted(
+        (k for k in params if re.match(r"^(BasicBlock|Bottleneck)_\d+$", k)),
+        key=lambda k: int(k.rsplit("_", 1)[1]),
+    )
+    if sum(stage_sizes) != len(blocks):
+        raise ValueError(
+            f"stage_sizes {list(stage_sizes)} sum to {sum(stage_sizes)} but "
+            f"the tree has {len(blocks)} blocks"
+        )
+    sd: Dict[str, np.ndarray] = {"conv1.weight": _inv_conv(
+        params["conv_init"]["kernel"])}
+    _inv_bn(sd, "bn1", params["bn_init"], stats["bn_init"])
+
+    it = iter(blocks)
+    for s, n in enumerate(stage_sizes, start=1):
+        for i in range(n):
+            name = next(it)
+            bp, bs = params[name], stats[name]
+            n_convs = 3 if name.startswith("Bottleneck") else 2
+            t = f"layer{s}.{i}"
+            for c in range(n_convs):
+                sd[f"{t}.conv{c + 1}.weight"] = _inv_conv(
+                    bp[f"Conv_{c}"]["kernel"])
+                _inv_bn(sd, f"{t}.bn{c + 1}",
+                        bp[f"FusedBatchNormAct_{c}"],
+                        bs[f"FusedBatchNormAct_{c}"])
+            if f"Conv_{n_convs}" in bp:  # projection shortcut
+                sd[f"{t}.downsample.0.weight"] = _inv_conv(
+                    bp[f"Conv_{n_convs}"]["kernel"])
+                _inv_bn(sd, f"{t}.downsample.1",
+                        bp[f"FusedBatchNormAct_{n_convs}"],
+                        bs[f"FusedBatchNormAct_{n_convs}"])
+    sd["fc.weight"] = _np(params["fc"]["kernel"]).transpose(1, 0).astype(
+        np.float32)
+    sd["fc.bias"] = _np(params["fc"]["bias"]).astype(np.float32)
+    return sd

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Convert a framework checkpoint back to the reference's torch format.
+
+Usage:
+    python scripts/export_torch_checkpoint.py \
+        --input ckpts/checkpoint.msgpack --output checkpoint.pth.tar
+
+Reads a msgpack checkpoint (or a ``--pretrained`` ``<arch>.msgpack``),
+converts the ResNet tree to a torchvision-shaped ``state_dict`` (OIHW convs,
+[out,in] linear, BN running stats) and writes the reference's payload
+``{'epoch', 'arch', 'state_dict', 'best_acc1'}`` via ``torch.save`` —
+loadable by the reference's recipes and by plain torchvision
+``model.load_state_dict`` (reference distributed.py:219-225,327-330).
+
+The migration path therefore runs both ways:
+import_torch_checkpoint.py (reference → here) and this (here → reference).
+"""
+
+import argparse
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input", required=True,
+                    help="msgpack checkpoint / pretrained file")
+    ap.add_argument("--output", required=True, help=".pth/.pth.tar to write")
+    ap.add_argument("--arch", default=None,
+                    help="arch name (defaults to the checkpoint's own field)")
+    args = ap.parse_args()
+
+    from flax import serialization
+
+    with open(args.input, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    arch = args.arch or payload.get("arch")
+    if not arch:
+        sys.exit("--arch required: checkpoint has no 'arch' field")
+
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.utils.torch_import import (
+        export_resnet_state_dict,
+    )
+
+    ctor = models._REGISTRY.get(arch)
+    stage_sizes = getattr(ctor, "keywords", {}).get("stage_sizes")
+    if stage_sizes is None:
+        sys.exit(f"export supports the ResNet family; {arch!r} has no "
+                 "stage_sizes")
+    state = payload["state"]
+    variables = {"params": state["params"],
+                 "batch_stats": state["batch_stats"]}
+    sd = export_resnet_state_dict(variables, stage_sizes)
+
+    import torch
+
+    out = {
+        "epoch": int(payload.get("epoch", 0)),
+        "arch": arch,
+        "best_acc1": float(payload.get("best_acc1", 0.0)),
+        "state_dict": {k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+    }
+    torch.save(out, args.output)
+    print(f"wrote {args.output} ({arch}, epoch={out['epoch']}, "
+          f"best_acc1={out['best_acc1']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
