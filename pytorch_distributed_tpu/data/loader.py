@@ -47,6 +47,7 @@ class DataLoader:
         seed: int = 0,
         batch_mode: str = "f32",
         random_flip: bool = False,
+        worker_type: str = "thread",
     ):
         """``batch_mode``:
 
@@ -59,9 +60,20 @@ class DataLoader:
                           and normalization happens on device (DeviceFeeder).
         ``random_flip`` applies the train-stack horizontal flip in the u8
         modes (in f32 mode the flip lives in the per-sample transform).
+
+        ``worker_type``: ``"thread"`` (default; right for the native-decode
+        path, whose C++ batch decode releases the GIL) or ``"process"`` —
+        spawn-based worker processes for the Python/PIL per-sample path,
+        where threads serialize on the GIL (reference ``DataLoader``
+        worker processes, reference distributed.py:176-180).  Spawn, not
+        fork, so the dataset+transform must be picklable (the built-in
+        ones are); see ``_iter_process`` for why fork is unsafe here.
         """
         if batch_mode not in ("f32", "u8_host", "u8_wire"):
             raise ValueError(f"unknown batch_mode {batch_mode!r}")
+        if worker_type not in ("thread", "process"):
+            raise ValueError(f"unknown worker_type {worker_type!r}")
+        self.worker_type = worker_type
         self.dataset = dataset
         self.batch_size = batch_size
         self.sampler = sampler or DistributedShardSampler(
@@ -133,78 +145,197 @@ class DataLoader:
                 )
         return images, labels, dead
 
+    def _batch_indices(self, indices, valid, b: int):
+        lo, hi = b * self.batch_size, (b + 1) * self.batch_size
+        idx = indices[lo:hi]
+        val = valid[lo:hi]
+        # Pad the trailing batch to the static batch size.
+        pad = self.batch_size - len(idx)
+        if pad:
+            idx = np.concatenate([idx, np.zeros(pad, dtype=idx.dtype)])
+            val = np.concatenate([val, np.zeros(pad, dtype=val.dtype)])
+        return idx, val
+
+    def _assemble(self, b: int, val, samples) -> Batch:
+        """Samples → one padded/masked batch (shared by both worker modes)."""
+        if getattr(self.dataset, "native_decode", False):
+            if self.batch_mode == "f32":
+                raise TypeError(
+                    "native_decode datasets produce uint8 batches; "
+                    "use batch_mode 'u8_host' or 'u8_wire'"
+                )
+            images, labels, dead = self._assemble_native(samples)
+            if dead:
+                val = val.copy()
+                val[dead] = 0
+        else:
+            proto = next(s for s in samples if s is not None)
+            img_dtype = (
+                np.uint8 if self.batch_mode != "f32" else np.float32
+            )
+            if self.batch_mode != "f32" and proto[0].dtype != np.uint8:
+                raise TypeError(
+                    f"batch_mode {self.batch_mode!r} needs uint8 "
+                    f"samples (use the *_transform_u8 stacks), got "
+                    f"{proto[0].dtype}"
+                )
+            images = np.zeros(
+                (self.batch_size,) + proto[0].shape, dtype=img_dtype
+            )
+            labels = np.zeros(self.batch_size, dtype=np.int32)
+            for i, s in enumerate(samples):
+                if s is not None:
+                    images[i] = s[0]
+                    labels[i] = s[1]
+        if self.batch_mode != "f32":
+            flip_rng = np.random.default_rng(
+                (self.seed, self.sampler.epoch, b, 1)
+            )
+            flip = (
+                (flip_rng.random(self.batch_size) < 0.5).astype(np.uint8)
+                if self.random_flip
+                else None
+            )
+            if self.batch_mode == "u8_host":
+                from pytorch_distributed_tpu.data.native import (
+                    normalize_batch,
+                )
+                from pytorch_distributed_tpu.data.transforms import (
+                    IMAGENET_MEAN,
+                    IMAGENET_STD,
+                )
+
+                images = normalize_batch(
+                    images, IMAGENET_MEAN, IMAGENET_STD, flip=flip
+                )
+            elif flip is not None:  # u8_wire: flip on host, u8 out
+                fidx = np.nonzero(flip)[0]
+                images[fidx] = images[fidx, :, ::-1, :]
+        return {
+            "images": images,
+            "labels": labels,
+            "weights": val.astype(np.float32),
+        }
+
     def __iter__(self) -> Iterator[Batch]:
         indices, valid = self.sampler.shard()
         nb = len(self)
+        if self.worker_type == "process":
+            yield from self._iter_process(indices, valid, nb)
+            return
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
             for b in range(nb):
-                lo, hi = b * self.batch_size, (b + 1) * self.batch_size
-                idx = indices[lo:hi]
-                val = valid[lo:hi]
-                # Pad the trailing batch to the static batch size.
-                pad = self.batch_size - len(idx)
-                if pad:
-                    idx = np.concatenate([idx, np.zeros(pad, dtype=idx.dtype)])
-                    val = np.concatenate([val, np.zeros(pad, dtype=val.dtype)])
+                idx, val = self._batch_indices(indices, valid, b)
                 samples = list(pool.map(self._fetch, idx, val))
-                if getattr(self.dataset, "native_decode", False):
-                    if self.batch_mode == "f32":
-                        raise TypeError(
-                            "native_decode datasets produce uint8 batches; "
-                            "use batch_mode 'u8_host' or 'u8_wire'"
-                        )
-                    images, labels, dead = self._assemble_native(samples)
-                    if dead:
-                        val = val.copy()
-                        val[dead] = 0
-                else:
-                    proto = next(s for s in samples if s is not None)
-                    img_dtype = (
-                        np.uint8 if self.batch_mode != "f32" else np.float32
-                    )
-                    if self.batch_mode != "f32" and proto[0].dtype != np.uint8:
-                        raise TypeError(
-                            f"batch_mode {self.batch_mode!r} needs uint8 "
-                            f"samples (use the *_transform_u8 stacks), got "
-                            f"{proto[0].dtype}"
-                        )
-                    images = np.zeros(
-                        (self.batch_size,) + proto[0].shape, dtype=img_dtype
-                    )
-                    labels = np.zeros(self.batch_size, dtype=np.int32)
-                    for i, s in enumerate(samples):
-                        if s is not None:
-                            images[i] = s[0]
-                            labels[i] = s[1]
-                if self.batch_mode != "f32":
-                    flip_rng = np.random.default_rng(
-                        (self.seed, self.sampler.epoch, b, 1)
-                    )
-                    flip = (
-                        (flip_rng.random(self.batch_size) < 0.5).astype(np.uint8)
-                        if self.random_flip
-                        else None
-                    )
-                    if self.batch_mode == "u8_host":
-                        from pytorch_distributed_tpu.data.native import (
-                            normalize_batch,
-                        )
-                        from pytorch_distributed_tpu.data.transforms import (
-                            IMAGENET_MEAN,
-                            IMAGENET_STD,
-                        )
+                yield self._assemble(b, val, samples)
 
-                        images = normalize_batch(
-                            images, IMAGENET_MEAN, IMAGENET_STD, flip=flip
-                        )
-                    elif flip is not None:  # u8_wire: flip on host, u8 out
-                        fidx = np.nonzero(flip)[0]
-                        images[fidx] = images[fidx, :, ::-1, :]
-                yield {
-                    "images": images,
-                    "labels": labels,
-                    "weights": val.astype(np.float32),
-                }
+    def _iter_process(self, indices, valid, nb: int) -> Iterator[Batch]:
+        """Worker *processes* for the per-sample fetch — the GIL-proof mode
+        for Python/PIL decode (the reference's ``DataLoader(num_workers=…)``
+        process pool, reference distributed.py:176-180).  The native-decode
+        path doesn't need this: its C++ batch decode already releases the
+        GIL (``_assemble_native``).
+
+        Spawn start method, NOT fork: this runtime pre-imports jax (which is
+        multithreaded) into every interpreter, and forking a threaded parent
+        can deadlock the children.  The dataset ships to each worker once
+        via the pool initializer (transforms are plain picklable classes);
+        worker startup cost amortizes over the epoch."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        pool = ctx.Pool(self.num_workers, initializer=_process_init,
+                        initargs=(self.dataset,))
+        try:
+            for b in range(nb):
+                idx, val = self._batch_indices(indices, valid, b)
+                args = [
+                    (int(i), int(v), self.seed, self.sampler.epoch)
+                    for i, v in zip(idx, val)
+                ]
+                samples = pool.map(_process_fetch, args)
+                yield self._assemble(b, val, samples)
+        finally:
+            pool.terminate()
+            pool.join()
+
+
+_PROC_DATASET = None  # per-worker global, set by _process_init
+
+
+def _process_init(dataset) -> None:
+    global _PROC_DATASET
+    _PROC_DATASET = dataset
+
+
+def _process_fetch(args):
+    index, valid, seed, epoch = args
+    if not valid:
+        return None  # padding slot
+    rng = np.random.default_rng((seed, epoch, index))
+    ds = _PROC_DATASET
+    if hasattr(ds, "get"):
+        return ds.get(index, rng)
+    return ds[index]
+
+
+class AsyncFeeder:
+    """Generic async host→device pipeline with prefetch depth ≥ 2.
+
+    A producer thread pulls host items, runs ``put`` on each (host work +
+    async device transfer dispatch), and queues the results; the consumer
+    generator yields them.  ``DeviceFeeder`` (image batches) and the LM
+    token pipeline (train/lm.py) are both instances — the machinery that
+    replaces the apex CUDA-stream ``data_prefetcher``
+    (reference apex_distributed.py:115-169).
+    """
+
+    def __init__(self, put, prefetch: int = 2):
+        self.put = put
+        self.prefetch = max(1, prefetch)
+
+    def __call__(self, host_iter) -> Iterator:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = object()
+
+        dead = threading.Event()
+
+        def offer(item) -> bool:
+            """Put with a liveness check so an abandoned consumer (early
+            ``break``/``close()`` out of the epoch loop) can't leave this
+            thread blocked forever on a full queue."""
+            while not dead.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            # Exceptions must surface at the consumer, not die in the thread —
+            # otherwise a bad batch silently truncates the epoch.
+            try:
+                for batch in host_iter:
+                    if dead.is_set() or not offer(self.put(batch)):
+                        return
+                offer(stop)
+            except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+                offer(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is stop:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            dead.set()
+            t.join(timeout=5.0)
 
 
 class DeviceFeeder:
@@ -265,44 +396,4 @@ class DeviceFeeder:
         return out
 
     def __call__(self, host_iter) -> Iterator[Dict[str, jax.Array]]:
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
-        stop = object()
-
-        dead = threading.Event()
-
-        def offer(item) -> bool:
-            """Put with a liveness check so an abandoned consumer (early
-            ``break``/``close()`` out of the epoch loop) can't leave this
-            thread blocked forever on a full queue."""
-            while not dead.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def producer():
-            # Exceptions must surface at the consumer, not die in the thread —
-            # otherwise a bad batch silently truncates the epoch.
-            try:
-                for batch in host_iter:
-                    if dead.is_set() or not offer(self._put(batch)):
-                        return
-                offer(stop)
-            except BaseException as e:  # noqa: BLE001 — re-raised at consumer
-                offer(e)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is stop:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            dead.set()
-            t.join(timeout=5.0)
+        return AsyncFeeder(self._put, self.prefetch)(host_iter)

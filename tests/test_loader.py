@@ -90,3 +90,26 @@ def test_transform_rng_varies_by_epoch():
     loader.set_epoch(1)
     b1 = next(iter(loader))
     assert not np.array_equal(b0["images"], b1["images"])
+
+
+def test_process_workers_match_thread_workers():
+    """worker_type='process' (spawn pool, GIL-proof PIL path) must produce
+    byte-identical batches to the thread pool — same per-sample RNG keys."""
+    from pytorch_distributed_tpu.data.transforms import train_transform
+
+    ds = SyntheticImageDataset(
+        length=20, num_classes=5, image_size=32,
+        transform=train_transform(size=16),
+    )
+    batches = {}
+    for wt in ("thread", "process"):
+        sampler = DistributedShardSampler(20, shuffle=True, seed=3)
+        loader = DataLoader(ds, batch_size=8, sampler=sampler,
+                            num_workers=2, worker_type=wt)
+        loader.set_epoch(1)
+        batches[wt] = list(loader)
+    assert len(batches["thread"]) == len(batches["process"])
+    for a, b in zip(batches["thread"], batches["process"]):
+        np.testing.assert_array_equal(a["images"], b["images"])
+        np.testing.assert_array_equal(a["labels"], b["labels"])
+        np.testing.assert_array_equal(a["weights"], b["weights"])
