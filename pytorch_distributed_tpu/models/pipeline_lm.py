@@ -64,12 +64,27 @@ class PipelinedTransformerLM:
         model_axis: str = "model",
         sp_size: int = 1,
         seq_axis: str = "seq",
+        schedule: str = "gpipe",
+        remat: bool = False,
     ):
         """``tp_size > 1``: Megatron tensor parallelism INSIDE each stage
         (``parallel/tp_stage.py`` — explicit psums under the pipeline's
         shard_map) over ``model_axis``; the mesh must carry that axis.
         ``sp_size > 1``: ring sequence parallelism inside each stage over
-        ``seq_axis`` (composable with ``tp_size``)."""
+        ``seq_axis`` (composable with ``tp_size``).
+
+        ``schedule``: ``"gpipe"`` (autodiff through the forward pipeline,
+        activation stash O(M)) or ``"1f1b"`` (interleaved manual-gradient
+        schedule, stash bounded at 2(P-1)+1 stage-inputs — see
+        ``parallel/pp_1f1b.py``); ``remat=True`` checkpoints each stage under
+        the gpipe schedule (1f1b rematerializes by construction)."""
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        if schedule == "1f1b" and (tp_size > 1 or sp_size > 1):
+            raise ValueError(
+                "schedule='1f1b' currently supports plain stages "
+                "(tp_size == sp_size == 1); use gpipe for TP/SP-in-stage"
+            )
         if n_layers % n_stages:
             raise ValueError(
                 f"n_layers {n_layers} not divisible by n_stages {n_stages}"
@@ -96,6 +111,8 @@ class PipelinedTransformerLM:
                     f"mesh '{seq_axis}' axis "
                     f"{dict(mesh.shape).get(seq_axis)} != sp_size {sp_size}"
                 )
+        self.schedule = schedule
+        self.remat = remat
         self.sp_size = sp_size
         self.seq_axis = seq_axis
         self.vocab_size = vocab_size
@@ -159,6 +176,56 @@ class PipelinedTransformerLM:
                 self.model_axis if self.tp_size > 1 else None)
         return None
 
+    def has_manual_grads(self) -> bool:
+        """``make_lm_train_step`` calls ``loss_and_grads`` instead of
+        ``jax.value_and_grad`` when this returns True (the 1F1B schedule
+        computes gradients inside its own interleaved scan)."""
+        return self.schedule == "1f1b"
+
+    def loss_and_grads(self, params, tokens: jnp.ndarray):
+        """``((loss, acc%), grads)`` via the 1F1B schedule — the signature
+        ``jax.value_and_grad(loss_fn, has_aux=True)`` would produce, computed
+        manually (see parallel/pp_1f1b.py)."""
+        from pytorch_distributed_tpu.ops import cross_entropy
+        from pytorch_distributed_tpu.parallel.pp_1f1b import (
+            pipeline_1f1b_loss_and_grads,
+        )
+
+        embed_p, ln_p = params["embed"], params["ln_f"]
+        x, embed_vjp = jax.vjp(
+            lambda ep: self._embed.apply({"params": ep}, tokens), embed_p
+        )
+
+        def head_fn(hp, y, tok):
+            h = self._ln_f.apply({"params": hp["ln_f"]},
+                                 y.astype(jnp.float32))
+            logits = self._embed.apply(
+                {"params": hp["embed"]}, h, method=nn.Embed.attend
+            ).astype(jnp.float32)
+            v = logits.shape[-1]
+            fl = logits[:, :-1].reshape(-1, v)
+            ft = tok[:, 1:].reshape(-1)
+            loss = cross_entropy(fl, ft)
+            correct = jnp.sum(
+                (jnp.argmax(fl, axis=-1) == ft).astype(jnp.float32))
+            return loss, correct
+
+        stage_fn = lambda sp, xb: self._stage.apply({"params": sp}, xb)
+        loss, correct, count, g_stage, g_head, dx = (
+            pipeline_1f1b_loss_and_grads(
+                stage_fn, head_fn, params["stages"],
+                {"ln_f": ln_p, "embed": embed_p}, x, tokens,
+                self.n_microbatches, self.mesh, pipe_axis=self.pipe_axis,
+            )
+        )
+        (g_embed_in,) = embed_vjp(dx.astype(x.dtype))
+        g_embed = jax.tree_util.tree_map(
+            lambda a, b: a.astype(jnp.float32) + b.astype(jnp.float32),
+            g_head["embed"], g_embed_in)
+        grads = {"embed": g_embed, "stages": g_stage, "ln_f": g_head["ln_f"]}
+        acc = correct / count  # fraction; the step scales to % like autodiff
+        return (loss, acc), grads
+
     def apply(self, variables, tokens: jnp.ndarray, mutable=None,
               train: bool = True):
         p = variables["params"]
@@ -169,6 +236,7 @@ class PipelinedTransformerLM:
             pipe_axis=self.pipe_axis,
             stage_param_specs=self._stage_specs(),
             seq_axis=self.seq_axis if self.sp_size > 1 else None,
+            remat=self.remat,
         )
         x = self._ln_f.apply({"params": p["ln_f"]}, x.astype(jnp.float32))
         logits = self._embed.apply(

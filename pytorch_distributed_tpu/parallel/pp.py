@@ -35,6 +35,7 @@ def pipeline_apply(
     data_axis: str = "data",
     stage_param_specs: Pytree = None,
     seq_axis: Optional[str] = None,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """Run ``x`` through ``n_stages`` of ``stage_fn`` as a GPipe pipeline.
 
@@ -51,7 +52,15 @@ def pipeline_apply(
     ``stage_params`` for additional within-stage sharding (e.g. Megatron TP
     over a ``model`` axis — ``parallel/tp_stage.py``); each spec must still
     lead with ``pipe_axis``.  Default: every leaf ``P(pipe_axis)``.
+
+    ``remat=True`` checkpoints the stage function: autodiff through the
+    schedule then stashes only each tick's stage *input* (recomputing the
+    in-stage activations during backward) — the O(M·layers) GPipe
+    activation stash drops to O(M) stage-inputs.  For an M-independent
+    bound use the 1F1B schedule (``parallel/pp_1f1b.py``).
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     n_stages = mesh.shape[pipe_axis]
     B = x.shape[0]
     if B % n_microbatches:

@@ -1,0 +1,226 @@
+"""1F1B pipeline schedule: memory-bounded alternative to GPipe.
+
+``parallel/pp.py``'s GPipe schedule runs all ``M`` microbatch forwards, then
+lets autodiff reverse the scan — so every stage stashes activations for all
+``M`` microbatches (with ``remat`` the stash is one stage-*input* per tick,
+but still O(M)).  The 1F1B (one-forward-one-backward) schedule interleaves:
+once the pipeline is full, each stage retires one backward for every forward
+it admits, so at most ``2·(P-1)`` microbatch stage-inputs are ever live per
+stage — **independent of M**.  That is the schedule that makes deep
+pipelines train at large microbatch counts without activation OOM
+(Narayanan et al., PipeDream-Flush / Megatron-LM's non-interleaved 1F1B).
+
+TPU-native formulation: gradients are computed *manually* inside one
+``lax.scan`` over ``T = M + 2(P-1)`` ticks under ``shard_map`` — each tick
+every stage runs (masked) one forward and one backward.  The backward
+re-runs the stage forward from the stashed stage-input via ``jax.vjp``
+(= full in-stage rematerialization; residuals never cross ticks), the
+activation cotangent hops stage→stage-1 over the reversed ``ppermute`` ring,
+and the loss head (final LN → tied-embedding logits → CE) runs on the last
+stage in the same tick its forward retires, producing both the microbatch
+loss and the cotangent that seeds its backward.  Autodiff is never applied
+over the schedule — the scan carry holds only the two hop buffers, the
+bounded stash, and the gradient accumulators, so compiled peak memory is the
+1F1B bound by construction.
+
+Bubble note: this synchronous formulation pays a ``2(P-1)``-tick bubble
+(vs GPipe's ``P-1``) because forward and backward share a tick clock; for
+``M ≫ P`` the difference vanishes, and each tick does F+B work so the
+steady state is fully utilized.
+
+Beyond-reference capability (SURVEY.md §2.3: pipeline parallelism is
+"explicitly absent" from the reference)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Pytree = Any
+
+
+def pipeline_1f1b_loss_and_grads(
+    stage_fn: Callable[[Pytree, jnp.ndarray], jnp.ndarray],
+    head_fn: Callable[[Pytree, jnp.ndarray, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    stage_params: Pytree,
+    head_params: Pytree,
+    x: jnp.ndarray,
+    tokens: jnp.ndarray,
+    n_microbatches: int,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    data_axis: str = "data",
+    stage_param_specs: Pytree = None,
+):
+    """Run the 1F1B schedule; returns ``(loss, correct, count, g_stage,
+    g_head, dx)``.
+
+    - ``stage_fn(params_slice, x) -> y``: one pipeline stage (pure).
+    - ``head_fn(head_params, y, tok) -> (mean_loss, correct_count)``: the
+      per-microbatch loss head, differentiable in its first two args.
+    - ``x``: [B, ...] activations entering stage 0 (already embedded).
+    - ``tokens``: [B, L] targets, microbatched alongside ``x``.
+    Gradients: ``g_stage`` stays sharded over ``pipe_axis`` (stage-stacked,
+    like the inputs); ``g_head`` and the scalar outputs are replicated;
+    ``dx`` ([B, ...]) is the cotangent for ``x`` — feed it to the embed vjp.
+    All gradients correspond to the mean loss over all M microbatches.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    B = x.shape[0]
+    M = n_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading axis {leaf.shape[0]} != '{pipe_axis}' "
+                f"mesh size {n_stages}"
+            )
+    mb = B // M
+    micro = x.reshape((M, mb) + x.shape[1:])
+    micro_tok = tokens.reshape((M, mb) + tokens.shape[1:])
+    # Stash slots: at stage 0, tick t both admits microbatch t (write) and
+    # retires microbatch t-2(P-1) (read) — 2(P-1)+1 simultaneously live.
+    S = 2 * (n_stages - 1) + 1              # the 1F1B bound, M-independent
+    T = M + 2 * (n_stages - 1)              # schedule length in ticks
+    perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+    perm_bwd = [(i + 1, i) for i in range(n_stages - 1)]
+
+    data_size = mesh.shape.get(data_axis, 1)
+    has_data = data_axis in mesh.axis_names and data_size > 1
+
+    def per_stage(params_st, head_p, micro_local, tok_local):
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_st)
+        idx = jax.lax.axis_index(pipe_axis)
+        last = n_stages - 1
+
+        def masked_add(acc, upd, active):
+            return jax.tree_util.tree_map(
+                lambda a, u: a + jnp.where(active, u, 0).astype(a.dtype),
+                acc, upd)
+
+        def tick(carry, t):
+            (fbuf, bbuf, stash, g_stage, g_head, d_micro,
+             loss_sum, correct_sum) = carry
+
+            # ---- forward: stage `idx` admits microbatch t - idx ----------
+            fwd_m = t - idx
+            active_f = jnp.logical_and(fwd_m >= 0, fwd_m < M)
+            feed = micro_local[jnp.clip(fwd_m, 0, M - 1)]
+            cur = jnp.where(idx == 0, feed, fbuf)
+            slot_f = jnp.mod(fwd_m, S)
+            stash = jnp.where(active_f, stash.at[slot_f].set(cur), stash)
+            y = stage_fn(params_local, cur)
+
+            # ---- loss head: last stage, same tick its forward retires ----
+            tok_m = tok_local[jnp.clip(fwd_m, 0, M - 1)]
+            (loss_m, correct_m), (dhead_m, dy_head) = _head_vjp(
+                head_fn, head_p, y, tok_m)
+            active_h = jnp.logical_and(active_f, idx == last)
+            g_head = masked_add(g_head, dhead_m, active_h)
+            loss_sum = loss_sum + jnp.where(active_h, loss_m, 0.0)
+            correct_sum = correct_sum + jnp.where(active_h, correct_m, 0.0)
+
+            # ---- backward: stage `idx` retires microbatch t-2(P-1)+idx ---
+            bwd_m = t - 2 * (n_stages - 1) + idx
+            active_b = jnp.logical_and(bwd_m >= 0, bwd_m < M)
+            dy_in = jnp.where(idx == last, dy_head, bbuf).astype(y.dtype)
+            x_in = stash[jnp.mod(bwd_m, S)]
+            # vjp re-runs the stage forward from the stashed input: in-stage
+            # remat by construction; residuals live only within this tick.
+            _, svjp = jax.vjp(stage_fn, params_local, x_in)
+            dp_m, dx_m = svjp(dy_in)
+            g_stage = masked_add(g_stage, dp_m, active_b)
+            write0 = jnp.logical_and(active_b, idx == 0)
+            d_micro = jnp.where(
+                write0,
+                d_micro.at[jnp.clip(bwd_m, 0, M - 1)].set(
+                    dx_m.astype(d_micro.dtype)),
+                d_micro,
+            )
+
+            fbuf_next = jax.lax.ppermute(y, pipe_axis, perm_fwd)
+            bbuf_next = jax.lax.ppermute(dx_m, pipe_axis, perm_bwd)
+            return (fbuf_next, bbuf_next, stash, g_stage, g_head, d_micro,
+                    loss_sum, correct_sum), None
+
+        zeros_act = jnp.zeros_like(micro_local[0])
+        carry0 = (
+            zeros_act,
+            zeros_act,
+            jnp.zeros((S,) + micro_local.shape[1:], micro_local.dtype),
+            jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape[1:], jnp.float32), params_st),
+            jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), head_p),
+            jnp.zeros(micro_local.shape, jnp.float32),
+            jnp.float32(0.0),
+            jnp.float32(0.0),
+        )
+        (_, _, _, g_stage, g_head, d_micro, loss_sum, correct_sum), _ = (
+            jax.lax.scan(tick, carry0, jnp.arange(T))
+        )
+
+        # Mean-of-microbatch-means: grads and loss scale by 1/M.
+        inv_m = 1.0 / M
+        g_stage = jax.tree_util.tree_map(lambda g: g * inv_m, g_stage)
+        g_head = jax.tree_util.tree_map(lambda g: g * inv_m, g_head)
+        d_micro = d_micro * inv_m
+        loss = loss_sum * inv_m
+
+        # Only the last stage holds loss/head grads; only stage 0 holds
+        # d_micro — psum over `pipe` broadcasts each to every stage.
+        loss = jax.lax.psum(loss, pipe_axis)
+        correct = jax.lax.psum(correct_sum, pipe_axis)
+        g_head = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, pipe_axis), g_head)
+        d_micro = jax.lax.psum(d_micro, pipe_axis)
+        if has_data:
+            # The loss is the mean over GLOBAL tokens = mean over data shards
+            # of the per-shard means — so parameter grads are the pmean of
+            # the per-shard grads, and the per-shard input cotangent carries
+            # a 1/data_size factor.  correct is a plain count: psum.
+            loss = jax.lax.pmean(loss, data_axis)
+            correct = jax.lax.psum(correct, data_axis)
+            g_stage = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axis), g_stage)
+            g_head = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axis), g_head)
+            d_micro = d_micro / data_size
+        # Re-stack the stage axis so out_specs P(pipe, ...) slots each
+        # stage's gradient into the stacked layout.
+        g_stage = jax.tree_util.tree_map(lambda g: g[None], g_stage)
+        return loss, correct, g_stage, g_head, d_micro
+
+    micro_spec = P(None, data_axis if has_data else None)
+    act_spec = P(*(micro_spec + (None,) * (micro.ndim - 2)))
+    tok_spec = P(*(micro_spec + (None,) * (micro_tok.ndim - 2)))
+    param_specs = (
+        stage_param_specs
+        if stage_param_specs is not None
+        else jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params)
+    )
+    rep = jax.tree_util.tree_map(lambda _: P(), head_params)
+    loss, correct, g_stage, g_head, d_micro = jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(param_specs, rep, act_spec, tok_spec),
+        out_specs=(P(), P(), param_specs, rep, act_spec),
+        check_vma=False,
+    )(stage_params, head_params, micro, micro_tok)
+    count = jnp.float32(tokens.shape[0] * (tokens.shape[1] - 1))
+    dx = d_micro.reshape(x.shape)
+    return loss, correct, count, g_stage, g_head, dx
+
+
+def _head_vjp(head_fn, head_p, y, tok_m):
+    """``jax.vjp`` of the loss head with the correct-count as aux: returns
+    ``(loss, correct), (dhead, dy)`` with the loss cotangent seeded at 1."""
+    loss_m, vjp, correct_m = jax.vjp(
+        lambda hp, yy: head_fn(hp, yy, tok_m), head_p, y, has_aux=True
+    )
+    dhead, dy = vjp(jnp.float32(1.0))
+    return (loss_m, correct_m), (dhead, dy)

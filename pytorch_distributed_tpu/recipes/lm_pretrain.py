@@ -72,10 +72,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "--sp — Megatron TP / ring SP run inside each stage)")
     p.add_argument("--microbatches", type=int, default=0,
                    help="pipeline microbatches (default: pp)")
+    p.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
+                   help="pipeline schedule: gpipe (autodiff, stash O(M)) or "
+                        "1f1b (interleaved manual gradients, stash bounded "
+                        "at 2(pp-1)+1 microbatches — parallel/pp_1f1b.py)")
+    p.add_argument("--remat", action="store_true",
+                   help="checkpoint each pipeline stage (gpipe schedule): "
+                        "stash stage inputs only, recompute activations in "
+                        "backward")
     p.add_argument("--fsdp", action="store_true",
                    help="shard parameters + optimizer state over the data "
                         "axis (ZeRO-3 layout; GSPMD paths, composes with "
-                        "--tp/--sp)")
+                        "--tp/--sp and with --pp: stage params gather at "
+                        "the pipeline boundary, grads reduce-scatter back)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
@@ -110,9 +119,14 @@ def main(argv=None) -> float:
     if args.sp > 1 and args.seq_len % args.sp:
         raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                          f"--sp {args.sp}")
-    if args.fsdp and args.pp > 1:
-        raise SystemExit("--fsdp applies to the GSPMD paths; the pipeline's "
-                         "shard_map stages manage their own sharding")
+    if args.schedule == "1f1b" and args.pp <= 1:
+        raise SystemExit("--schedule 1f1b requires --pp > 1")
+    if args.schedule == "1f1b" and (args.tp > 1 or args.sp > 1):
+        raise SystemExit("--schedule 1f1b supports plain stages; use gpipe "
+                         "for TP/SP-in-stage")
+    if args.remat and args.pp <= 1:
+        raise SystemExit("--remat applies to the pipeline stages "
+                         "(requires --pp > 1)")
     if args.fsdp and args.ep > 1:
         raise SystemExit("--fsdp with --ep is not supported yet")
     if n % (args.tp * args.sp * args.ep * args.pp):
@@ -177,6 +191,7 @@ def main(argv=None) -> float:
             n_stages=args.pp,
             n_microbatches=args.microbatches or args.pp,
             mesh=mesh, dtype=dtype, tp_size=args.tp, sp_size=args.sp,
+            schedule=args.schedule, remat=args.remat,
         )
         specs = "pp"
     else:

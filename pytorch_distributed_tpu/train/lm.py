@@ -169,9 +169,15 @@ def make_lm_train_step(
             )
             return loss, acc
 
-        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+        if getattr(model, "has_manual_grads", lambda: False)():
+            # 1F1B pipeline: gradients come from the schedule's own
+            # interleaved scan, not autodiff over the whole step
+            # (models/pipeline_lm.py loss_and_grads).
+            (loss, acc), grads = model.loss_and_grads(state.params, tokens)
+        else:
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
         if clip_grad_norm > 0.0:
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -303,12 +309,23 @@ class LMTrainer:
         self.eval_every = eval_every
         self.eval_batches = eval_batches
         self.best_ppl = float("inf")
+        self.eval_history: list = []  # (loss, ppl, acc%) per evaluate() call
         self._agree = None  # lazy PreemptionAgreement (see utils/preempt.py)
         self._eval_fn = (
             make_lm_eval_step(model, mesh, self.param_specs)
             if eval_dataset is not None
             else None
         )
+
+    def _put_tokens(self, tokens: np.ndarray) -> jax.Array:
+        """Host batch → sharded device array.  Multi-process: each process
+        contributes its local shard of the global batch (the LM counterpart
+        of DeviceFeeder._put)."""
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                self.token_sharding, tokens
+            )
+        return jax.device_put(tokens, self.token_sharding)
 
     def _preempt_agreed(self) -> bool:
         """Cross-process 'any rank flagged?' — every rank calls this at the
@@ -329,8 +346,8 @@ class LMTrainer:
             raise ValueError("LMTrainer built without eval_dataset")
         totals = {"loss_sum": 0.0, "correct": 0.0, "count": 0.0}
         for i in range(self.eval_batches):
-            tokens = jax.device_put(
-                self.eval_dataset.batch(i, self.batch_size), self.token_sharding
+            tokens = self._put_tokens(
+                self.eval_dataset.batch(i, self.batch_size)
             )
             sums = self._eval_fn(self.state, tokens)
             for k in totals:
@@ -341,6 +358,7 @@ class LMTrainer:
         acc = totals["correct"] * 100.0 / count
         print(f" * Eval loss {loss:.4f} ppl {ppl:.2f} Acc@1 {acc:.2f}",
               flush=True)
+        self.eval_history.append((loss, ppl, acc))
         return loss, ppl, acc
 
     def fit(self, steps: int, print_freq: int = 10) -> float:
@@ -353,38 +371,49 @@ class LMTrainer:
         end = time.time()
         final_ppl = None  # ppl from an interval eval on the very last step
         preempted = False
-        for i in range(steps):
-            # print_freq cadence: the cross-process agreement collective
-            # (see utils/preempt.py) must run at the same step on every
-            # rank, and stays off the per-step hot path.
-            if (self.preempt is not None and i % print_freq == 0
-                    and self._preempt_agreed()):
-                print(f"=> preemption signal: stopping at step {i}",
-                      flush=True)
-                preempted = True
-                break
-            tokens = jax.device_put(
-                self.dataset.batch(i, self.batch_size), self.token_sharding
-            )
-            if self.lr_schedule is not None:
-                lr = jnp.float32(self.lr_schedule(i))
-            self.state, metrics = self.step_fn(self.state, tokens, lr)
-            losses.update(metrics["loss"], self.batch_size)
-            accs.update(metrics["acc"], self.batch_size)
-            batch_time.update(time.time() - end)
-            end = time.time()
-            if i % print_freq == 0:
-                progress.display(i)
-            if (
-                self._eval_fn is not None
-                and self.eval_every > 0
-                and (i + 1) % self.eval_every == 0
-            ):
-                _, final_ppl, _ = self.evaluate()
-                self.best_ppl = min(self.best_ppl, final_ppl)
-                end = time.time()  # eval time must not pollute the step meter
-            else:
-                final_ppl = None
+        # Prefetch ≥2: batch assembly (real host work for TextFileDataset
+        # windows) + async transfer dispatch run on a producer thread, off
+        # the step hot path — the LM counterpart of the image DeviceFeeder
+        # (reference apex data_prefetcher, apex_distributed.py:115-169).
+        from pytorch_distributed_tpu.data.loader import AsyncFeeder
+
+        host_iter = (
+            self.dataset.batch(i, self.batch_size) for i in range(steps)
+        )
+        token_iter = AsyncFeeder(self._put_tokens, prefetch=2)(host_iter)
+        try:
+            for i in range(steps):
+                # print_freq cadence: the cross-process agreement collective
+                # (see utils/preempt.py) must run at the same step on every
+                # rank, and stays off the per-step hot path.
+                if (self.preempt is not None and i % print_freq == 0
+                        and self._preempt_agreed()):
+                    print(f"=> preemption signal: stopping at step {i}",
+                          flush=True)
+                    preempted = True
+                    break
+                tokens = next(token_iter)
+                if self.lr_schedule is not None:
+                    lr = jnp.float32(self.lr_schedule(i))
+                self.state, metrics = self.step_fn(self.state, tokens, lr)
+                losses.update(metrics["loss"], self.batch_size)
+                accs.update(metrics["acc"], self.batch_size)
+                batch_time.update(time.time() - end)
+                end = time.time()
+                if i % print_freq == 0:
+                    progress.display(i)
+                if (
+                    self._eval_fn is not None
+                    and self.eval_every > 0
+                    and (i + 1) % self.eval_every == 0
+                ):
+                    _, final_ppl, _ = self.evaluate()
+                    self.best_ppl = min(self.best_ppl, final_ppl)
+                    end = time.time()  # eval must not pollute the step meter
+                else:
+                    final_ppl = None
+        finally:
+            token_iter.close()  # unblocks the producer on early exit
         is_best = False
         if self._eval_fn is not None and not preempted:
             # Preempted runs skip the final eval: the SIGTERM grace window
