@@ -1,0 +1,60 @@
+"""Short CI versions of the round-3 convergence oracles.
+
+- experiments/convergence_hard.py: the 100-class low-SNR top-1 oracle —
+  here a 20-class / 2-epoch miniature pinning that (a) the task is NOT
+  saturating, (b) fp32 and bf16 agree within noise while both learn.
+- experiments/lm_text.py: real-text byte-LM held-out perplexity must drop.
+
+The full runs (committed RESULTS_convergence_hard.json /
+RESULTS_lm_text.json) use the same code paths at larger scale."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_hard_oracle_miniature(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "experiments"))
+    try:
+        import convergence_hard as ch
+    finally:
+        sys.path.pop(0)
+
+    # Miniature: 20 classes (4 hues × 5 angles via the same generator
+    # geometry), 2 epochs — small enough for CI, hard enough not to hit
+    # the ceiling.
+    ch.CLASSES, ch.HUES, ch.ANGLES = 20, 4, 5
+    ch.PER_CLASS_TRAIN, ch.PER_CLASS_VAL = 12, 4
+    ch.EPOCHS, ch.BATCH, ch.IMAGE = 2, 40, 32
+
+    root = str(tmp_path / "data")
+    ch.make_dataset(root)
+    curves = {}
+    for name, precision in (("fp32", "fp32"), ("bf16", "bf16")):
+        curves[name] = ch.run_config(root, str(tmp_path), name, precision,
+                                     1, False)
+    for name, curve in curves.items():
+        # 2 epochs only in CI: above 2× chance = learning; the committed
+        # full run (RESULTS_convergence_hard.json) shows the real curve.
+        assert curve[-1] > 2 * 100.0 / ch.CLASSES, (name, curve)
+        assert curve[-1] < 97.0, (name, curve)  # doesn't saturate
+    assert abs(curves["fp32"][-1] - curves["bf16"][-1]) <= 15.0, curves
+
+
+def test_lm_text_miniature(tmp_path):
+    out_path = str(tmp_path / "lm_text.json")
+    env = dict(os.environ)
+    env.update(LMTEXT_SEQ="128", LMTEXT_D="64", LMTEXT_STEPS="60",
+               LMTEXT_EVAL_EVERY="30", LMTEXT_OUT=out_path,
+               PYTHONPATH=REPO)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "experiments", "lm_text.py")],
+        env=env, capture_output=True, text=True, timeout=1200,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    with open(out_path) as f:
+        out = json.load(f)
+    assert out["curve"][-1]["ppl"] < out["initial"]["ppl"]
