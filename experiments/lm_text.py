@@ -129,9 +129,14 @@ def main() -> int:
         if final >= init_ppl * 0.5:
             print(f"FAIL: final ppl {final} not well below initial {init_ppl}")
             ok = False
-        for prev, cur in zip(curve, curve[1:]):
-            if cur["ppl"] > prev["ppl"] * 1.05:
-                print(f"FAIL: ppl rose {prev['ppl']} -> {cur['ppl']}")
+        # Byte-LM short-run eval is noisy; tolerate wobble, catch divergence:
+        # no eval may sit above 1.5x the best seen so far.
+        best_so_far = float("inf")
+        for cur in curve:
+            best_so_far = min(best_so_far, cur["ppl"])
+            if cur["ppl"] > best_so_far * 1.5:
+                print(f"FAIL: ppl {cur['ppl']} diverged from best "
+                      f"{best_so_far}")
                 ok = False
     print("lm_text:", "OK" if ok else "MISMATCH")
     return 0 if ok else 1

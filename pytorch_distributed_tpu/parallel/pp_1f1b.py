@@ -116,9 +116,21 @@ def pipeline_1f1b_loss_and_grads(
             y = stage_fn(params_local, cur)
 
             # ---- loss head: last stage, same tick its forward retires ----
+            # lax.cond so only the last stage pays the head (vocab-matmul
+            # fwd+bwd) each tick — the branch is runtime-resolved per
+            # device from axis_index, and contains no collectives.
             tok_m = tok_local[jnp.clip(fwd_m, 0, M - 1)]
-            (loss_m, correct_m), (dhead_m, dy_head) = _head_vjp(
-                head_fn, head_p, y, tok_m)
+
+            def run_head(hp, yy, tm):
+                return _head_vjp(head_fn, hp, yy, tm)
+
+            def skip_head(hp, yy, tm):
+                zh = jax.tree_util.tree_map(jnp.zeros_like, hp)
+                return ((jnp.float32(0.0), jnp.float32(0.0)),
+                        (zh, jnp.zeros_like(yy)))
+
+            (loss_m, correct_m), (dhead_m, dy_head) = jax.lax.cond(
+                idx == last, run_head, skip_head, head_p, y, tok_m)
             active_h = jnp.logical_and(active_f, idx == last)
             g_head = masked_add(g_head, dhead_m, active_h)
             loss_sum = loss_sum + jnp.where(active_h, loss_m, 0.0)

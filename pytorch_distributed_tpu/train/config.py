@@ -30,6 +30,7 @@ class Config:
     data: str = "/home/zhangzhi/Data/exports/ImageNet2012"
     arch: str = "resnet18"
     workers: int = 4
+    worker_type: str = "thread"   # "thread" | "process" (GIL-proof PIL path)
     epochs: int = 90
     start_epoch: int = 0
     batch_size: int = 3200        # GLOBAL batch (reference semantics)
@@ -74,6 +75,11 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="model architecture: " + " | ".join(names) + f" (default: {d.arch})")
     p.add_argument("-j", "--workers", default=d.workers, type=int, metavar="N",
                    help="number of data loading workers (default: 4)")
+    p.add_argument("--worker-type", default=d.worker_type,
+                   choices=("thread", "process"), dest="worker_type",
+                   help="loader workers: threads (native decode path) or "
+                        "spawned processes (GIL-proof Python/PIL decode, "
+                        "reference DataLoader worker semantics)")
     p.add_argument("--epochs", default=d.epochs, type=int, metavar="N",
                    help="number of total epochs to run")
     p.add_argument("--start-epoch", default=d.start_epoch, type=int, metavar="N",

@@ -66,10 +66,14 @@ class SyntheticTokenDataset:
         return _wraparound_batch(self, step, batch_size)
 
 
-def _wraparound_batch(ds, step: int, batch_size: int) -> np.ndarray:
-    """Sequential wrap-around batching shared by the LM datasets."""
+def _wraparound_batch(ds, step: int, batch_size: int,
+                      rows: Optional[Tuple[int, int]] = None) -> np.ndarray:
+    """Sequential wrap-around batching shared by the LM datasets.
+    ``rows=(lo, hi)``: assemble only that row range of the logical global
+    batch (multi-process: each host builds just its own shard)."""
     base = (step * batch_size) % max(1, len(ds))
-    return np.stack([ds[(base + i) % len(ds)] for i in range(batch_size)])
+    lo, hi = rows if rows is not None else (0, batch_size)
+    return np.stack([ds[(base + i) % len(ds)] for i in range(lo, hi)])
 
 
 class TextFileDataset:
@@ -269,13 +273,17 @@ class LMTrainer:
         lr_schedule=None,
         clip_grad_norm: float = 0.0,
         preempt=None,
+        prefetch: int = 2,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
         ``clip_grad_norm``: in-graph global-norm gradient clipping;
         ``preempt``: optional installed ``utils.preempt.PreemptionGuard`` —
         when it triggers, ``fit`` stops at the next step boundary and the
-        end-of-fit checkpoint captures the state."""
+        end-of-fit checkpoint captures the state.
+        ``prefetch``: token batches kept in flight by the background feeder
+        (0 = synchronous host assembly + transfer in the step loop — the
+        before/after axis measured in experiments/lm_feeder_bench.py)."""
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -310,6 +318,8 @@ class LMTrainer:
         self.eval_batches = eval_batches
         self.best_ppl = float("inf")
         self.eval_history: list = []  # (loss, ppl, acc%) per evaluate() call
+        self.prefetch = prefetch
+        self._span = None  # this process's batch-row range, computed once
         self._agree = None  # lazy PreemptionAgreement (see utils/preempt.py)
         self._eval_fn = (
             make_lm_eval_step(model, mesh, self.param_specs)
@@ -317,15 +327,49 @@ class LMTrainer:
             else None
         )
 
-    def _put_tokens(self, tokens: np.ndarray) -> jax.Array:
-        """Host batch → sharded device array.  Multi-process: each process
-        contributes its local shard of the global batch (the LM counterpart
-        of DeviceFeeder._put)."""
+    def _row_span(self) -> Tuple[int, int]:
+        """This process's row range of the global batch under the token
+        sharding — the LM counterpart of DistributedSampler's per-rank
+        shard (reference distributed.py:174-175).  Replicated axes (e.g.
+        a cross-process TP mesh with data=1) span the full batch; a
+        cross-process data axis yields a contiguous slice.  Static for
+        fixed shapes, so computed once."""
+        if self._span is None:
+            B = self.batch_size
+            if jax.process_count() == 1:
+                self._span = (0, B)
+            else:
+                gm = self.token_sharding.devices_indices_map(
+                    (B, self.dataset.seq_len))
+                me = jax.process_index()
+                spans = [
+                    (s[0].start or 0, B if s[0].stop is None else s[0].stop)
+                    for d, s in gm.items() if d.process_index == me
+                ]
+                self._span = (min(s[0] for s in spans),
+                              max(s[1] for s in spans))
+        return self._span
+
+    def _local_rows(self, global_batch: np.ndarray) -> np.ndarray:
+        """Slice an already-assembled global batch down to this process's
+        rows (prefer ``_local_batch``, which never assembles foreign rows)."""
+        lo, hi = self._row_span()
+        return global_batch[lo:hi]
+
+    def _local_batch(self, ds, step: int) -> np.ndarray:
+        """Assemble ONLY this process's rows of logical global batch
+        ``step`` — no cross-host redundant window stacking."""
+        return _wraparound_batch(ds, step, self.batch_size,
+                                 rows=self._row_span())
+
+    def _put_tokens(self, local_tokens: np.ndarray) -> jax.Array:
+        """This process's host rows → sharded global device array (the LM
+        counterpart of DeviceFeeder._put)."""
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(
-                self.token_sharding, tokens
+                self.token_sharding, local_tokens
             )
-        return jax.device_put(tokens, self.token_sharding)
+        return jax.device_put(local_tokens, self.token_sharding)
 
     def _preempt_agreed(self) -> bool:
         """Cross-process 'any rank flagged?' — every rank calls this at the
@@ -346,9 +390,7 @@ class LMTrainer:
             raise ValueError("LMTrainer built without eval_dataset")
         totals = {"loss_sum": 0.0, "correct": 0.0, "count": 0.0}
         for i in range(self.eval_batches):
-            tokens = self._put_tokens(
-                self.eval_dataset.batch(i, self.batch_size)
-            )
+            tokens = self._put_tokens(self._local_batch(self.eval_dataset, i))
             sums = self._eval_fn(self.state, tokens)
             for k in totals:
                 totals[k] += float(sums[k])
@@ -377,10 +419,16 @@ class LMTrainer:
         # (reference apex data_prefetcher, apex_distributed.py:115-169).
         from pytorch_distributed_tpu.data.loader import AsyncFeeder
 
+        # Each process assembles ONLY its own rows (wraparound batching,
+        # the convention both LM datasets implement).
         host_iter = (
-            self.dataset.batch(i, self.batch_size) for i in range(steps)
+            self._local_batch(self.dataset, i) for i in range(steps)
         )
-        token_iter = AsyncFeeder(self._put_tokens, prefetch=2)(host_iter)
+        if self.prefetch > 0:
+            token_iter = AsyncFeeder(self._put_tokens,
+                                     prefetch=self.prefetch)(host_iter)
+        else:  # synchronous baseline (measured in lm_feeder_bench)
+            token_iter = (self._put_tokens(b) for b in host_iter)
         try:
             for i in range(steps):
                 # print_freq cadence: the cross-process agreement collective

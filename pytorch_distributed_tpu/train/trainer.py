@@ -248,6 +248,7 @@ class Trainer:
             seed=seed,
             batch_mode=batch_mode,
             random_flip=batch_mode != "f32",
+            worker_type=cfg.worker_type,
         )
         self.val_loader = DataLoader(
             self.val_set,
@@ -256,6 +257,7 @@ class Trainer:
             num_workers=cfg.workers,
             seed=seed,
             batch_mode=batch_mode,
+            worker_type=cfg.worker_type,
         )
 
     # ----------------------------------------------------------------- train
