@@ -8,9 +8,13 @@ experiment rebuilds the reference's accuracy oracle (per-epoch val top-1,
 reference distributed.py:212,321-322) on a task hard enough to sit well
 below the ceiling:
 
-- **100 classes** = 10 hue tints × 10 blob positions, weak signal, strong
-  per-image noise → resnet18 plateaus in the middle of the range, where
-  numerics differences would actually move the curve;
+- **100 classes** = a fine-grained hue wheel (class c → hue c/100) with
+  per-image hue jitter at 0.45× the class spacing.  Hue is global, so the
+  signal survives RandomResizedCrop + flip (position/texture codes do
+  not), and the jitter puts an ANALYTIC ceiling on top-1:
+  P(correct) = erf(spacing / (2·sqrt(2)·jitter·spacing)) =
+  erf(1/(2·sqrt(2)·0.45)) ~= 73% — the curve plateaus mid-range by
+  construction, where numerics differences would actually move it;
 - configs: fp32, bf16, bf16+accum, explicit-collectives+bf16-wire
   (the Horovod-recipe analogue), and **1-device DP vs 8-device DP**
   (the data-parallel invariance claim, run in a subprocess with a 1-device
@@ -46,41 +50,40 @@ if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
         pass
 
 CLASSES = 100
-HUES = 10          # class = hue_idx * 10 + angle_idx
-ANGLES = 10
 PER_CLASS_TRAIN = int(os.environ.get("CONVH_PER_CLASS", "20"))
 PER_CLASS_VAL = 5
 IMAGE = 40
 EPOCHS = int(os.environ.get("CONVH_EPOCHS", "8"))
 BATCH = 40
-NOISE = 0.24       # per-pixel gaussian noise sigma (signal tint is 0.12)
+NOISE = float(os.environ.get("CONVH_NOISE", "0.15"))   # per-pixel noise sigma
+TINT = float(os.environ.get("CONVH_TINT", "0.25"))     # hue signal strength
+# Per-image hue jitter as a fraction of the class spacing (1/CLASSES):
+# the irreducible confusion that pins the plateau below the ceiling.
+# P(top-1) ~= erf(1 / (2*sqrt(2)*JITTER)) -> 0.34 gives ~86%... 0.5 ~ 68%.
+JITTER = float(os.environ.get("CONVH_JITTER", "0.45"))
+LR = float(os.environ.get("CONVH_LR", "0.06"))
 
 
 def make_dataset(root: str, seed: int = 0) -> None:
-    """100 weak-signal classes: subtle hue tint × jittered blob position
-    under heavy noise — learnable, far from saturating."""
+    """Hue-wheel classes under per-image hue jitter and pixel noise —
+    learnable, but the jitter caps top-1 well below 100% (see module
+    docstring for the analytic ceiling)."""
     from PIL import Image
 
     rng = np.random.default_rng(seed)
     for split, per in (("train", PER_CLASS_TRAIN), ("val", PER_CLASS_VAL)):
         for c in range(CLASSES):
-            hue = (c // ANGLES) / HUES
-            ang = 2 * np.pi * (c % ANGLES) / ANGLES
             d = os.path.join(root, split, f"class{c:03d}")
             os.makedirs(d, exist_ok=True)
             for i in range(per):
+                # class hue + irreducible per-image jitter (the plateau knob)
+                hue = c / CLASSES + rng.normal(0.0, JITTER / CLASSES)
                 img = rng.normal(0.45, NOISE, size=(IMAGE, IMAGE, 3))
                 tint = np.array([
                     0.5 + 0.5 * np.cos(2 * np.pi * (hue + k / 3.0))
                     for k in range(3)
                 ])
-                img += 0.12 * tint
-                cy = IMAGE / 2 + (IMAGE / 3.2) * np.sin(ang) + rng.normal(0, 1.5)
-                cx = IMAGE / 2 + (IMAGE / 3.2) * np.cos(ang) + rng.normal(0, 1.5)
-                yy, xx = np.mgrid[0:IMAGE, 0:IMAGE]
-                blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2)
-                                / (2 * (IMAGE / 10) ** 2)))
-                img += 0.30 * blob[..., None]
+                img += TINT * tint
                 arr = (np.clip(img, 0, 1) * 255).astype(np.uint8)
                 Image.fromarray(arr).save(os.path.join(d, f"{i:03d}.jpg"),
                                           quality=92)
@@ -95,7 +98,7 @@ def run_config(data_root: str, tmpdir: str, name: str, precision: str,
 
     cfg = Config(
         data=data_root, arch="resnet18", batch_size=BATCH, epochs=EPOCHS,
-        lr=0.02, print_freq=1000, seed=0, image_size=IMAGE,
+        lr=LR, print_freq=1000, seed=0, image_size=IMAGE,
         precision=precision, accum_steps=accum,
         checkpoint_dir=os.path.join(tmpdir, name),
         workers=2,
@@ -106,6 +109,7 @@ def run_config(data_root: str, tmpdir: str, name: str, precision: str,
     for epoch in range(EPOCHS):
         t.train_epoch(epoch)
         curve.append(round(float(t.validate()), 3))
+        print(f"[{name}] epoch {epoch}: top-1 {curve[-1]}", flush=True)
     return curve
 
 
@@ -128,7 +132,7 @@ def main() -> int:
     out_path = os.path.abspath(os.path.join(here, "..",
                                             "RESULTS_convergence_hard.json"))
     fingerprint = [CLASSES, PER_CLASS_TRAIN, PER_CLASS_VAL, IMAGE, EPOCHS,
-                   BATCH, NOISE]
+                   BATCH, NOISE, TINT, JITTER]
     only = os.environ.get("CONVH_ONLY", "")
     data_root = os.environ.get("CONVH_DATA", "")
 
@@ -153,7 +157,7 @@ def main() -> int:
         "dataset": f"{CLASSES}-class low-SNR synthetic ImageFolder (JPEG), "
                    f"{CLASSES * PER_CLASS_TRAIN} train / "
                    f"{CLASSES * PER_CLASS_VAL} val, {IMAGE}px, "
-                   f"noise {NOISE}",
+                   f"noise {NOISE} tint {TINT} hue-jitter {JITTER}x spacing",
         "arch": "resnet18",
         "epochs": EPOCHS,
         "batch": BATCH,

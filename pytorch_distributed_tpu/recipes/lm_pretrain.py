@@ -13,7 +13,9 @@ of the TransformerLM with the framework's parallelism menu —
   with the data axis AND with ``--tp``/``--sp``, which then run *inside*
   each stage (``parallel/tp_stage.py``) — up to all four axes in one
   ``(data, pipe, seq, model)`` mesh
-- ``--ep N``  expert parallelism (MoE model variant; exclusive)
+- ``--ep N``  expert parallelism (MoE model variant; exclusive of
+  --tp/--sp/--pp, composes with --fsdp: non-expert leaves and the free
+  dims of the expert stacks shard over ``data``)
 - remaining devices form the ``data`` axis (gradient psum)
 
 Examples (8 simulated chips):
@@ -83,8 +85,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fsdp", action="store_true",
                    help="shard parameters + optimizer state over the data "
                         "axis (ZeRO-3 layout; GSPMD paths, composes with "
-                        "--tp/--sp and with --pp: stage params gather at "
-                        "the pipeline boundary, grads reduce-scatter back)")
+                        "--tp/--sp/--ep and with --pp: stage params gather "
+                        "at the pipeline boundary, grads reduce-scatter "
+                        "back)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
@@ -127,8 +130,6 @@ def main(argv=None) -> float:
     if args.remat and args.pp <= 1:
         raise SystemExit("--remat applies to the pipeline stages "
                          "(requires --pp > 1)")
-    if args.fsdp and args.ep > 1:
-        raise SystemExit("--fsdp with --ep is not supported yet")
     if n % (args.tp * args.sp * args.ep * args.pp):
         raise SystemExit(f"{n} devices not divisible by tp*sp*ep*pp")
     if args.pp > 1 and args.n_layers % args.pp:

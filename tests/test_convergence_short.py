@@ -23,10 +23,9 @@ def test_hard_oracle_miniature(tmp_path):
     finally:
         sys.path.pop(0)
 
-    # Miniature: 20 classes (4 hues × 5 angles via the same generator
-    # geometry), 2 epochs — small enough for CI, hard enough not to hit
-    # the ceiling.
-    ch.CLASSES, ch.HUES, ch.ANGLES = 20, 4, 5
+    # Miniature: a 20-class hue wheel with the same jittered-hue generator,
+    # 2 epochs — small enough for CI, jitter keeps it off the ceiling.
+    ch.CLASSES = 20
     ch.PER_CLASS_TRAIN, ch.PER_CLASS_VAL = 12, 4
     ch.EPOCHS, ch.BATCH, ch.IMAGE = 2, 40, 32
 

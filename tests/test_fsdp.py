@@ -88,6 +88,65 @@ def test_fsdp_composes_with_tp():
     assert "model" in qkv and "data" in qkv
 
 
+def test_fsdp_composes_with_ep():
+    """--fsdp with --ep: expert-stacked leaves keep P('expert', ...) and
+    gain the data axis on a free dim; one step matches the plain-EP layout."""
+    from jax.sharding import NamedSharding
+
+    from pytorch_distributed_tpu.models.moe import moe_specs
+    from pytorch_distributed_tpu.parallel.tp import shard_state
+
+    mesh = build_mesh(MeshSpec(("data", "expert"), (4, 2)), jax.devices()[:8])
+    model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
+                          n_layers=1, moe_experts=2)
+    tokens0 = jnp.zeros((1, SEQ), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
+
+    base = moe_specs(params)
+    sp = fsdp_specs(params, mesh, base_specs=base, min_size=64)
+    fc1 = sp["block_0"]["moe"]["experts"]["fc1"]["kernel"]
+    assert "expert" in fc1 and "data" in fc1
+    assert sp != base, "fsdp_specs left the ep layout unchanged"
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, VOCAB, size=(BATCH, SEQ))
+                         .astype(np.int32))
+    results = {}
+    with mesh:
+        toks = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+        for name, specs in (("ep", base), ("ep_fsdp", sp)):
+            # fresh copy per layout: shard_state donates (deletes) its input
+            p = jax.tree_util.tree_map(jnp.array, params)
+            state = shard_state(
+                TrainState.create({"params": p}, sgd_init(p)), specs, mesh)
+            step = make_lm_train_step(model, mesh, specs, weight_decay=0.0)
+            state2, metrics = step(state, toks, jnp.float32(0.05))
+            results[name] = (float(metrics["loss"]),
+                             jax.device_get(state2.params))
+    assert results["ep"][0] == pytest.approx(results["ep_fsdp"][0], rel=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(results["ep"][1]),
+                    jax.tree_util.tree_leaves(results["ep_fsdp"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_lm_pretrain_ep_fsdp_runs_and_learns(capsys, tmp_path):
+    from pytorch_distributed_tpu.recipes import lm_pretrain
+
+    final = lm_pretrain.main([
+        "--vocab", "32", "--d-model", "32", "--n-heads", "2",
+        "--n-layers", "1", "--seq-len", "32", "-b", "8",
+        "--steps", "15", "--lr", "0.05", "-p", "4",
+        "--dataset-length", "8", "--precision", "fp32",
+        "--ep", "2", "--fsdp", "--no-eval",
+        "--checkpoint-dir", str(tmp_path),
+    ])
+    out = capsys.readouterr().out
+    first = float(out.split("Loss ")[1].split(" ")[0])
+    assert final < first
+    assert (tmp_path / "checkpoint.msgpack").exists()
+
+
 def test_lm_pretrain_fsdp_runs_and_learns(capsys, tmp_path):
     from pytorch_distributed_tpu.recipes import lm_pretrain
 

@@ -164,3 +164,24 @@ def test_clip_grad_norm_bounds_update():
     unclipped = run(0.0)
     assert clipped <= 1e-3 + 1e-6   # ||Δparams|| = lr * ||clipped grads||
     assert unclipped > 10 * clipped
+
+
+def test_prefetch_modes_produce_identical_training():
+    """prefetch=2 (AsyncFeeder) and prefetch=0 (synchronous baseline) must
+    consume identical batch streams — same final loss and params."""
+    mesh = _mesh()
+    results = {}
+    for prefetch in (0, 2):
+        model = TransformerLM(vocab_size=32, d_model=32, n_heads=2,
+                              n_layers=1)
+        ds = SyntheticTokenDataset(16, 16, 32)
+        with mesh:
+            t = LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
+                          prefetch=prefetch)
+            final = t.fit(6, print_freq=100)
+            results[prefetch] = (final,
+                                 jax.device_get(t.state.params))
+    assert results[0][0] == results[2][0]
+    for a, b in zip(jax.tree_util.tree_leaves(results[0][1]),
+                    jax.tree_util.tree_leaves(results[2][1])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
