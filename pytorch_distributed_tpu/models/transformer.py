@@ -46,15 +46,10 @@ def rope(x: jnp.ndarray, base: float = 10000.0, offset=0) -> jnp.ndarray:
 
 
 def _pick_attention(L: int, attn_impl: str):
-    """'auto' selects the Pallas flash kernel on TPU at long L (where it
-    beats XLA dense ~1.4-2.4×, see ops/flash_attention.py); dense otherwise."""
-    if attn_impl == "flash":
-        return "flash"
-    if attn_impl == "dense":
-        return "dense"
-    if jax.default_backend() == "tpu" and L >= 4096 and L % 1024 == 0:
-        return "flash"
-    return "dense"
+    """Shared 'auto' flash/dense policy — ops/flash_attention.py."""
+    from pytorch_distributed_tpu.ops.flash_attention import pick_attention_impl
+
+    return pick_attention_impl(L, attn_impl)
 
 
 class SelfAttention(nn.Module):
@@ -65,6 +60,7 @@ class SelfAttention(nn.Module):
     attn_impl: str = "auto"  # auto | dense | flash
     decode: bool = False     # KV-cached autoregressive mode
     max_len: int = 0         # cache capacity (decode mode)
+    sp_impl: str = "ring"    # ring | a2a (Ulysses-style all-to-all SP)
 
     @nn.compact
     def __call__(self, x):
@@ -79,8 +75,17 @@ class SelfAttention(nn.Module):
         q, k = rope(q), rope(k)
         if self.ring:
             if self.mesh is None:
-                raise ValueError("ring attention requires a mesh with a 'seq' axis")
-            out = ring_self_attention(q, k, v, self.mesh, causal=True)
+                raise ValueError(
+                    "sequence parallelism requires a mesh with a 'seq' axis")
+            if self.sp_impl == "a2a":
+                from pytorch_distributed_tpu.parallel.ulysses import (
+                    a2a_self_attention,
+                )
+
+                out = a2a_self_attention(q, k, v, self.mesh, causal=True,
+                                         inner=self.attn_impl)
+            else:
+                out = ring_self_attention(q, k, v, self.mesh, causal=True)
         elif _pick_attention(L, self.attn_impl) == "flash":
             from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
@@ -148,6 +153,7 @@ class Block(nn.Module):
     moe_top_k: int = 1
     decode: bool = False
     max_len: int = 0
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x):
@@ -155,7 +161,8 @@ class Block(nn.Module):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + SelfAttention(self.n_heads, self.dtype, self.mesh, self.ring,
                               self.attn_impl, decode=self.decode,
-                              max_len=self.max_len, name="attn")(h)
+                              max_len=self.max_len, sp_impl=self.sp_impl,
+                              name="attn")(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.moe_experts > 0:
             from pytorch_distributed_tpu.models.moe import MoEMLP
@@ -187,6 +194,7 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 1    # 1 = Switch routing; 2 = Mixtral-style top-2
     decode: bool = False  # KV-cached autoregressive inference mode
     max_len: int = 0      # cache capacity (decode mode)
+    sp_impl: str = "ring"  # ring | a2a (Ulysses-style; parallel/ulysses.py)
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -198,7 +206,7 @@ class TransformerLM(nn.Module):
             x = block_cls(self.n_heads, self.dtype, self.mesh, self.ring,
                           self.attn_impl, self.moe_experts, self.moe_top_k,
                           decode=self.decode, max_len=self.max_len,
-                          name=f"block_{i}")(x)
+                          sp_impl=self.sp_impl, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied output head (embed.attend) keeps params lean at long context.
         return embed.attend(x.astype(jnp.float32)).astype(jnp.float32)

@@ -387,6 +387,18 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
     return jax.default_backend() != "tpu"
 
 
+def pick_attention_impl(L: int, attn_impl: str = "auto") -> str:
+    """The shared 'auto' policy: the Pallas flash kernel on TPU at long,
+    1024-aligned L (where it beats XLA dense ~1.4-2.4×, RESULTS_flash.json);
+    dense otherwise.  Used by models/transformer.SelfAttention and the
+    Ulysses a2a inner attention (parallel/ulysses.py)."""
+    if attn_impl in ("flash", "dense"):
+        return attn_impl
+    if jax.default_backend() == "tpu" and L >= 4096 and L % 1024 == 0:
+        return "flash"
+    return "dense"
+
+
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, bwd_impl):
     out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
                           _resolve_interpret(interpret))

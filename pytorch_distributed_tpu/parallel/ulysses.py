@@ -1,0 +1,115 @@
+"""All-to-all (Ulysses-style) sequence parallelism over a ``seq`` mesh axis.
+
+Beyond-reference capability (the reference has no sequence parallelism at
+all — SURVEY.md §5.7); this is the second of the framework's two SP
+formulations, complementing ``parallel/ring.py``:
+
+- **ring**: KV blocks rotate around the ring (P-1 ``ppermute`` neighbor
+  hops); attention math is blockwise-online; per-device memory is
+  O(L·L/P) score-free and the sequence axis can grow with the ring.
+- **a2a (this module)**: two ``all_to_all`` exchanges re-slice the sharded
+  activations from sequence-sharded to *head*-sharded and back
+  (DeepSpeed-Ulysses pattern, Jacobs et al. 2023).  In between, every
+  device holds the FULL sequence for H/P heads, so the inner attention is
+  an ordinary single-device kernel — including the Pallas flash kernel
+  (``ops/flash_attention.py``), which the blockwise ring formulation
+  cannot reuse.  Comms per attention: 4 all-to-alls (q, k, v in; out
+  back), each moving B·L·C/P elements over ICI — a constant number of
+  hops independent of P, vs the ring's P-1 rounds.
+
+Trade-off (documented, both shipped): a2a needs ``local_heads % P == 0``
+and materializes full-L scores per head group under the dense inner
+(O(L²·H/P) — use ``inner='flash'`` at long L); ring has no head-count
+constraint and never materializes L² anything.
+
+Layout contract matches ring.py: global ``[batch, seq, heads, head_dim]``,
+sequence sharded over ``seq_axis``, batch over ``data_axis``, and —
+composing with Megatron TP — heads over ``model_axis``; the all-to-all
+then subdivides the model-local heads across the seq axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_tpu.parallel.ring import dense_attention
+
+
+def a2a_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = "seq",
+    causal: bool = True,
+    inner: str = "auto",
+) -> jnp.ndarray:
+    """Ulysses attention on device-local blocks; call *inside* ``shard_map``.
+
+    ``q/k/v``: local ``[B, L/P, H_local, D]``.  ``inner`` selects the
+    full-sequence attention run on each head group: ``'dense'``,
+    ``'flash'`` (Pallas kernel), or ``'auto'`` (flash on TPU at long,
+    1024-aligned L — same policy as models/transformer._pick_attention).
+    """
+    P_ = jax.lax.axis_size(axis_name)
+    B, Lb, H, D = q.shape
+    if H % P_:
+        raise ValueError(
+            f"a2a sequence parallelism needs local heads ({H}) divisible by "
+            f"the '{axis_name}' axis size ({P_}); use ring SP otherwise"
+        )
+    L = Lb * P_
+    from pytorch_distributed_tpu.ops.flash_attention import pick_attention_impl
+
+    inner = pick_attention_impl(L, inner)
+
+    # seq-sharded -> head-sharded: [B, L/P, H, D] -> [B, L, H/P, D].
+    # Concatenation order along seq follows device order on the axis, so
+    # gathered positions are global positions (rope was applied upstream).
+    def to_heads(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qg, kg, vg = to_heads(q), to_heads(k), to_heads(v)
+    if inner == "flash":
+        from pytorch_distributed_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(qg, kg, vg, causal)
+    else:
+        out = dense_attention(qg, kg, vg, causal=causal)
+    # head-sharded -> seq-sharded: [B, L, H/P, D] -> [B, L/P, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def a2a_self_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    causal: bool = True,
+    seq_axis: str = "seq",
+    data_axis: Optional[str] = "data",
+    model_axis: Optional[str] = "model",
+    inner: str = "auto",
+) -> jnp.ndarray:
+    """``shard_map`` wrapper mirroring ``ring_self_attention``: global
+    ``[B, L, H, D]`` in/out with L sharded over ``seq_axis`` (B over
+    ``data_axis``; composing with Megatron TP, heads over ``model_axis`` —
+    the all-to-all splits the model-local head group across ``seq_axis``,
+    so H must be divisible by seq·model)."""
+    batch_spec = data_axis if data_axis in mesh.axis_names else None
+    head_spec = (
+        model_axis if model_axis and model_axis in mesh.axis_names else None
+    )
+    spec = P(batch_spec, seq_axis, head_spec, None)
+    fn = functools.partial(a2a_attention, axis_name=seq_axis, causal=causal,
+                           inner=inner)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

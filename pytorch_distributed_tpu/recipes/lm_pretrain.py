@@ -6,9 +6,11 @@ of the TransformerLM with the framework's parallelism menu —
 
 - ``--tp N``  tensor parallelism (Megatron-style sharded qkv/proj/fc1/fc2 +
   vocab-sharded embedding; XLA inserts the per-block all-reduces)
-- ``--sp N``  sequence parallelism (ring attention over the ``seq`` axis);
-  **composes with --tp**: one ``(data, seq, model)`` mesh, heads sharded
-  over ``model`` inside the ring
+- ``--sp N``  sequence parallelism over the ``seq`` axis — ``--sp-impl
+  ring`` (KV rotation) or ``a2a`` (Ulysses-style all-to-all re-slice to
+  head-sharded; the inner attention sees the full sequence and can run
+  the Pallas flash kernel); **composes with --tp**: one ``(data, seq,
+  model)`` mesh, heads sharded over ``model`` inside either formulation
 - ``--pp N``  pipeline parallelism (GPipe stages over ``pipe``); composes
   with the data axis AND with ``--tp``/``--sp``, which then run *inside*
   each stage (``parallel/tp_stage.py``) — up to all four axes in one
@@ -64,6 +66,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel (ring) size")
+    p.add_argument("--sp-impl", choices=("ring", "a2a"), default="ring",
+                   help="SP formulation: ring (ppermute KV rotation, no "
+                        "head constraint) or a2a (Ulysses-style all-to-all "
+                        "to head-sharded, inner attention sees the full "
+                        "sequence and can use the Pallas flash kernel; "
+                        "needs n_heads divisible by sp*tp)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel size (MoE MLPs, one expert/device)")
     p.add_argument("--moe-top-k", type=int, default=1,
@@ -154,6 +162,14 @@ def main(argv=None) -> float:
     if args.generate > 0 and (args.tp > 1 or args.sp > 1 or args.ep > 1
                               or args.pp > 1):
         raise SystemExit("--generate supports plain dp runs only")
+    if args.sp_impl == "a2a" and args.sp > 1:
+        if args.pp > 1:
+            raise SystemExit("--sp-impl a2a does not run inside pipeline "
+                             "stages yet; use the ring schedule with --pp")
+        if args.n_heads % (args.sp * args.tp):
+            raise SystemExit(f"--sp-impl a2a shards heads: --n-heads "
+                             f"{args.n_heads} must be divisible by "
+                             f"sp*tp = {args.sp * args.tp}")
     if args.tp > 1 and args.sp > 1 and args.n_heads % args.tp:
         # Composed with ring SP the attention heads are explicitly sharded
         # over 'model' (ring.py shard_map specs); pure GSPMD TP has no such
@@ -211,6 +227,7 @@ def main(argv=None) -> float:
             vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, dtype=dtype,
             mesh=mesh if args.sp > 1 else None, ring=args.sp > 1,
+            sp_impl=args.sp_impl,
         )
         specs = "tp" if args.tp > 1 else None
 
