@@ -12,6 +12,7 @@ which XLA emits the collectives.
 
 from pytorch_distributed_tpu.parallel.mesh import (
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
     data_parallel_mesh,
     local_device_count,
@@ -25,6 +26,7 @@ from pytorch_distributed_tpu.parallel.dist import (
 
 __all__ = [
     "MeshSpec",
+    "build_hybrid_mesh",
     "build_mesh",
     "data_parallel_mesh",
     "local_device_count",

@@ -1,0 +1,87 @@
+"""Multi-slice (ICI × DCN) mesh construction — parallel/mesh.py."""
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.parallel import (
+    MeshSpec,
+    build_hybrid_mesh,
+    build_mesh,
+)
+
+
+def test_single_slice_falls_back_to_flat_mesh():
+    """CPU-sim devices carry no slice topology — build_hybrid_mesh must
+    degrade to plain build_mesh with identical device placement."""
+    spec = MeshSpec(("data", "model"), (4, 2))
+    hybrid = build_hybrid_mesh(spec, devices=jax.devices()[:8])
+    flat = build_mesh(spec, jax.devices()[:8])
+    assert hybrid.axis_names == flat.axis_names
+    assert hybrid.shape == flat.shape
+    assert (np.asarray(hybrid.devices) == np.asarray(flat.devices)).all()
+
+
+def test_rejects_unknown_dcn_axis():
+    with pytest.raises(ValueError, match="dcn_axis"):
+        build_hybrid_mesh(MeshSpec(("data",), (-1,)), dcn_axis="pipe",
+                          devices=jax.devices()[:8])
+
+
+class _FakeSliceDevice:
+    """Stub with the slice topology attribute the hybrid path dispatches on."""
+
+    def __init__(self, i, n_per_slice):
+        self.id = i
+        self.slice_index = i // n_per_slice
+        self.process_index = self.slice_index
+
+
+def test_multi_slice_splits_dcn_axis(monkeypatch):
+    """With 2 fake slices × 4 devices, the data axis (8) must decompose into
+    ici=4 per slice × dcn=2 across slices, delegated to
+    mesh_utils.create_hybrid_device_mesh."""
+    from jax.experimental import mesh_utils
+
+    fakes = [_FakeSliceDevice(i, 4) for i in range(8)]
+    captured = {}
+
+    def fake_hybrid(ici_shape, dcn_shape, devices, **kw):
+        captured["ici"] = tuple(ici_shape)
+        captured["dcn"] = tuple(dcn_shape)
+        captured["n"] = len(devices)
+        return np.asarray(jax.devices()[:8]).reshape(
+            tuple(i * d for i, d in zip(ici_shape, dcn_shape)))
+
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", fake_hybrid)
+    mesh = build_hybrid_mesh(MeshSpec(("data",), (-1,)), devices=fakes)
+    assert captured == {"ici": (4,), "dcn": (2,), "n": 8}
+    assert mesh.shape == {"data": 8}
+
+
+def test_multi_slice_dcn_axis_must_divide():
+    fakes = [_FakeSliceDevice(i, 2) for i in range(6)]  # 3 slices × 2
+    with pytest.raises(ValueError, match="not divisible"):
+        # data axis carries 2 of 6 devices → 2 % 3 slices != 0
+        build_hybrid_mesh(MeshSpec(("data", "model"), (2, 3)), devices=fakes)
+
+
+def test_multi_slice_inner_axis_stays_in_slice(monkeypatch):
+    """Only the dcn axis is split across slices; model stays ICI-local."""
+    from jax.experimental import mesh_utils
+
+    fakes = [_FakeSliceDevice(i, 4) for i in range(8)]
+    captured = {}
+
+    def fake_hybrid(ici_shape, dcn_shape, devices, **kw):
+        captured["ici"] = tuple(ici_shape)
+        captured["dcn"] = tuple(dcn_shape)
+        return np.asarray(jax.devices()[:8]).reshape(
+            tuple(i * d for i, d in zip(ici_shape, dcn_shape)))
+
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", fake_hybrid)
+    mesh = build_hybrid_mesh(MeshSpec(("data", "model"), (4, 2)),
+                             devices=fakes)
+    assert captured["ici"] == (2, 2)  # data 4 = 2/slice × 2 slices
+    assert captured["dcn"] == (2, 1)  # model never crosses DCN
+    assert mesh.shape == {"data": 4, "model": 2}
