@@ -32,15 +32,17 @@ def generate(
     dtype: Any = jnp.float32,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     seed: int = 0,
 ) -> jnp.ndarray:
     """Decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
 
     ``params``: a trained TransformerLM's ``params`` tree (decode mode uses
     the same parameter structure).  ``temperature=0`` is greedy argmax;
-    ``temperature>0`` samples from softmax(logits/T), optionally truncated
-    to the ``top_k`` most likely tokens.  Returns ``[B, max_new_tokens]``
-    int32.
+    ``temperature>0`` samples from softmax(logits/T), truncated to the
+    ``top_k`` most likely tokens and/or the nucleus holding ``top_p``
+    probability mass (both filters compose, k first).  Returns
+    ``[B, max_new_tokens]`` int32.
     """
     B, P = prompt.shape
     model = TransformerLM(
@@ -65,6 +67,19 @@ def generate(
             kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][
                 ..., -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if 0.0 < top_p < 1.0:
+            # Nucleus: keep the smallest prefix (by descending probability)
+            # whose mass reaches top_p — i.e. drop tokens whose preceding
+            # cumulative mass already covers it.  Static shapes: sort +
+            # cumsum + gather back through the inverse permutation.
+            order = jnp.argsort(-logits, axis=-1)
+            sorted_probs = jax.nn.softmax(
+                jnp.take_along_axis(logits, order, axis=-1), axis=-1)
+            mass_before = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+            drop_sorted = mass_before >= top_p
+            inv = jnp.argsort(order, axis=-1)
+            drop = jnp.take_along_axis(drop_sorted, inv, axis=-1)
+            logits = jnp.where(drop, -jnp.inf, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     @jax.jit

@@ -94,3 +94,29 @@ def test_sampled_generation_temperature_and_topk():
     # top-k=1 collapses sampling back to greedy
     k1 = generate(params, prompt, 5, **CFG, temperature=1.0, top_k=1, seed=7)
     np.testing.assert_array_equal(np.asarray(k1), np.asarray(gg))
+
+
+def test_nucleus_sampling():
+    from pytorch_distributed_tpu.models.generate import generate
+
+    params = _trained_params(seed=3)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    gg = greedy_generate(params, prompt, 5, **CFG)
+    # a tiny nucleus keeps only the argmax token -> greedy
+    p_tiny = generate(params, prompt, 5, **CFG, temperature=1.0,
+                      top_p=1e-6, seed=5)
+    np.testing.assert_array_equal(np.asarray(p_tiny), np.asarray(gg))
+    # top_p=0 disables the filter: identical stream to unfiltered sampling
+    s_plain = generate(params, prompt, 5, **CFG, temperature=1.5, seed=9)
+    s_full = generate(params, prompt, 5, **CFG, temperature=1.5,
+                      top_p=0.0, seed=9)
+    np.testing.assert_array_equal(np.asarray(s_plain), np.asarray(s_full))
+    # reproducible per seed; a mid-size nucleus still varies across seeds
+    n1 = generate(params, prompt, 8, **CFG, temperature=2.0, top_p=0.9,
+                  seed=11)
+    n1b = generate(params, prompt, 8, **CFG, temperature=2.0, top_p=0.9,
+                   seed=11)
+    n2 = generate(params, prompt, 8, **CFG, temperature=2.0, top_p=0.9,
+                  seed=12)
+    np.testing.assert_array_equal(np.asarray(n1), np.asarray(n1b))
+    assert (np.asarray(n1) != np.asarray(n2)).any()

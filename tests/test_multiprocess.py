@@ -46,28 +46,49 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_rendezvous_and_collective(tmp_path):
+def _run_workers(tmp_path, template, n_procs, extra_args=(), timeout=540):
+    """Shared spawn harness: write the worker template (filling port/repo),
+    launch ``n_procs`` ranks (rank as argv[1]), kill stragglers, assert
+    every rank exited 0, and return the per-rank stdout list."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER % {"port": _free_port(), "repo": repo})
+    script.write_text(template % {"port": _free_port(), "repo": repo})
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PTD_TPU", "JAX_", "XLA_"))}
     procs = [
-        subprocess.Popen([sys.executable, str(script), str(i)],
-                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                         text=True, env=env)
-        for i in (0, 1)
+        subprocess.Popen(
+            [sys.executable, str(script), str(i)] + [str(a) for a in extra_args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(n_procs)
     ]
     try:
-        outs = [p.communicate(timeout=300)[0] for p in procs]
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
     finally:
         for p in procs:  # no orphaned workers holding the port on timeout
             if p.poll() is None:
                 p.kill()
     for i, out in enumerate(outs):
         assert procs[i].returncode == 0, out
-        # 2x4 zeros from proc 0 + 2x4 ones from proc 1 ⇒ global sum 8.
-        assert f"RESULT {i} 8.0" in out, out
+    return outs
+
+
+def _parse(outs, prefix):
+    """Collect ``{rank: payload}`` from lines ``<prefix> <rank> <payload>``."""
+    vals = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith(prefix + " "):
+                parts = line.split(" ", 2)
+                vals[int(parts[1])] = parts[2] if len(parts) > 2 else ""
+    return vals
+
+
+def test_two_process_rendezvous_and_collective(tmp_path):
+    outs = _run_workers(tmp_path, _WORKER, 2, timeout=300)
+    # 2x4 zeros from proc 0 + 2x4 ones from proc 1 ⇒ global sum 8.
+    results = _parse(outs, "RESULT")
+    assert results == {0: "8.0", 1: "8.0"}, outs
 
 
 _TRAINER_WORKER = textwrap.dedent(
@@ -106,36 +127,10 @@ def test_two_process_trainer_epoch(tmp_path):
     rank-0-only checkpoint, distributed.py:174-175,218-225)."""
     import json
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "trainer_worker.py"
-    script.write_text(_TRAINER_WORKER % {"port": _free_port(), "repo": repo})
     ckpt_dir = tmp_path / "ckpt"
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("PTD_TPU", "JAX_", "XLA_"))}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(i), str(ckpt_dir)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
-        for i in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=540)[0] for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-
-    shards, accs = {}, {}
-    for i, out in enumerate(outs):
-        assert procs[i].returncode == 0, out
-        for line in out.splitlines():
-            if line.startswith("SHARD "):
-                _, rank, payload = line.split(" ", 2)
-                shards[int(rank)] = json.loads(payload)
-            elif line.startswith("ACC "):
-                _, rank, val = line.split()
-                accs[int(rank)] = float(val)
+    outs = _run_workers(tmp_path, _TRAINER_WORKER, 2, extra_args=[ckpt_dir])
+    shards = {r: json.loads(p) for r, p in _parse(outs, "SHARD").items()}
+    accs = {r: float(p) for r, p in _parse(outs, "ACC").items()}
 
     # Disjoint shards covering the dataset exactly once (len 32, world 2).
     assert set(shards) == {0, 1}
@@ -204,6 +199,73 @@ _LM_WORKER = textwrap.dedent(
 )
 
 
+_GRID_WORKER = textwrap.dedent(
+    """
+    import os, sys, json
+    pid = sys.argv[1]
+    ckpt_dir = sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["PTD_TPU_COORDINATOR"] = "127.0.0.1:%(port)d"
+    os.environ["PTD_TPU_NUM_PROCESSES"] = "4"
+    os.environ["PTD_TPU_PROCESS_ID"] = pid
+    sys.path.insert(0, %(repo)r)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh, initialize
+    ctx = initialize()
+    assert ctx.process_count == 4
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel.tp import tp_specs
+    from pytorch_distributed_tpu.train.lm import LMTrainer, SyntheticTokenDataset
+    import jax.numpy as jnp
+    # The deployment-shaped grid: model axis innermost (fast ICI hops),
+    # data across the outer pairs — dp=2 x tp=2 over 4 single-device procs.
+    mesh = build_mesh(MeshSpec(("data", "model"), (2, 2)))
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(16, 16, 32)
+    with mesh:
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 16), jnp.int32)))["params"]
+        t = LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
+                      param_specs=tp_specs(shapes), is_primary=ctx.is_primary,
+                      checkpoint_dir=ckpt_dir)
+        rows = t._local_rows(ds.batch(0, 8))
+        print("ROWS", ctx.process_index, rows.shape[0],
+              json.dumps(rows[:, 0].tolist()), flush=True)
+        final = t.fit(6, print_freq=3)
+    print("METRICS", ctx.process_index, f"{final:.6f}", flush=True)
+    """
+)
+
+
+def test_four_process_dp_tp_grid(tmp_path):
+    """4 single-device processes forming one (data 2, model 2) mesh — the
+    deployment topology (Megatron TP on the inner axis, DP across): each
+    data-group feeds its own batch half (replicated over its model pair),
+    every rank reports the identical global loss, one checkpoint."""
+    import json
+
+    ckpt_dir = tmp_path / "ckpt"
+    outs = _run_workers(tmp_path, _GRID_WORKER, 4, extra_args=[ckpt_dir])
+    rows = {r: (int(p.split(" ", 1)[0]), json.loads(p.split(" ", 1)[1]))
+            for r, p in _parse(outs, "ROWS").items()}
+    metrics = _parse(outs, "METRICS")
+
+    assert set(rows) == {0, 1, 2, 3}
+    # 8-row batch over data=2: each data group holds a 4-row half,
+    # replicated across its model pair; halves are disjoint.
+    assert all(rows[r][0] == 4 for r in rows)
+    assert rows[0][1] == rows[1][1]
+    assert rows[2][1] == rows[3][1]
+    assert rows[0][1] != rows[2][1]
+    # One identical global loss on every rank; exactly one checkpoint.
+    assert set(metrics) == {0, 1, 2, 3}
+    assert len(set(metrics.values())) == 1
+    files = sorted(p.name for p in ckpt_dir.iterdir())
+    assert files.count("checkpoint.msgpack") == 1, files
+
+
 @pytest.mark.parametrize("tp", [1, 2])
 def test_two_process_lm_pretrain(tmp_path, tp):
     """2-process LM twin of the image Trainer test (VERDICT r2 item 8):
@@ -212,36 +274,11 @@ def test_two_process_lm_pretrain(tmp_path, tp):
     both ranks feed the replicated batch."""
     import json
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "lm_worker.py"
-    script.write_text(_LM_WORKER % {"port": _free_port(), "repo": repo})
     ckpt_dir = tmp_path / "ckpt"
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("PTD_TPU", "JAX_", "XLA_"))}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(i), str(ckpt_dir), str(tp)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
-        for i in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=540)[0] for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-
-    rows, metrics = {}, {}
-    for i, out in enumerate(outs):
-        assert procs[i].returncode == 0, out
-        for line in out.splitlines():
-            if line.startswith("ROWS "):
-                _, rank, n, payload = line.split(" ", 3)
-                rows[int(rank)] = (int(n), json.loads(payload))
-            elif line.startswith("METRICS "):
-                _, rank, vals = line.split(" ", 2)
-                metrics[int(rank)] = vals
+    outs = _run_workers(tmp_path, _LM_WORKER, 2, extra_args=[ckpt_dir, tp])
+    rows = {r: (int(p.split(" ", 1)[0]), json.loads(p.split(" ", 1)[1]))
+            for r, p in _parse(outs, "ROWS").items()}
+    metrics = _parse(outs, "METRICS")
 
     assert set(rows) == {0, 1}
     if tp == 1:
