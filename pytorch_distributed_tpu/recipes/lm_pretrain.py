@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--lr over --steps (fixed lr otherwise)")
     p.add_argument("--clip-grad-norm", type=float, default=0.0,
                    help=">0: in-graph global-norm gradient clipping")
+    p.add_argument("--accum-steps", type=int, default=1,
+                   help="gradient accumulation microbatches inside the "
+                        "compiled step (long-context memory relief; "
+                        "redundant with --pp, whose schedule already "
+                        "microbatches)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel (ring) size")
@@ -138,6 +143,10 @@ def main(argv=None) -> float:
     if args.remat and args.pp <= 1:
         raise SystemExit("--remat applies to the pipeline stages "
                          "(requires --pp > 1)")
+    if args.accum_steps > 1 and args.pp > 1:
+        raise SystemExit("--accum-steps with --pp is redundant: the pipeline "
+                         "schedule already microbatches; raise "
+                         "--microbatches instead")
     if n % (args.tp * args.sp * args.ep * args.pp):
         raise SystemExit(f"{n} devices not divisible by tp*sp*ep*pp")
     if args.pp > 1 and args.n_layers % args.pp:
@@ -303,6 +312,7 @@ def main(argv=None) -> float:
             eval_dataset=eval_dataset, eval_every=args.eval_every,
             eval_batches=args.eval_batches,
             lr_schedule=schedule, clip_grad_norm=args.clip_grad_norm,
+            accum_steps=args.accum_steps,
         )
         final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
         if args.generate > 0:  # plain-dp only, validated with the args above

@@ -146,42 +146,90 @@ def make_lm_train_step(
     weight_decay: float = 1e-4,
     data_axis: str = "data",
     clip_grad_norm: float = 0.0,
+    accum_steps: int = 1,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
     ``clip_grad_norm > 0`` rescales gradients to that global L2 norm
-    (in-graph, before the update — the torch ``clip_grad_norm_`` analogue)."""
+    (in-graph, before the update — the torch ``clip_grad_norm_`` analogue).
+    ``accum_steps > 1`` accumulates gradients over that many strided
+    microbatches inside the one compiled step (same semantics as the image
+    path, train/steps.py).  For dense models the update equals the
+    unaccumulated step up to fp reassociation (tested); for MoE models the
+    router's load-balancing aux loss is computed from *microbatch-local*
+    routing fractions, so accumulated and unaccumulated runs differ
+    slightly — the standard per-microbatch aux-loss semantics, not a bug."""
+    manual = getattr(model, "has_manual_grads", lambda: False)()
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if accum_steps > 1 and manual:
+        raise ValueError(
+            "accum_steps > 1 with the 1F1B pipeline is redundant — the "
+            "schedule already splits the batch into pipeline microbatches; "
+            "raise n_microbatches instead"
+        )
 
     def step(state: TrainState, tokens: jnp.ndarray, lr: jnp.ndarray):
-        def loss_fn(params):
+        def loss_fn(params, toks):
             # mutable=["losses"] collects sown auxiliary objectives (the MoE
             # router's load-balancing loss); {} for dense models.
             logits, sown = model.apply(
-                {"params": params}, tokens, mutable=["losses"]
+                {"params": params}, toks, mutable=["losses"]
             )
             vocab = logits.shape[-1]
             loss = cross_entropy(
                 logits[:, :-1].reshape(-1, vocab),
-                tokens[:, 1:].reshape(-1),
+                toks[:, 1:].reshape(-1),
             )
             for leaf in jax.tree_util.tree_leaves(sown.get("losses", {})):
                 loss = loss + leaf
             acc = jnp.mean(
-                (jnp.argmax(logits[:, :-1], axis=-1) == tokens[:, 1:]).astype(
+                (jnp.argmax(logits[:, :-1], axis=-1) == toks[:, 1:]).astype(
                     jnp.float32
                 )
             )
             return loss, acc
 
-        if getattr(model, "has_manual_grads", lambda: False)():
+        if manual:
             # 1F1B pipeline: gradients come from the schedule's own
             # interleaved scan, not autodiff over the whole step
             # (models/pipeline_lm.py loss_and_grads).
             (loss, acc), grads = model.loss_and_grads(state.params, tokens)
-        else:
+        elif accum_steps == 1:
             (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params
+                state.params, tokens
             )
+        else:
+            B = tokens.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"batch {B} not divisible by accum_steps {accum_steps}"
+                )
+            # Strided split keeps every microbatch evenly spread over the
+            # data-sharded rows (a contiguous split would concentrate each
+            # microbatch on a device subset — train/steps.py note).
+            micro = tokens.reshape(
+                B // accum_steps, accum_steps, -1).swapaxes(0, 1)
+
+            def body(carry, mb):
+                g_acc, loss_acc, acc_acc = carry
+                (l, a), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                    state.params, mb
+                )
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, loss_acc + l, acc_acc + a), None
+
+            init = (
+                jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params),
+                jnp.float32(0.0),
+                jnp.float32(0.0),
+            )
+            (grads, loss, acc), _ = jax.lax.scan(body, init, micro)
+            inv = 1.0 / accum_steps  # means-of-equal-size-microbatch-means
+            grads = jax.tree_util.tree_map(
+                lambda g, p: (g * inv).astype(p.dtype), grads, state.params)
+            loss, acc = loss * inv, acc * inv
         if clip_grad_norm > 0.0:
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -274,10 +322,12 @@ class LMTrainer:
         clip_grad_norm: float = 0.0,
         preempt=None,
         prefetch: int = 2,
+        accum_steps: int = 1,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
         ``clip_grad_norm``: in-graph global-norm gradient clipping;
+        ``accum_steps``: gradient accumulation inside the compiled step;
         ``preempt``: optional installed ``utils.preempt.PreemptionGuard`` —
         when it triggers, ``fit`` stops at the next step boundary and the
         end-of-fit checkpoint captures the state.
@@ -311,7 +361,8 @@ class LMTrainer:
         self.state = shard_state(state, self.param_specs, mesh)
         self.lr_schedule = lr_schedule
         self.step_fn = make_lm_train_step(model, mesh, self.param_specs,
-                                          clip_grad_norm=clip_grad_norm)
+                                          clip_grad_norm=clip_grad_norm,
+                                          accum_steps=accum_steps)
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every

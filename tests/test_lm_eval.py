@@ -166,6 +166,56 @@ def test_clip_grad_norm_bounds_update():
     assert unclipped > 10 * clipped
 
 
+def test_lm_accum_matches_unaccumulated():
+    """accum_steps=2 must produce the same update as the plain step at the
+    same global batch (equal-size token-mean microbatches; fp reassociation
+    is the only difference)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.parallel.tp import replicated_like, shard_state
+    from pytorch_distributed_tpu.train.lm import make_lm_train_step
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+
+    mesh = _mesh()
+    model = _tiny_model()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    specs = replicated_like(params)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(16, 16)).astype(np.int32))
+    out = {}
+    with mesh:
+        toks = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
+        for accum in (1, 2, 4):
+            p = jax.tree_util.tree_map(jnp.array, params)
+            state = shard_state(
+                TrainState.create({"params": p}, sgd_init(p)), specs, mesh)
+            step = make_lm_train_step(model, mesh, specs, accum_steps=accum)
+            state2, metrics = step(state, toks, jnp.float32(0.05))
+            out[accum] = (float(metrics["loss"]), float(metrics["acc"]),
+                          jax.device_get(state2.params))
+    for accum in (2, 4):
+        assert out[accum][0] == pytest.approx(out[1][0], rel=1e-5)
+        assert out[accum][1] == pytest.approx(out[1][1], rel=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(out[1][2]),
+                        jax.tree_util.tree_leaves(out[accum][2])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_lm_accum_rejects_manual_grads_model():
+    from pytorch_distributed_tpu.train.lm import make_lm_train_step
+
+    class FakePipelined:
+        def has_manual_grads(self):
+            return True
+
+    mesh = _mesh()
+    with pytest.raises(ValueError, match="1F1B"):
+        make_lm_train_step(FakePipelined(), mesh, {}, accum_steps=2)
+
+
 def test_prefetch_modes_produce_identical_training():
     """prefetch=2 (AsyncFeeder) and prefetch=0 (synchronous baseline) must
     consume identical batch streams — same final loss and params."""
