@@ -117,7 +117,9 @@ CONFIGS = (
     # name, precision, accum, explicit_collectives
     ("fp32", "fp32", 1, False),
     ("bf16", "bf16", 1, False),
-    ("bf16_accum2", "bf16", 2, False),
+    # accum=5: BATCH(40)/accum must stay a multiple of the 8-device data
+    # axis (the strided-microbatch constraint, train/steps.py) — 40/5 = 8.
+    ("bf16_accum5", "bf16", 5, False),
     ("explicit_bf16wire", "fp32", 1, True),
     # dp1_fp32 runs ONLY in the re-exec'd child (1-device mesh): same
     # global batch, one device — the DP-invariance leg.
@@ -132,7 +134,7 @@ def main() -> int:
     out_path = os.path.abspath(os.path.join(here, "..",
                                             "RESULTS_convergence_hard.json"))
     fingerprint = [CLASSES, PER_CLASS_TRAIN, PER_CLASS_VAL, IMAGE, EPOCHS,
-                   BATCH, NOISE, TINT, JITTER]
+                   BATCH, NOISE, TINT, JITTER, LR]
     only = os.environ.get("CONVH_ONLY", "")
     data_root = os.environ.get("CONVH_DATA", "")
 
