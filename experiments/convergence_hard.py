@@ -34,6 +34,7 @@ Run (CPU 8-device mesh, ~40-60 min on one core):
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -50,18 +51,24 @@ if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
         pass
 
 CLASSES = 100
-PER_CLASS_TRAIN = int(os.environ.get("CONVH_PER_CLASS", "20"))
+PER_CLASS_TRAIN = int(os.environ.get("CONVH_PER_CLASS", "16"))
 PER_CLASS_VAL = 5
-IMAGE = 40
-EPOCHS = int(os.environ.get("CONVH_EPOCHS", "8"))
-BATCH = 40
-NOISE = float(os.environ.get("CONVH_NOISE", "0.15"))   # per-pixel noise sigma
-TINT = float(os.environ.get("CONVH_TINT", "0.25"))     # hue signal strength
+IMAGE = 32
+EPOCHS = int(os.environ.get("CONVH_EPOCHS", "18"))
+BATCH = 32
+NOISE = float(os.environ.get("CONVH_NOISE", "0.10"))   # per-pixel noise sigma
+TINT = float(os.environ.get("CONVH_TINT", "0.45"))     # hue signal strength
 # Per-image hue jitter as a fraction of the class spacing (1/CLASSES):
 # the irreducible confusion that pins the plateau below the ceiling.
 # P(top-1) ~= erf(1 / (2*sqrt(2)*JITTER)) -> 0.34 gives ~86%... 0.5 ~ 68%.
+# NOISE/TINT/LR set how FAST the curve rises; only JITTER sets the ceiling —
+# the round-3 run (tint .25, noise .15, constant lr .06, 8 epochs) was still
+# mid-rise at 11-14%, so round 4 strengthens the signal and adds a cosine
+# schedule to reach the plateau, where the spread gate has teeth (VERDICT r3).
 JITTER = float(os.environ.get("CONVH_JITTER", "0.45"))
-LR = float(os.environ.get("CONVH_LR", "0.06"))
+LR = float(os.environ.get("CONVH_LR", "0.12"))
+CEILING = (100.0 if JITTER == 0 else
+           100.0 * math.erf(1.0 / (2.0 * math.sqrt(2.0) * JITTER)))
 
 
 def make_dataset(root: str, seed: int = 0) -> None:
@@ -98,7 +105,12 @@ def run_config(data_root: str, tmpdir: str, name: str, precision: str,
 
     cfg = Config(
         data=data_root, arch="resnet18", batch_size=BATCH, epochs=EPOCHS,
-        lr=LR, print_freq=1000, seed=0, image_size=IMAGE,
+        # No warmup: LR 0.12 from epoch 0 proved stable (fp32 leg rising
+        # cleanly), and the cached-curve fingerprint below predates the
+        # warmup-ramp fix in train/lr.py — warmup 0 keeps every config on
+        # the identical schedule the first legs ran.
+        lr=LR, lr_schedule="cosine", lr_warmup_epochs=0,
+        print_freq=1000, seed=0, image_size=IMAGE,
         precision=precision, accum_steps=accum,
         checkpoint_dir=os.path.join(tmpdir, name),
         workers=2,
@@ -117,9 +129,9 @@ CONFIGS = (
     # name, precision, accum, explicit_collectives
     ("fp32", "fp32", 1, False),
     ("bf16", "bf16", 1, False),
-    # accum=5: BATCH(40)/accum must stay a multiple of the 8-device data
-    # axis (the strided-microbatch constraint, train/steps.py) — 40/5 = 8.
-    ("bf16_accum5", "bf16", 5, False),
+    # accum=4: BATCH(32)/accum must stay a multiple of the 8-device data
+    # axis (the strided-microbatch constraint, train/steps.py) — 32/4 = 8.
+    ("bf16_accum4", "bf16", 4, False),
     ("explicit_bf16wire", "fp32", 1, True),
     # dp1_fp32 runs ONLY in the re-exec'd child (1-device mesh): same
     # global batch, one device — the DP-invariance leg.
@@ -133,8 +145,10 @@ def main() -> int:
     here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.path.abspath(os.path.join(here, "..",
                                             "RESULTS_convergence_hard.json"))
+    # The trailing tag is an OPAQUE cache key for the schedule; bump it
+    # whenever run_config's schedule args change or stale curves get reused.
     fingerprint = [CLASSES, PER_CLASS_TRAIN, PER_CLASS_VAL, IMAGE, EPOCHS,
-                   BATCH, NOISE, TINT, JITTER, LR]
+                   BATCH, NOISE, TINT, JITTER, LR, "cosine_warmup1"]
     only = os.environ.get("CONVH_ONLY", "")
     data_root = os.environ.get("CONVH_DATA", "")
 
@@ -163,7 +177,9 @@ def main() -> int:
         "arch": "resnet18",
         "epochs": EPOCHS,
         "batch": BATCH,
+        "lr": f"{LR} cosine, no warmup",
         "chance_pct": 100.0 / CLASSES,
+        "analytic_ceiling_pct": round(CEILING, 2),
     }
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -204,22 +220,35 @@ def main() -> int:
     if os.environ.get("CONVH_CHILD"):
         return 0  # parent applies the gates over the merged file
     print(json.dumps({"curves": results}, indent=1))
-    finals = {k: v[-1] for k, v in results.items()}
+    # Gates are applied AT THE PLATEAU (VERDICT r3): each final is the mean of
+    # the last 3 epochs (cosine tail, LR≈0 — epoch noise is smallest there).
+    finals = {k: round(float(np.mean(v[-3:])), 3) for k, v in results.items()}
     ok = True
-    for k, v in finals.items():
-        if v < 8 * meta["chance_pct"]:  # learns: ≥8× chance
-            print(f"FAIL: {k} final top-1 {v} < {8 * meta['chance_pct']}")
+    floor = 0.62 * CEILING  # relative so CONVH_JITTER stays tunable
+    for k, curve in results.items():
+        v = finals[k]
+        if v < floor:  # learns to the ceiling's neighbourhood, not mid-rise
+            print(f"FAIL: {k} plateau top-1 {v} < {floor:.1f} "
+                  f"(ceiling {CEILING:.1f})")
             ok = False
-        if v > 97.0:  # oracle must keep its discriminating power
-            print(f"FAIL: {k} final top-1 {v} saturates (>97%)")
+        if v > CEILING + 4.0:  # above the analytic ceiling = generator leak
+            print(f"FAIL: {k} plateau top-1 {v} exceeds analytic ceiling "
+                  f"{CEILING:.1f}+4")
             ok = False
+        if len(curve) >= 6:  # plateaued: last-3 mean within 3 of prior-3 mean
+            rise = float(np.mean(curve[-3:]) - np.mean(curve[-6:-3]))
+            if rise > 3.0:
+                print(f"FAIL: {k} still climbing at the end "
+                      f"(+{rise:.2f} points over last 3 epochs)")
+                ok = False
     if finals:
         spread = max(finals.values()) - min(finals.values())
-        if spread > 8.0:
-            print(f"FAIL: final top-1 spread {spread:.2f} > 8 points")
+        if spread > 5.0:  # numerics gate, at plateau where it has teeth
+            print(f"FAIL: plateau top-1 spread {spread:.2f} > 5 points")
             ok = False
         print("convergence_hard:", "OK" if ok else "MISMATCH",
-              f"finals={finals} spread={spread:.2f}")
+              f"plateau_finals={finals} spread={spread:.2f} "
+              f"ceiling={CEILING:.1f}")
     return 0 if ok else 1
 
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_tpu.ops.fused_bn import FusedBatchNormAct
+from pytorch_distributed_tpu.ops.fused_conv_bn import conv1x1_bn
 
 ModuleDef = Any
 
@@ -38,6 +39,10 @@ class BasicBlock(nn.Module):
     base_width: int = 64
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = FusedBatchNormAct
+    # Accepted for uniform construction; the basic topology has no 1x1
+    # stride-1 conv→BN pair to fold (3x3 mains; downsamples are strided),
+    # so the flag is a no-op here.
+    fused_convbn: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -62,25 +67,80 @@ class Bottleneck(nn.Module):
     base_width: int = 64
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = FusedBatchNormAct
+    # Route the 1x1 stride-1 conv→BN pairs (2-3 of the 4 convs per block)
+    # through the fused-backward op (ops/fused_conv_bn.py) — dy never hits
+    # HBM.  Param paths are IDENTICAL either way (the fused combinator
+    # declares through child scopes), so checkpoints interchange freely.
+    fused_convbn: bool = False
+
+    def _fuse_active(self) -> bool:
+        # Only fold when conv/norm really are the stock nn.Conv /
+        # FusedBatchNormAct semantics — a custom ModuleDef (or a conv
+        # partial carrying more than dtype, e.g. precision) must keep the
+        # unfused composition, or its settings would be silently dropped.
+        if not self.fused_convbn:
+            return False
+        if getattr(self.norm, "func", self.norm) is not FusedBatchNormAct:
+            return False
+        if getattr(self.conv, "func", self.conv) is not nn.Conv:
+            return False
+        if set(getattr(self.conv, "keywords", {})) - {"dtype"}:
+            return False
+        # Same rule for norm extras: anything beyond what conv1x1_bn
+        # forwards (use_running_average/momentum/epsilon) would be dropped.
+        return not (set(getattr(self.norm, "keywords", {}))
+                    - {"use_running_average", "momentum", "epsilon"})
 
     @nn.compact
     def __call__(self, x):
         residual = x
         width = int(self.filters * (self.base_width / 64.0)) * self.groups
-        y = self.conv(width, (1, 1), use_bias=False)(x)
-        y = self.norm(relu=True)(y)
+        out_ch = self.filters * self.expansion
+        if not self._fuse_active():
+            y = self.conv(width, (1, 1), use_bias=False)(x)
+            y = self.norm(relu=True)(y)
+            y = self.conv(width, (3, 3), (self.strides, self.strides),
+                          padding=[(1, 1), (1, 1)], use_bias=False,
+                          feature_group_count=self.groups)(y)
+            y = self.norm(relu=True)(y)
+            y = self.conv(out_ch, (1, 1), use_bias=False)(y)
+            # Zero-init the last BN scale so blocks start as identity
+            # (torchvision zero_init_residual analogue; helps large-batch SGD).
+            y = self.norm(scale_init=nn.initializers.zeros)(y)
+            if residual.shape != y.shape:
+                residual = self.conv(out_ch, (1, 1),
+                                     (self.strides, self.strides),
+                                     use_bias=False)(residual)
+                residual = self.norm()(residual)
+            return nn.relu(y + residual)
+
+        # Fused branch: explicit child names reproduce the auto-assigned
+        # paths of the branch above, slot for slot.
+        nkw = getattr(self.norm, "keywords", {})
+        ckw = getattr(self.conv, "keywords", {})
+        fkw = dict(
+            use_running_average=bool(nkw.get("use_running_average", False)),
+            momentum=nkw.get("momentum", 0.9),
+            eps=nkw.get("epsilon", 1e-5),
+            dtype=ckw.get("dtype", jnp.float32),
+        )
+        y = conv1x1_bn(self, "Conv_0", "FusedBatchNormAct_0", x, width,
+                       relu=True, **fkw)
         y = self.conv(width, (3, 3), (self.strides, self.strides),
                       padding=[(1, 1), (1, 1)], use_bias=False,
-                      feature_group_count=self.groups)(y)
-        y = self.norm(relu=True)(y)
-        y = self.conv(self.filters * self.expansion, (1, 1), use_bias=False)(y)
-        # Zero-init the last BN scale so blocks start as identity
-        # (torchvision zero_init_residual analogue; helps large-batch SGD).
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
+                      feature_group_count=self.groups, name="Conv_1")(y)
+        y = self.norm(relu=True, name="FusedBatchNormAct_1")(y)
+        y = conv1x1_bn(self, "Conv_2", "FusedBatchNormAct_2", y, out_ch,
+                       relu=False, scale_init=nn.initializers.zeros, **fkw)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * self.expansion, (1, 1),
-                                 (self.strides, self.strides), use_bias=False)(residual)
-            residual = self.norm()(residual)
+            if self.strides == 1:
+                residual = conv1x1_bn(self, "Conv_3", "FusedBatchNormAct_3",
+                                      residual, out_ch, relu=False, **fkw)
+            else:
+                residual = self.conv(out_ch, (1, 1),
+                                     (self.strides, self.strides),
+                                     use_bias=False, name="Conv_3")(residual)
+                residual = self.norm(name="FusedBatchNormAct_3")(residual)
         return nn.relu(y + residual)
 
 
@@ -142,6 +202,7 @@ class ResNet(nn.Module):
     base_width: int = 64
     dtype: Any = jnp.float32
     stem: str = "conv7"  # "conv7" (torchvision) | "space_to_depth" (same math)
+    fused_convbn: bool = False  # fold BN-backward dx into the 1x1 dgrad/wgrad
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -174,6 +235,7 @@ class ResNet(nn.Module):
                     base_width=self.base_width,
                     conv=conv,
                     norm=norm,
+                    fused_convbn=self.fused_convbn,
                 )(x)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(x)

@@ -1,0 +1,260 @@
+"""Fused conv1x1+BN(+ReLU) backward — the BN-dx fold (ROADMAP item 1).
+
+The round-2 roofline (scripts/profile_trace.py) showed the ResNet-50 step is
+HBM-bound with a ~3,080 img/s ceiling at b256; the only route past it is
+removing whole memory passes.  The largest remaining pass is the BN-backward
+dx: autodiff materializes ``dy`` (the gradient at the conv output / BN input)
+to HBM, then the dgrad and wgrad convolutions each read it back — for every
+conv→BN pair, (y, do) are read for the reductions, read again to form dy,
+dy is written, then read twice more:
+
+    XLA today:   reduce(y,do) + write dy(y,do) + dgrad(dy) + wgrad(dy,a)
+                 ≈ 9 tensor-passes per pair
+    this kernel: reduce(y,do) + fused[dy in VMEM → dgrad+wgrad]
+                 ≈ 6 tensor-passes — dy never exists in HBM
+
+For the 1×1 stride-1 convolutions (2-3 of the 4 convs in every ResNet-50
+bottleneck) the conv is exactly a matmul over channels, so the fold is a
+single Pallas kernel: per M-tile (M = N·H·W rows), recompute the ReLU mask
+and dy in VMEM from (y, do) and per-channel vectors, then
+
+    da(tile)  = dy @ Wᵀ                       (MXU)
+    dW       += aᵀ @ dy     (f32 accumulator, written at the last grid step)
+
+reading y, do, a from HBM exactly once each.  3×3 / strided / grouped convs
+keep the plain XLA backward (see ``models/resnet.py`` for slot selection).
+
+Forward is unchanged XLA (conv + the one-pass BN+ReLU of ops/fused_bn.py) —
+forward fusion is something XLA already does well; the backward pass is where
+the traffic lives.
+
+Semantics match ``nn.Conv(use_bias=False)`` → ``FusedBatchNormAct`` exactly
+(global-batch SyncBN statistics under GSPMD, per-shard statistics under
+shard_map — identical to the unfused pair; tests/test_fused_conv_bn.py).
+
+Reference anchor: the conv+BN stacks of every torchvision model the
+reference instantiates (reference distributed.py:134-139); the perf target
+is the reference's recorded-wall-clock methodology (reference README.md:15-17).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_tpu.ops.fused_bn import _bn_act, _bn_act_fwd
+
+
+def _resolve_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _bwd_kernel(y_ref, do_ref, a_ref, w_ref, vec_ref, da_ref, dw_ref,
+                *, relu: bool, cdt):
+    """One M-tile: dy in VMEM, then dgrad + wgrad off the same registers.
+
+    vec rows: 0=s (γ·inv), 1=t, 2=u  (dy = s∘dof + t∘y + u), 3=v
+    (mask pre-activation = s∘y + v); see the wrapper for the algebra.
+    """
+    i = pl.program_id(0)
+    yf = y_ref[:].astype(jnp.float32)                    # [MT, Co]
+    dof = do_ref[:].astype(jnp.float32)                  # [MT, Co]
+    s = vec_ref[0:1, :]                                  # [1, Co]
+    t = vec_ref[1:2, :]
+    u = vec_ref[2:3, :]
+    if relu:
+        v = vec_ref[3:4, :]
+        dof = jnp.where(yf * s + v > 0, dof, 0.0)
+    dy = (dof * s + yf * t + u).astype(cdt)              # [MT, Co]
+    # dgrad: da = dy @ Wᵀ (contract Co)
+    da_ref[:] = jax.lax.dot_general(
+        dy, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(da_ref.dtype)
+    # wgrad: dW += aᵀ @ dy (contract M), f32 accumulation across the grid —
+    # the output block is grid-constant, so it lives in VMEM for the whole
+    # kernel and is written back once.
+    contrib = jax.lax.dot_general(
+        a_ref[:].astype(cdt), dy, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == 0)
+    def _():
+        dw_ref[:] = contrib
+
+    @pl.when(i > 0)
+    def _():
+        dw_ref[:] = dw_ref[:] + contrib
+
+
+def _fused_dgrad_wgrad(y, do, a, w, s, t, u, v, relu: bool, interpret: bool
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """da, dW for the 1×1 conv whose output fed BN — one pass over (y,do,a).
+
+    Shapes: y/do [..., Co], a [..., Ci] with identical leading dims; w
+    [Ci, Co].  Leading dims are flattened to M rows and zero-padded to the
+    tile size (padded ``do``/``a`` rows are zero, so they contribute nothing
+    to dW and their da rows are dropped; bench shapes divide evenly).
+    """
+    Ci, Co = w.shape
+    M = 1
+    for d in y.shape[:-1]:
+        M *= d
+    y2 = y.reshape(M, Co)
+    do2 = do.reshape(M, Co)
+    a2 = a.reshape(M, Ci)
+    cdt = a.dtype
+    # Tile choice: 256 rows amortizes the grid; drop to 128 when the
+    # weight + f32 dW accumulator get big so VMEM stays comfortable.
+    mt = 128 if Ci * Co >= (1 << 20) else 256
+    mp = ((M + mt - 1) // mt) * mt
+    if mp != M:
+        pad = ((0, mp - M), (0, 0))
+        y2 = jnp.pad(y2, pad)
+        do2 = jnp.pad(do2, pad)
+        a2 = jnp.pad(a2, pad)
+    vec = jnp.stack([s, t, u, v]).astype(jnp.float32)    # [4, Co]
+    da2, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, relu=relu, cdt=cdt),
+        grid=(mp // mt,),
+        in_specs=[
+            pl.BlockSpec((mt, Co), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((mt, Co), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((mt, Ci), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Ci, Co), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, Co), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((mt, Ci), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Ci, Co), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, Ci), cdt),
+            jax.ShapeDtypeStruct((Ci, Co), jnp.float32),
+        ],
+        interpret=interpret,
+    )(y2, do2, a2, w.astype(cdt), vec)
+    return da2[:M].reshape(a.shape), dw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def conv1x1_bn_act(a, w, gamma, beta, eps: float, relu: bool,
+                   interpret: Optional[bool] = None):
+    """``(o, mu, var) = BN+ReLU(conv1x1(a, w))`` with the fused backward.
+
+    ``a``: NHWC activations; ``w``: [1, 1, Ci, Co] (HWIO) f32 params cast to
+    ``a.dtype`` for compute, like ``nn.Conv(dtype=...)``.  mu/var are exposed
+    for the EMA update (stop-gradiented by the caller, like ops/fused_bn).
+    """
+    (o, mu, var), _ = _conv1x1_bn_fwd(a, w, gamma, beta, eps, relu, interpret)
+    return o, mu, var
+
+
+def _conv1x1(a, w):
+    return jax.lax.conv_general_dilated(
+        a, w.astype(a.dtype), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _conv1x1_bn_fwd(a, w, gamma, beta, eps, relu, interpret):
+    y = _conv1x1(a, w)
+    (o, mu, var), (y_res, mu_res, inv, g_res, b_res) = _bn_act_fwd(
+        y, gamma, beta, eps, relu
+    )
+    return (o, mu, var), (a, w, y_res, mu_res, inv, g_res, b_res)
+
+
+def _conv1x1_bn_bwd(eps, relu, interpret, res, cts):
+    a, w, y, mu, inv, gamma, beta = res
+    do = cts[0]  # mu/var cotangents are zero (EMA is stop-grad)
+    f32 = jnp.float32
+    axes = tuple(range(y.ndim - 1))
+    n = 1
+    for ax in axes:
+        n *= y.shape[ax]
+    yf = y.astype(f32)
+    dof = do.astype(f32)
+    # Pass 1 (XLA, fused reductions): dβ, dγ.  Under GSPMD with a sharded
+    # batch these are global means/sums (SyncBN backward); under shard_map
+    # they are per-shard — identical to the unfused _bn_act_bwd.
+    s = gamma * inv
+    v = beta - s * mu
+    if relu:
+        dof = jnp.where(yf * s + v > 0, dof, 0.0)
+    dbeta = dof.sum(axes)
+    xhat = (yf - mu) * inv
+    dgamma = (dof * xhat).sum(axes)
+    # dy = s·(dof − dβ/n − x̂·dγ/n) rearranged to two per-channel FMAs:
+    #   dy = s∘dof + t∘y + u,  t = −s·inv·dγ/n,  u = −s·dβ/n − t·μ
+    t = -(s * inv) * (dgamma / n)
+    u = -s * (dbeta / n) - t * mu
+    da, dw2 = _fused_dgrad_wgrad(
+        y, do, a, w.reshape(w.shape[-2], w.shape[-1]), s, t, u, v,
+        relu, _resolve_interpret(interpret),
+    )
+    dw = dw2.reshape(w.shape).astype(w.dtype)
+    return (da.astype(a.dtype), dw,
+            dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype))
+
+
+conv1x1_bn_act.defvjp(_conv1x1_bn_fwd, _conv1x1_bn_bwd)
+
+
+def conv1x1_bn(mdl, conv_name: str, bn_name: str, x, features: int, *,
+               relu: bool, use_running_average: bool, dtype,
+               momentum: float = 0.9, eps: float = 1e-5,
+               scale_init=None, fused: bool = True,
+               interpret: Optional[bool] = None):
+    """Flax-level combinator: a ``Conv_k``→``FusedBatchNormAct_k`` pair whose
+    params live at EXACTLY the unfused pair's paths (declared through child
+    scopes), so toggling the fused backward never invalidates a checkpoint —
+    asserted by tests/test_fused_conv_bn.py.
+
+    ``mdl`` is the calling (compact) module; names are the explicit child
+    names the unfused branch would auto-assign.
+    """
+    from flax import linen as nn
+
+    if scale_init is None:
+        scale_init = nn.initializers.ones
+    ci = x.shape[-1]
+    csc = mdl.scope.push(conv_name)
+    kernel = csc.param("kernel", nn.initializers.lecun_normal(),
+                       (1, 1, ci, features), jnp.float32)
+    bsc = mdl.scope.push(bn_name)
+    gamma = bsc.param("scale", scale_init, (features,), jnp.float32)
+    beta = bsc.param("bias", nn.initializers.zeros, (features,), jnp.float32)
+    ra_mean = bsc.variable("batch_stats", "mean",
+                           lambda: jnp.zeros((features,), jnp.float32))
+    ra_var = bsc.variable("batch_stats", "var",
+                          lambda: jnp.ones((features,), jnp.float32))
+
+    xd = x.astype(dtype)
+    if use_running_average:
+        y = _conv1x1(xd, kernel)
+        invr = jax.lax.rsqrt(ra_var.value + eps)
+        scale = gamma * invr
+        shift = beta - ra_mean.value * scale
+        o = (y.astype(jnp.float32) * scale + shift).astype(y.dtype)
+        return jax.nn.relu(o) if relu else o
+
+    if mdl.is_initializing() or not fused:
+        y = _conv1x1(xd, kernel)
+        o, mu, var = _bn_act(y, gamma, beta, eps, relu)
+    else:
+        o, mu, var = conv1x1_bn_act(xd, kernel, gamma, beta, eps, relu,
+                                    interpret)
+    if not mdl.is_initializing():
+        m = momentum
+        ra_mean.value = m * ra_mean.value + (1 - m) * jax.lax.stop_gradient(mu)
+        ra_var.value = m * ra_var.value + (1 - m) * jax.lax.stop_gradient(var)
+    return o

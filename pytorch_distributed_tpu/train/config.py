@@ -35,6 +35,10 @@ class Config:
     start_epoch: int = 0
     batch_size: int = 3200        # GLOBAL batch (reference semantics)
     lr: float = 0.1
+    # "step" = the reference's adjust_learning_rate (0.1x every 30 epochs,
+    # distributed.py:374-378); "cosine" = warmup+cosine over --epochs.
+    lr_schedule: str = "step"
+    lr_warmup_epochs: int = 0
     momentum: float = 0.9
     weight_decay: float = 1e-4
     print_freq: int = 10
@@ -56,8 +60,13 @@ class Config:
     # ResNet stem variant: "space_to_depth" is the MLPerf-style packed stem
     # (identical math/params, faster MXU tiling); other archs ignore it.
     stem: str = "conv7"
+    # Fold BN-backward dx into the 1x1 dgrad/wgrad via the Pallas fused
+    # kernel (ops/fused_conv_bn.py); ResNet bottleneck family only.
+    fused_convbn: bool = False
     resume: Optional[str] = None
-    checkpoint_dir: str = "."
+    # Default under runs/ so checkpoints never land in the repo root
+    # (workspace-hygiene; save_checkpoint creates the directory).
+    checkpoint_dir: str = "runs"
     ckpt_backend: str = "msgpack"
     epoch_csv: Optional[str] = None
     profile_dir: Optional[str] = None
@@ -88,6 +97,13 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="mini-batch size: total batch size across all chips")
     p.add_argument("--lr", "--learning-rate", default=d.lr, type=float,
                    metavar="LR", help="initial learning rate", dest="lr")
+    p.add_argument("--lr-schedule", default=d.lr_schedule,
+                   choices=("step", "cosine"), dest="lr_schedule",
+                   help="step = reference 0.1x-every-30-epochs decay; "
+                   "cosine = warmup+cosine over --epochs")
+    p.add_argument("--lr-warmup-epochs", default=d.lr_warmup_epochs, type=int,
+                   dest="lr_warmup_epochs",
+                   help="linear LR warmup epochs (cosine schedule)")
     p.add_argument("--momentum", default=d.momentum, type=float, metavar="M",
                    help="momentum")
     p.add_argument("--wd", "--weight-decay", default=d.weight_decay, type=float,
@@ -148,6 +164,10 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    choices=("conv7", "space_to_depth"),
                    help="ResNet stem: torchvision conv7 or the numerically "
                    "identical space-to-depth packing (TPU MXU-friendly)")
+    p.add_argument("--fused-convbn", action="store_true", dest="fused_convbn",
+                   help="fuse BN-backward dx into the bottleneck 1x1 "
+                   "dgrad/wgrad (Pallas; dy never hits HBM); checkpoints "
+                   "stay interchangeable with the unfused model")
     return p
 
 

@@ -19,6 +19,35 @@ def step_decay_lr(
     return base_lr * (decay_factor ** (epoch // decay_every))
 
 
+def cosine_lr(
+    base_lr: float,
+    epoch: int,
+    total_epochs: int,
+    warmup_epochs: int = 0,
+    min_lr: float = 0.0,
+) -> float:
+    """Warmup + cosine decay over epochs — half-cosine from ``base_lr`` at
+    the end of warmup to ``min_lr`` at ``total_epochs``.  Same shape as the
+    LM twin's per-step ``warmup_cosine_lr`` (train/lm.py), but the ramp here
+    ends AT ``warmup_epochs`` (every warmup epoch runs reduced), while the
+    LM form's ``(step+1)/warmup_steps`` reaches full LR one step early —
+    immaterial at its hundreds-of-steps granularity, degenerate at epoch
+    granularity.  Like ``step_decay_lr`` this is a pure host-side function;
+    its value enters the jitted step as a scalar operand, so changing LR
+    never retraces."""
+    import math
+
+    if warmup_epochs > 0 and epoch < warmup_epochs:
+        # Ramp reaches base_lr at epoch == warmup_epochs, so every warmup
+        # epoch (including warmup_epochs=1) really runs reduced — the
+        # (epoch+1)/warmup form makes warmup=1 a silent no-op at epoch
+        # granularity (round-4 review finding).
+        return base_lr * (epoch + 1) / (warmup_epochs + 1)
+    span = max(1, total_epochs - warmup_epochs)
+    t = min(max(epoch - warmup_epochs, 0), span) / span
+    return min_lr + (base_lr - min_lr) * 0.5 * (1.0 + math.cos(math.pi * t))
+
+
 def linear_scaled_lr(base_lr: float, global_batch: int, base_batch: int = 256) -> float:
     """Linear-scaling rule (Goyal et al.) — optional helper, off by default to
     preserve the reference's effective-LR semantics (SURVEY.md §7.4 item 2)."""

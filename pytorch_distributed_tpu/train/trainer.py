@@ -29,7 +29,7 @@ from pytorch_distributed_tpu.data.transforms import eval_transform, train_transf
 from pytorch_distributed_tpu.parallel import DistContext, data_parallel_mesh
 from pytorch_distributed_tpu.train.checkpoint import load_checkpoint, save_checkpoint
 from pytorch_distributed_tpu.train.config import Config
-from pytorch_distributed_tpu.train.lr import step_decay_lr
+from pytorch_distributed_tpu.train.lr import cosine_lr, step_decay_lr
 from pytorch_distributed_tpu.train.meters import AverageMeter, ProgressMeter
 from pytorch_distributed_tpu.train.optim import sgd_init
 from pytorch_distributed_tpu.train.state import TrainState
@@ -79,14 +79,17 @@ class Trainer:
         self._build_data()
 
         dtype = jnp.bfloat16 if cfg.precision == "bf16" else jnp.float32
-        # --stem is a ResNet-family knob; only forwarded when non-default.
+        # --stem / --fused-convbn are ResNet-family knobs; only forwarded
+        # when non-default.
         extra = {} if cfg.stem == "conv7" else {"stem": cfg.stem}
+        if cfg.fused_convbn:
+            extra["fused_convbn"] = True
         if extra and getattr(
             models._REGISTRY.get(cfg.arch), "func", None
         ) is not models.ResNet:
             raise ValueError(
-                f"--stem {cfg.stem} only applies to the ResNet family; "
-                f"arch {cfg.arch!r} has no stem variant"
+                f"--stem/--fused-convbn only apply to the ResNet family; "
+                f"arch {cfg.arch!r} has no such variant"
             )
         self.model = models.create_model(
             cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra
@@ -263,7 +266,15 @@ class Trainer:
     # ----------------------------------------------------------------- train
     def train_epoch(self, epoch: int) -> None:
         cfg = self.cfg
-        lr = step_decay_lr(cfg.lr, epoch)
+        if cfg.lr_schedule == "cosine":
+            lr = cosine_lr(cfg.lr, epoch, cfg.epochs,
+                           warmup_epochs=cfg.lr_warmup_epochs)
+        elif cfg.lr_schedule == "step":
+            lr = step_decay_lr(cfg.lr, epoch)
+        else:  # argparse enforces choices; guard programmatic Configs too
+            raise ValueError(
+                f"unknown lr_schedule {cfg.lr_schedule!r}: "
+                "expected 'step' or 'cosine'")
         batch_time = AverageMeter("Time", ":6.3f")
         losses = AverageMeter("Loss", ":.4e")
         top1 = AverageMeter("Acc@1", ":6.2f")
