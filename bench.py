@@ -52,6 +52,18 @@ def _emit(payload: dict, code: int) -> "NoReturn":
     sys.exit(code)
 
 
+def _stale_exit_code() -> int:
+    """Exit code for stale (LKG-replay) emissions.  Default 0 keeps the
+    driver contract that recorded round 3's stale marker; set
+    BENCH_STALE_EXIT_CODE (e.g. 3) so an automated consumer keying on the
+    exit code can never mistake a replayed number for a fresh benchmark
+    (advisor r3) — the "stale": true field remains the in-band marker."""
+    try:
+        return int(os.environ.get("BENCH_STALE_EXIT_CODE", "0"))
+    except ValueError:
+        return 0
+
+
 def _emit_failure(error: str) -> "NoReturn":
     """Last resort: report last-known-good (marked stale) instead of 0.0."""
     try:
@@ -65,7 +77,7 @@ def _emit_failure(error: str) -> "NoReturn":
             "stale": True,
             "stale_from": lkg.get("captured_at"),
             "error": error,
-        }, 0)
+        }, _stale_exit_code())
     except (OSError, KeyError, ValueError):
         _emit({"metric": METRIC, "value": 0.0, "unit": UNIT,
                "vs_baseline": 0.0, "error": error}, 1)
@@ -142,7 +154,7 @@ def main() -> None:
                     "stale_from": lkg.get("captured_at"),
                     "error": "backend init hung >240s after probe success",
                 }))
-                os._exit(0)
+                os._exit(_stale_exit_code())
             except (OSError, KeyError, ValueError):
                 print(json.dumps({
                     "metric": METRIC, "value": 0.0, "unit": UNIT,

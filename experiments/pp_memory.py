@@ -32,7 +32,7 @@ import numpy as np  # noqa: E402
 
 def compiled_temp_bytes(schedule: str, remat: bool, n_micro: int,
                         d_model: int, seq: int, stages: int,
-                        vocab: int, mb: int) -> dict:
+                        vocab: int, mb: int, time_iters: int = 0) -> dict:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from pytorch_distributed_tpu.models.pipeline_lm import (
@@ -65,12 +65,26 @@ def compiled_temp_bytes(schedule: str, remat: bool, n_micro: int,
         toks = jax.device_put(tokens, NamedSharding(mesh, P("data", None)))
         compiled = step.lower(state, toks, jnp.float32(0.05)).compile()
     m = compiled.memory_analysis()
-    return {
+    row = {
         "schedule": schedule + ("+remat" if remat else ""),
         "microbatches": n_micro,
         "temp_bytes": int(m.temp_size_in_bytes),
         "argument_bytes": int(m.argument_size_in_bytes),
     }
+    if time_iters:
+        import time
+
+        lr = jnp.float32(0.05)
+        with mesh:
+            state, _ = compiled(state, toks, lr)   # warm; state is donated,
+            jax.block_until_ready(state.params)    # so chain the new one
+            t0 = time.perf_counter()
+            for _ in range(time_iters):
+                state, _ = compiled(state, toks, lr)
+            jax.block_until_ready(state.params)
+            row["ms_per_step"] = round(
+                (time.perf_counter() - t0) * 1000.0 / time_iters, 1)
+    return row
 
 
 def main() -> None:
@@ -81,6 +95,9 @@ def main() -> None:
     ap.add_argument("--vocab", type=int, default=1024)
     ap.add_argument("--mb", type=int, default=2, help="per-microbatch batch")
     ap.add_argument("--micro", type=int, nargs="+", default=[8, 32])
+    ap.add_argument("--time-iters", type=int, default=2,
+                    help="timed executions per config after one warm step "
+                    "(0 = compile-only, the round-3 behavior)")
     ap.add_argument("--out", default="RESULTS_pp_memory.json")
     args = ap.parse_args()
 
@@ -90,17 +107,24 @@ def main() -> None:
                                 ("1f1b", False)):
             r = compiled_temp_bytes(schedule, remat, n_micro, args.d_model,
                                     args.seq, args.stages, args.vocab,
-                                    args.mb)
+                                    args.mb, time_iters=args.time_iters)
             rows.append(r)
             print(f"M={n_micro:3d} {r['schedule']:12s} "
-                  f"temp={r['temp_bytes']/2**20:9.1f} MiB", flush=True)
+                  f"temp={r['temp_bytes']/2**20:9.1f} MiB "
+                  f"ms/step={r.get('ms_per_step', '-')}", flush=True)
 
     out = {
         "config": {"d_model": args.d_model, "seq": args.seq,
                    "stages": args.stages, "vocab": args.vocab,
                    "mb": args.mb,
                    "note": "XLA compiled peak temp buffers, full train step "
-                           "(fwd+bwd+SGD), 8-device CPU mesh, f32"},
+                           "(fwd+bwd+SGD), 8-device CPU mesh, f32",
+                   "timing_note": "ms_per_step on the 1-core host serializes "
+                   "all 8 simulated stages, so pipeline BUBBLES cost no "
+                   "wall-clock here; the column isolates per-schedule "
+                   "compute overhead (remat's recompute tax, 1f1b's "
+                   "scheduling overhead vs gpipe) — bubble-fraction deltas "
+                   "need real parallel chips"},
         "rows": rows,
     }
     with open(args.out, "w") as f:

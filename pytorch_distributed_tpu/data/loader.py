@@ -84,6 +84,8 @@ class DataLoader:
         self.seed = seed
         self.batch_mode = batch_mode
         self.random_flip = random_flip
+        self._pool = None      # persistent spawn pool (process worker_type)
+        self._pool_key = None
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
@@ -229,6 +231,45 @@ class DataLoader:
                 samples = list(pool.map(self._fetch, idx, val))
                 yield self._assemble(b, val, samples)
 
+    def _ensure_pool(self):
+        """The spawn pool persists across epochs (advisor r3: a per-__iter__
+        pool re-pays full worker spawn + dataset pickling every epoch) —
+        rebuilt when ``self.dataset`` is rebound to a different object or
+        the worker count changes; ``close()``/``__del__`` tear it down.
+
+        The key holds a STRONG reference to the keyed dataset and compares
+        by identity, so a freed-then-reallocated object can never alias the
+        key (id() alone can be reused by CPython).  Workers hold a pickled
+        SNAPSHOT of the dataset: in-place mutation (e.g. swapping
+        ``dataset.transform`` mid-training) is not re-shipped — call
+        ``close()`` after mutating to force a fresh pool next epoch."""
+        import multiprocessing as mp
+
+        if (self._pool is not None
+                and self._pool_key is not None
+                and self._pool_key[0] is self.dataset
+                and self._pool_key[1] == self.num_workers):
+            return self._pool
+        self.close()
+        ctx = mp.get_context("spawn")
+        self._pool = ctx.Pool(self.num_workers, initializer=_process_init,
+                              initargs=(self.dataset,))
+        self._pool_key = (self.dataset, self.num_workers)
+        return self._pool
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+            self._pool_key = None
+
+    def __del__(self):  # best-effort; close() is the deterministic path
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter may be tearing down
+            pass
+
     def _iter_process(self, indices, valid, nb: int) -> Iterator[Batch]:
         """Worker *processes* for the per-sample fetch — the GIL-proof mode
         for Python/PIL decode (the reference's ``DataLoader(num_workers=…)``
@@ -239,25 +280,29 @@ class DataLoader:
         Spawn start method, NOT fork: this runtime pre-imports jax (which is
         multithreaded) into every interpreter, and forking a threaded parent
         can deadlock the children.  The dataset ships to each worker once
-        via the pool initializer (transforms are plain picklable classes);
-        worker startup cost amortizes over the epoch."""
-        import multiprocessing as mp
+        via the pool initializer (transforms are plain picklable classes).
 
-        ctx = mp.get_context("spawn")
-        pool = ctx.Pool(self.num_workers, initializer=_process_init,
-                        initargs=(self.dataset,))
-        try:
-            for b in range(nb):
-                idx, val = self._batch_indices(indices, valid, b)
-                args = [
-                    (int(i), int(v), self.seed, self.sampler.epoch)
-                    for i, v in zip(idx, val)
-                ]
-                samples = pool.map(_process_fetch, args)
-                yield self._assemble(b, val, samples)
-        finally:
-            pool.terminate()
-            pool.join()
+        Dispatch is **batch-level, not item-level** (VERDICT r3 item 6):
+        each worker gets one contiguous chunk of the batch per task — one
+        pickle round-trip per worker per batch instead of one per sample —
+        so on a host where processes cannot actually parallelize (1 core)
+        the IPC overhead stays a constant per batch, not per image."""
+        pool = self._ensure_pool()
+        W = self.num_workers
+        for b in range(nb):
+            idx, val = self._batch_indices(indices, valid, b)
+            args = [
+                (int(i), int(v), self.seed, self.sampler.epoch)
+                for i, v in zip(idx, val)
+            ]
+            bounds = [(len(args) * w // W, len(args) * (w + 1) // W)
+                      for w in range(W)]
+            chunks = [args[lo:hi] for lo, hi in bounds if hi > lo]
+            samples = [
+                s for chunk in pool.map(_process_fetch_chunk, chunks)
+                for s in chunk
+            ]
+            yield self._assemble(b, val, samples)
 
 
 _PROC_DATASET = None  # per-worker global, set by _process_init
@@ -277,6 +322,11 @@ def _process_fetch(args):
     if hasattr(ds, "get"):
         return ds.get(index, rng)
     return ds[index]
+
+
+def _process_fetch_chunk(chunk):
+    """One task per worker per batch: fetch a whole contiguous chunk."""
+    return [_process_fetch(a) for a in chunk]
 
 
 class AsyncFeeder:

@@ -89,6 +89,7 @@ def build_hybrid_mesh(
     spec: MeshSpec,
     dcn_axis: str = "data",
     devices: Optional[Sequence[jax.Device]] = None,
+    granule: str = "auto",
 ) -> Mesh:
     """Multi-slice mesh: ``dcn_axis`` spans slices over DCN, every other
     axis stays inside a slice on ICI.
@@ -101,33 +102,56 @@ def build_hybrid_mesh(
     XLA decomposes them hierarchically (in-slice reduce, cross-slice
     exchange, in-slice broadcast).
 
-    On a single slice — or on the CPU-simulated mesh, whose devices carry
-    no slice topology — this degrades to plain ``build_mesh``; the
+    ``granule`` — the unit of the outer (DCN) network:
+
+    - ``"slice"``    — TPU slices via ``device.slice_index``;
+    - ``"process"``  — host processes (``device.process_index``), for
+                       platforms that don't set ``slice_index`` (GPU-style
+                       deployments; the multi-process CPU sim — this is
+                       what lets the DCN code path run LIVE in
+                       tests/test_multiprocess.py);
+    - ``"auto"``     — slices when >1 are visible, else processes when >1,
+                       else the plain flat mesh.
+
+    On a single granule this degrades to plain ``build_mesh``; the
     ``dcn_axis`` size must then be 1 or divide the flat device order,
     which is what ``jax.devices()`` already gives.
     """
     if dcn_axis not in spec.axes:
         raise ValueError(f"dcn_axis {dcn_axis!r} not in mesh axes {spec.axes}")
+    if granule not in ("auto", "slice", "process"):
+        raise ValueError(f"unknown granule {granule!r}")
     devs = list(devices) if devices is not None else list(jax.devices())
-    slice_ids = {getattr(d, "slice_index", 0) for d in devs}
-    n_slices = len(slice_ids)
-    if n_slices <= 1:
+    n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+    n_procs = len({d.process_index for d in devs})
+    auto = granule == "auto"
+    if auto:
+        granule = "slice" if n_slices > 1 else "process"
+    n_granules = n_slices if granule == "slice" else n_procs
+    if n_granules <= 1:
         return build_mesh(spec, devs)
     shape = spec.resolve(len(devs))
     dcn_pos = spec.axes.index(dcn_axis)
-    if shape[dcn_pos] % n_slices:
+    if shape[dcn_pos] % n_granules:
+        if auto:
+            # Auto must never turn a previously-valid spec into an error:
+            # an indivisible dcn axis just means this spec can't be laid
+            # out hierarchically — keep the flat mesh (the pre-round-4
+            # behavior for process granules).
+            return build_mesh(spec, devs)
         raise ValueError(
             f"dcn axis {dcn_axis!r} size {shape[dcn_pos]} not divisible by "
-            f"the {n_slices} slices"
+            f"the {n_granules} {granule} granules"
         )
     from jax.experimental import mesh_utils
 
     ici_shape = list(shape)
-    ici_shape[dcn_pos] = shape[dcn_pos] // n_slices
+    ici_shape[dcn_pos] = shape[dcn_pos] // n_granules
     dcn_shape = [1] * len(shape)
-    dcn_shape[dcn_pos] = n_slices
+    dcn_shape[dcn_pos] = n_granules
     dev_array = mesh_utils.create_hybrid_device_mesh(
         tuple(ici_shape), tuple(dcn_shape), devs,
+        process_is_granule=granule == "process",
         allow_split_physical_axes=True,
     )
     return Mesh(dev_array, spec.axes)

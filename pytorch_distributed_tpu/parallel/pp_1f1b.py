@@ -28,6 +28,18 @@ Bubble note: this synchronous formulation pays a ``2(P-1)``-tick bubble
 ``M ≫ P`` the difference vanishes, and each tick does F+B work so the
 steady state is fully utilized.
 
+Memory-claim scope: the **M-independent bound covers the activation
+stash** (the term that explodes under GPipe).  Each stage still holds the
+full ``[B, ...]`` microbatch input stack (``micro``/``tokens``, replicated
+over ``pipe`` by the shard_map specs) plus the equally-shaped fp32
+``d_micro`` cotangent accumulator — two O(B·L·D) buffers that scale with
+the *batch*, not with M.  Measured at d512/seq512/8 stages they are a few
+hundred MiB against GPipe's multi-GiB O(M) stash
+(RESULTS_pp_memory.json); slicing the feed to stage 0 / the head to the
+last stage would need per-stage data placement that uniform shard_map
+specs cannot express, so the replication is documented rather than
+removed.
+
 Beyond-reference capability (SURVEY.md §2.3: pipeline parallelism is
 "explicitly absent" from the reference)."""
 

@@ -397,8 +397,22 @@ class LMTrainer:
                     (s[0].start or 0, B if s[0].stop is None else s[0].stop)
                     for d, s in gm.items() if d.process_index == me
                 ]
-                self._span = (min(s[0] for s in spans),
-                              max(s[1] for s in spans))
+                lo = min(s[0] for s in spans)
+                hi = max(s[1] for s in spans)
+                # (min, max) assumes this process's row slices tile a
+                # contiguous range; a future hybrid/multi-slice device
+                # order could interleave processes, and an over-wide span
+                # would surface as a confusing shape error deep inside
+                # make_array_from_process_local_data (advisor r3).
+                rows = sum(b - a for a, b in set(spans))
+                if hi - lo != rows:
+                    raise ValueError(
+                        f"process {me} holds a non-contiguous row shard "
+                        f"{sorted(set(spans))} of the global batch; "
+                        "contiguous per-process rows are required for the "
+                        "local-assembly feed path"
+                    )
+                self._span = (lo, hi)
         return self._span
 
     def _local_rows(self, global_batch: np.ndarray) -> np.ndarray:
