@@ -133,11 +133,12 @@ def build_hybrid_mesh(
     shape = spec.resolve(len(devs))
     dcn_pos = spec.axes.index(dcn_axis)
     if shape[dcn_pos] % n_granules:
-        if auto:
+        if auto and granule == "process":
             # Auto must never turn a previously-valid spec into an error:
-            # an indivisible dcn axis just means this spec can't be laid
-            # out hierarchically — keep the flat mesh (the pre-round-4
-            # behavior for process granules).
+            # process granules are a round-4 addition, so an indivisible
+            # dcn axis keeps the flat mesh those callers used to get.
+            # (Indivisible SLICES still raise, as they always did — real
+            # multi-slice topology with a bad axis is a config bug.)
             return build_mesh(spec, devs)
         raise ValueError(
             f"dcn axis {dcn_axis!r} size {shape[dcn_pos]} not divisible by "
