@@ -91,7 +91,10 @@ def main() -> int:
         "rows": rows,
         "feeder_speedup": round(speedup, 3),
     }
-    out_path = os.path.join(REPO, "RESULTS_lm.json")
+    # Smokes must not pollute the committed chip results: LMFEED_OUT
+    # redirects (e.g. /tmp/lm_smoke.json); chip runs leave it unset.
+    out_path = os.environ.get("LMFEED_OUT",
+                              os.path.join(REPO, "RESULTS_lm.json"))
     data = {}
     if os.path.exists(out_path):
         with open(out_path) as f:

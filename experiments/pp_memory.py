@@ -19,7 +19,13 @@ import json
 import os
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    # Long pipeline steps serialize 8 device threads onto however many host
+    # cores exist; XLA-CPU's default 40 s collective-rendezvous terminate
+    # limit shoots the process mid-step on a 1-core host (observed at M=32).
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=1800"
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
 ).strip()
 
 import jax  # noqa: E402

@@ -33,6 +33,19 @@ import numpy as np
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 PEAK_BF16_FLOPS = float(os.environ.get("VIT_PEAK_FLOPS", 197e12))  # v5e chip
 ITERS = int(os.environ.get("VIT_ITERS", "20"))
+# Smoke knobs (CPU shakeout only — chip runs use the defaults): shrink the
+# image / divide the batches / redirect artifacts so a dry run can't leave
+# bogus RESULTS_vit.json / vit_statistics.csv at the repo root.
+IMAGE = int(os.environ.get("VIT_IMAGE", "224"))
+BATCH_DIV = int(os.environ.get("VIT_BATCH_DIV", "1"))
+ATTN_ITERS = int(os.environ.get("VIT_ATTN_ITERS", "50"))
+_SMOKE = (IMAGE != 224 or BATCH_DIV != 1 or ATTN_ITERS != 50
+          or bool(os.environ.get("VIT_PLATFORM")))
+# Any smoke knob forces artifacts off the repo root unless the caller
+# explicitly chose a destination — a dry run must never overwrite the
+# committed RESULTS_vit.json / vit_statistics.csv.
+OUT_DIR = os.environ.get("VIT_OUT_DIR") or (
+    __import__("tempfile").gettempdir() if _SMOKE else REPO)
 
 
 def vit_flops_per_image(*, image: int, patch: int, d: int, layers: int,
@@ -59,7 +72,7 @@ ARCHS = {
 }
 
 
-def bench_arch(arch: str, spec: dict, image: int = 224) -> dict:
+def bench_arch(arch: str, spec: dict, image: int = IMAGE) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -69,7 +82,7 @@ def bench_arch(arch: str, spec: dict, image: int = 224) -> dict:
     from pytorch_distributed_tpu.train.state import TrainState
     from pytorch_distributed_tpu.train.steps import make_train_step
 
-    batch = spec["batch"]
+    batch = max(1, spec["batch"] // BATCH_DIV)
     mesh = data_parallel_mesh()
     model = models.create_model(arch, num_classes=1000, dtype=jnp.bfloat16)
     variables = model.init(
@@ -125,6 +138,7 @@ def bench_attention(image: int = 224, patch: int = 16, d: int = 768,
     from pytorch_distributed_tpu.ops.flash_attention import flash_attention
 
     L = 256  # 197 padded up to the kernel's block granularity
+    batch = max(1, batch // BATCH_DIV)
     hd = d // heads
     rng = np.random.default_rng(0)
     q, k, v = (
@@ -147,10 +161,10 @@ def bench_attention(image: int = 224, patch: int = 16, d: int = 768,
         r = fn(q, k, v)
         r.block_until_ready()
         t0 = time.perf_counter()
-        for _ in range(50):
+        for _ in range(ATTN_ITERS):
             r = fn(q, k, v)
         r.block_until_ready()
-        ms = (time.perf_counter() - t0) / 50 * 1000
+        ms = (time.perf_counter() - t0) / ATTN_ITERS * 1000
         out[name + "_ms"] = round(ms, 3)
         print(f"attention {name}: {ms:.3f} ms  (B={batch} L={L} H={heads} "
               f"hd={hd})", flush=True)
@@ -158,9 +172,18 @@ def bench_attention(image: int = 224, patch: int = 16, d: int = 768,
 
 
 def main() -> int:
+    # Smoke runs steer off the tunneled-axon platform (sitecustomize
+    # pre-sets it, so plain env doesn't work — same dance as
+    # convergence_hard.py); chip runs leave VIT_PLATFORM unset.
+    plat = os.environ.get("VIT_PLATFORM")
+    if plat:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", plat)
+
     from pytorch_distributed_tpu.utils.telemetry import TelemetrySampler
 
-    csv_path = os.path.join(REPO, "vit_statistics.csv")
+    csv_path = os.path.join(OUT_DIR, "vit_statistics.csv")
     sampler = TelemetrySampler(csv_path, 0.5).start()
     try:
         results = {a: bench_arch(a, s) for a, s in ARCHS.items()}
@@ -171,9 +194,10 @@ def main() -> int:
     import jax
 
     attn = results["attention_micro"]
-    fwd_b16 = vit_flops_per_image(image=224, patch=16, d=768, layers=12,
+    fwd_b16 = vit_flops_per_image(image=IMAGE, patch=16, d=768, layers=12,
                                   heads=12, mlp=3072)
-    attn_frac = (12 * 2 * 197 * 197 * 768 * 2) / fwd_b16
+    L16 = (IMAGE // 16) ** 2 + 1  # tokens at the RUN's image size
+    attn_frac = (12 * 2 * L16 * L16 * 768 * 2) / fwd_b16
     out = {
         "meta": {
             "platform": jax.devices()[0].platform,
@@ -188,7 +212,7 @@ def main() -> int:
         },
         "results": results,
     }
-    with open(os.path.join(REPO, "RESULTS_vit.json"), "w") as f:
+    with open(os.path.join(OUT_DIR, "RESULTS_vit.json"), "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out, indent=1))
     return 0

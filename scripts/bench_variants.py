@@ -6,6 +6,13 @@ HBM-roofline-bound at ~690 GB/s effective):
   - batch 256 vs 512           (amortize fixed/latency costs)
   - conv7 vs space_to_depth    (stem MXU packing)
   - f32 vs bf16 input images   (stem read traffic)
+  - fused vs unfused conv+BN backward (round 4: the BN-dx fold,
+    ops/fused_conv_bn.py — the only identified route past the ceiling)
+
+Select variants by substring — multiple args are OR'd (a variant runs if
+ANY substring matches its tag), so ``b256 fused`` = all b256 variants
+PLUS all fused variants; use one precise substring for an intersection
+(e.g. ``b256-space_to_depth-bfloat16-fusedconvbn``).
 """
 
 import itertools
@@ -41,14 +48,17 @@ def main():
     lr = jnp.float32(0.1)
 
     combos = itertools.product(
-        (256, 512), ("conv7", "space_to_depth"), (np.float32, jnp.bfloat16))
+        (256, 512), ("conv7", "space_to_depth"), (np.float32, jnp.bfloat16),
+        (False, True))
     only = sys.argv[1:] or None
-    for batch, stem, in_dtype in combos:
-        tag = f"b{batch}-{stem}-{np.dtype(in_dtype).name}"
+    for batch, stem, in_dtype, fused in combos:
+        tag = (f"b{batch}-{stem}-{np.dtype(in_dtype).name}"
+               + ("-fusedconvbn" if fused else ""))
         if only and not any(o in tag for o in only):
             continue
         model = models.create_model(
-            "resnet50", num_classes=1000, dtype=jnp.bfloat16, stem=stem)
+            "resnet50", num_classes=1000, dtype=jnp.bfloat16, stem=stem,
+            fused_convbn=fused)
         variables = model.init(
             jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)), train=False)
         state = TrainState.create(variables, sgd_init(variables["params"]))
@@ -60,7 +70,13 @@ def main():
                 rng.integers(0, 1000, size=batch).astype(np.int32)),
             "weights": jnp.ones((batch,), jnp.float32),
         }
-        dt = timeit(step, state, b, lr)
+        try:
+            dt = timeit(step, state, b, lr)
+        except Exception as e:  # noqa: BLE001 — e.g. Mosaic rejecting the
+            # fused kernel on this chip/toolchain: report, keep sweeping.
+            print(f"{tag:34s} FAILED {type(e).__name__}: {str(e)[:120]}",
+                  flush=True)
+            continue
         print(f"{tag:34s} {dt*1e3:8.2f} ms/step  {batch/dt:8.1f} img/s",
               flush=True)
 
