@@ -40,7 +40,7 @@ IMAGE = int(os.environ.get("VIT_IMAGE", "224"))
 BATCH_DIV = int(os.environ.get("VIT_BATCH_DIV", "1"))
 ATTN_ITERS = int(os.environ.get("VIT_ATTN_ITERS", "50"))
 _SMOKE = (IMAGE != 224 or BATCH_DIV != 1 or ATTN_ITERS != 50
-          or bool(os.environ.get("VIT_PLATFORM")))
+          or ITERS != 20 or bool(os.environ.get("VIT_PLATFORM")))
 # Any smoke knob forces artifacts off the repo root unless the caller
 # explicitly chose a destination — a dry run must never overwrite the
 # committed RESULTS_vit.json / vit_statistics.csv.

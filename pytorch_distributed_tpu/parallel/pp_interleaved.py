@@ -1,0 +1,479 @@
+"""Interleaved (virtual-stage) 1F1B: V model chunks per device.
+
+Megatron-LM's interleaved schedule (Narayanan et al. 2021) cuts the
+pipeline bubble from (P-1)/M to (P-1)/(M·V) by giving each device V
+non-adjacent model chunks: C = P·V chunks, chunk c on device c mod P, so
+every chunk boundary is the SAME +1 ring hop (the wrap P-1→0 included) and
+the comm pattern stays the two ppermutes of ``parallel/pp_1f1b.py``.
+
+The round-3/4 blocker was the "high-risk tick mapping" — closed-form
+index arithmetic for which (chunk, microbatch) each device runs at each
+tick.  This module removes that risk by **simulating the schedule on the
+host at trace time** (`simulate_interleaved_schedule`): per-device
+Megatron op order + data/backpressure readiness produces [T, P] tick
+tables (chunk, microbatch, stash slot, inbox routing) that the
+``shard_map``-ed ``lax.scan`` merely *gathers* — the hazardous arithmetic
+becomes a pure Python function with standalone invariant tests
+(tests/test_pp_interleaved.py):
+
+- every (c, m) forwarded exactly once and backwarded exactly once;
+- a value is consumed only after its 1-tick ppermute hop arrives;
+- one F and one B max per device per tick (one hop channel each way);
+- single-entry inboxes per chunk (senders back-pressured);
+- stash high-water mark reported (the interleave's V× memory trade).
+
+Runtime structure mirrors pp_1f1b: manual gradients inside one scan,
+``jax.vjp`` re-runs each chunk forward from its stashed input (in-chunk
+remat), the loss head runs on the last chunk's device in the tick its
+forward retires and seeds that chunk's backward through a local inbox.
+
+Scope note (round 4): the schedule + pipeline function + parity tests
+vs the sequential oracle; wiring into ``models/pipeline_lm.py``'s model
+class is round-5 work.  Beyond-reference capability (SURVEY.md §2.3:
+pipeline parallelism is "explicitly absent" from the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+Pytree = Any
+
+
+class InterleavedSchedule(NamedTuple):
+    """Host-side tick tables, all int32 [T, P] unless noted."""
+
+    T: int                 # ticks
+    S: int                 # stash slots per device (high-water mark)
+    f_active: np.ndarray   # bool: device runs a forward this tick
+    f_k: np.ndarray        # chunk-local index (0..V-1) of that forward
+    f_m: np.ndarray        # microbatch of that forward
+    f_slot: np.ndarray     # stash slot the forward's INPUT is written to
+    b_active: np.ndarray   # bool: device runs a backward this tick
+    b_k: np.ndarray
+    b_m: np.ndarray
+    b_slot: np.ndarray     # stash slot the backward reads (then frees)
+    rf_active: np.ndarray  # bool: incoming fwd hop value lands this tick
+    rf_k: np.ndarray       # inbox_f slot (consumer chunk-local k) it fills
+    rb_active: np.ndarray  # bool: incoming bwd hop value lands this tick
+    rb_k: np.ndarray       # inbox_b slot it fills
+
+
+def _megatron_order(P_: int, V: int, M: int, d: int):
+    """Device d's op list in Megatron's interleaved order:
+    [('F'|'B', chunk_local_k, microbatch), ...].
+
+    Forward step s runs chunk-local k = (s // P) % V on microbatch
+    m = P·(s // (P·V)) + s % P (microbatches advance in groups of P per
+    chunk); backward mirrors it with chunks reversed.  Warmup depth
+    (P - d - 1)·2 + (V - 1)·P staggers devices so the 1F1B phase
+    alternates one forward with one backward.
+    """
+    n = M * V  # total forward ops on every device
+
+    def fwd_km(s):
+        return (s // P_) % V, P_ * (s // (P_ * V)) + s % P_
+
+    def bwd_km(s):
+        return V - 1 - (s // P_) % V, P_ * (s // (P_ * V)) + s % P_
+
+    warmup = min(n, (P_ - d - 1) * 2 + (V - 1) * P_)
+    ops = [("F",) + fwd_km(s) for s in range(warmup)]
+    nf, nb = warmup, 0
+    while nf < n or nb < n:
+        if nf < n:
+            ops.append(("F",) + fwd_km(nf))
+            nf += 1
+        if nb < n:
+            ops.append(("B",) + bwd_km(nb))
+            nb += 1
+    return ops
+
+
+def simulate_interleaved_schedule(P_: int, V: int, M: int
+                                  ) -> InterleavedSchedule:
+    """Event-driven lockstep simulation → tick tables.
+
+    Each tick every device tries the earliest not-done op in its Megatron
+    list (strictly in order — a stalled op stalls the device), and may
+    additionally run the NEXT op in the same tick when it is of the other
+    type (the F+B-per-tick structure pp_1f1b uses).  Readiness:
+
+    - F(k, m): input available (chunk 0: always; else the hop value
+      arrived in a prior tick and still sits in inbox_f[k]) AND the
+      consumer's inbox slot for our output is free (backpressure; the
+      last chunk's output goes to the local head instead), AND a stash
+      slot is free;
+    - B(k, m): cotangent available in inbox_b[k] (last chunk: seeded the
+      tick its own forward ran, by the head).
+
+    The sim asserts single-entry inboxes, exactly-once execution, and
+    termination; the resulting tables make those invariants STATIC for
+    the compiled scan.
+    """
+    if M % P_:
+        # Megatron's group-of-P microbatch order requires it; the caller
+        # validates, this keeps the sim honest.
+        raise ValueError(f"microbatches {M} must divide by pipeline {P_}")
+    C = P_ * V
+    orders = [_megatron_order(P_, V, M, d) for d in range(P_)]
+    pos = [0] * P_
+    # inbox occupancy: None or (tag, k, m); fwd value for chunk k / bwd
+    # cotangent for chunk k.  Hop values land at the START of tick t+1.
+    inbox_f = [[None] * V for _ in range(P_)]
+    inbox_b = [[None] * V for _ in range(P_)]
+    in_flight_f: list = [None] * P_   # (k_consumer, m) arriving next tick
+    in_flight_b: list = [None] * P_
+    fwd_done: Dict[Tuple[int, int], int] = {}
+    bwd_done: Dict[Tuple[int, int], int] = {}
+    free_slots = [list(range(2 * C + M)) for _ in range(P_)]  # generous cap
+    slot_of: Dict[Tuple[int, int, int], int] = {}
+    rows: Dict[str, list] = {k: [] for k in (
+        "f_active", "f_k", "f_m", "f_slot", "b_active", "b_k", "b_m",
+        "b_slot", "rf_active", "rf_k", "rb_active", "rb_k")}
+    max_slot_used = 0
+    t = 0
+    limit = 8 * (M * V + 2 * C) + 64
+    while any(pos[d] < len(orders[d]) for d in range(P_)):
+        assert t < limit, f"schedule deadlocked at tick {t}"
+        row = {k: [0] * P_ for k in rows}
+        # 1. land in-flight hop values (sent at t-1).
+        for d in range(P_):
+            if in_flight_f[d] is not None:
+                k, m = in_flight_f[d]
+                assert inbox_f[d][k] is None, (
+                    f"t={t} d={d}: fwd inbox[{k}] collision")
+                inbox_f[d][k] = m
+                row["rf_active"][d] = 1
+                row["rf_k"][d] = k
+                in_flight_f[d] = None
+            if in_flight_b[d] is not None:
+                k, m = in_flight_b[d]
+                assert inbox_b[d][k] is None, (
+                    f"t={t} d={d}: bwd inbox[{k}] collision")
+                inbox_b[d][k] = m
+                row["rb_active"][d] = 1
+                row["rb_k"][d] = k
+                in_flight_b[d] = None
+        sends_f: list = [None] * P_
+        sends_b: list = [None] * P_
+        # The compiled tick body runs F before B, so the last-chunk F's
+        # head seed is WRITTEN before any same-tick B reads — a B-then-F
+        # sim order that consumes the old seed and then overwrites it
+        # would be mis-replayed (the fresh seed would clobber the pending
+        # one).  Gate the last-chunk F on the seed slot's occupancy AT
+        # TICK START, so that pattern stalls the F one tick instead.
+        seed_busy_at_start = [inbox_b[d][V - 1] is not None
+                              for d in range(P_)]
+        # Slots freed by a B this tick become available only NEXT tick:
+        # the compiled tick body writes the forward's stash entry before
+        # the backward reads (the same-tick head-seed → backward path
+        # needs that order), so a same-tick freed-slot reuse would let
+        # the F overwrite the B's input.
+        freed_this_tick: list = [[] for _ in range(P_)]
+
+        def try_run(d: int, op) -> bool:
+            nonlocal max_slot_used
+            kind, k, m = op
+            c = k * P_ + d
+            if kind == "F":
+                if c > 0 and inbox_f[d][k] != m:
+                    return False
+                if not free_slots[d]:
+                    return False
+                if c < C - 1:
+                    # backpressure: consumer inbox slot must be free and
+                    # no same-direction send already queued this tick.
+                    nd, nk = (d + 1) % P_, (k if d + 1 < P_ else k + 1)
+                    if inbox_f[nd][nk] is not None or in_flight_f[nd]:
+                        return False
+                    if sends_f[d] is not None:
+                        return False
+                elif inbox_b[d][k] is not None or seed_busy_at_start[d]:
+                    # last chunk: the head seeds inbox_b[V-1] this tick —
+                    # the slot must have been free at tick start (the
+                    # runtime writes the seed in its F phase, before any
+                    # same-tick B consumes).
+                    return False
+                # run
+                if c > 0:
+                    inbox_f[d][k] = None
+                slot = free_slots[d].pop(0)
+                max_slot_used = max(max_slot_used, slot + 1)
+                slot_of[(d, k, m)] = slot
+                fwd_done[(c, m)] = t
+                row["f_active"][d], row["f_k"][d] = 1, k
+                row["f_m"][d], row["f_slot"][d] = m, slot
+                if c < C - 1:
+                    sends_f[d] = ((d + 1) % P_,
+                                  k if d + 1 < P_ else k + 1, m)
+                else:
+                    # head seeds this chunk's own backward locally.
+                    assert inbox_b[d][k] is None
+                    inbox_b[d][k] = m
+                return True
+            # B
+            if inbox_b[d][k] != m:
+                return False
+            if c > 0:
+                nd, nk = (d - 1) % P_, (k if d > 0 else k - 1)
+                if inbox_b[nd][nk] is not None or in_flight_b[nd]:
+                    return False
+                if sends_b[d] is not None:
+                    return False
+            assert (c, m) in fwd_done and fwd_done[(c, m)] <= t
+            inbox_b[d][k] = None
+            slot = slot_of.pop((d, k, m))
+            freed_this_tick[d].append(slot)
+            bwd_done[(c, m)] = t
+            row["b_active"][d], row["b_k"][d] = 1, k
+            row["b_m"][d], row["b_slot"][d] = m, slot
+            if c > 0:
+                sends_b[d] = ((d - 1) % P_, k if d > 0 else k - 1, m)
+            return True
+
+        for d in range(P_):
+            lst = orders[d]
+            if pos[d] >= len(lst):
+                continue
+            if try_run(d, lst[pos[d]]):
+                pos[d] += 1
+                if (pos[d] < len(lst)
+                        and lst[pos[d]][0] != lst[pos[d] - 1][0]
+                        and try_run(d, lst[pos[d]])):
+                    pos[d] += 1
+        for d in range(P_):
+            if sends_f[d] is not None:
+                nd, nk, m = sends_f[d]
+                in_flight_f[nd] = (nk, m)
+            if sends_b[d] is not None:
+                nd, nk, m = sends_b[d]
+                in_flight_b[nd] = (nk, m)
+            free_slots[d] = freed_this_tick[d] + free_slots[d]
+        for k in rows:
+            rows[k].append(row[k])
+        t += 1
+    # drain any value still in flight (nothing left to consume it => bug)
+    assert all(v is None for v in in_flight_f + in_flight_b)
+    assert len(fwd_done) == C * M and len(bwd_done) == C * M, (
+        len(fwd_done), len(bwd_done), C * M)
+    arrs = {k: np.asarray(v, np.int32) for k, v in rows.items()}
+    return InterleavedSchedule(T=t, S=max_slot_used, **arrs)
+
+
+def interleaved_pipeline_loss_and_grads(
+    stage_fn: Callable[[Pytree, jnp.ndarray], jnp.ndarray],
+    head_fn: Callable[[Pytree, jnp.ndarray, jnp.ndarray],
+                      Tuple[jnp.ndarray, jnp.ndarray]],
+    chunk_params: Pytree,
+    head_params: Pytree,
+    x: jnp.ndarray,
+    tokens: jnp.ndarray,
+    n_microbatches: int,
+    n_virtual: int,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    data_axis: str = "data",
+):
+    """Interleaved-1F1B counterpart of ``pipeline_1f1b_loss_and_grads``.
+
+    ``chunk_params``: leaves with leading axis C = P·V in **device-major
+    order** — position p·V + k holds chunk c = k·P + p (device p's k-th
+    chunk), so sharding axis 0 over ``pipe_axis`` lands each device's V
+    chunks locally (use ``interleave_order``/``deinterleave_order`` to
+    convert from natural chunk order).  Returns ``(loss, correct, count,
+    g_chunks, g_head, dx)`` with ``g_chunks`` in the same layout.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    V = n_virtual
+    B = x.shape[0]
+    M = n_microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    for leaf in jax.tree_util.tree_leaves(chunk_params):
+        if leaf.shape[0] != n_stages * V:
+            raise ValueError(
+                f"chunk_params leading axis {leaf.shape[0]} != P*V = "
+                f"{n_stages * V}")
+    sched = simulate_interleaved_schedule(n_stages, V, M)
+    T, S = sched.T, sched.S
+    mb = B // M
+    micro = x.reshape((M, mb) + x.shape[1:])
+    micro_tok = tokens.reshape((M, mb) + tokens.shape[1:])
+    ring_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    ring_bwd = [((i + 1) % n_stages, i) for i in range(n_stages)]
+    data_size = mesh.shape.get(data_axis, 1)
+    has_data = data_axis in mesh.axis_names and data_size > 1
+    tables = jnp.stack([
+        jnp.asarray(a) for a in (
+            sched.f_active, sched.f_k, sched.f_m, sched.f_slot,
+            sched.b_active, sched.b_k, sched.b_m, sched.b_slot,
+            sched.rf_active, sched.rf_k, sched.rb_active, sched.rb_k)
+    ], axis=1)  # [T, 12, P]
+
+    from pytorch_distributed_tpu.parallel.pp_1f1b import _head_vjp
+
+    def per_stage(params_st, head_p, micro_local, tok_local):
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_st)
+        # params_local leaves: [V, ...] — this device's chunks.
+        idx = jax.lax.axis_index(pipe_axis)
+        last_dev = n_stages - 1
+
+        def masked_add(acc, upd, active):
+            return jax.tree_util.tree_map(
+                lambda a, u: a + jnp.where(active, u, 0).astype(a.dtype),
+                acc, upd)
+
+        def chunk_of(tree, k):
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, k, axis=0, keepdims=False), tree)
+
+        def tick(carry, tbl):
+            (vin_f, vin_b, inbox_f, inbox_b, stash, g_chunks, g_head,
+             d_micro, loss_sum, correct_sum) = carry
+            (fa, fk, fm, fsl, ba, bk, bm, bsl,
+             rfa, rfk, rba, rbk) = [tbl[i][idx] for i in range(12)]
+            # land incoming hop values (sent by neighbors last tick)
+            inbox_f = jnp.where(rfa == 1,
+                                inbox_f.at[rfk].set(vin_f), inbox_f)
+            inbox_b = jnp.where(rba == 1,
+                                inbox_b.at[rbk].set(vin_b), inbox_b)
+            # ---- forward ------------------------------------------------
+            feed = micro_local[jnp.clip(fm, 0, M - 1)]
+            is_feed = jnp.logical_and(idx == 0, fk == 0)  # chunk 0
+            x_in = jnp.where(is_feed, feed, inbox_f[fk])
+            y = stage_fn(chunk_of(params_local, fk), x_in)
+            stash = jnp.where(fa == 1, stash.at[fsl].set(x_in), stash)
+            # head: producing global chunk C-1 = (V-1)*P + (P-1)
+            is_last = jnp.logical_and(idx == last_dev, fk == V - 1)
+            tok_m = tok_local[jnp.clip(fm, 0, M - 1)]
+
+            def run_head(hp, yy, tm):
+                return _head_vjp(head_fn, hp, yy, tm)
+
+            def skip_head(hp, yy, tm):
+                zh = jax.tree_util.tree_map(jnp.zeros_like, hp)
+                return ((jnp.float32(0.0), jnp.float32(0.0)),
+                        (zh, jnp.zeros_like(yy)))
+
+            (loss_m, correct_m), (dhead_m, dy_head) = jax.lax.cond(
+                jnp.logical_and(is_last, fa == 1), run_head, skip_head,
+                head_p, y, tok_m)
+            active_h = jnp.logical_and(fa == 1, is_last)
+            g_head = masked_add(g_head, dhead_m, active_h)
+            loss_sum = loss_sum + jnp.where(active_h, loss_m, 0.0)
+            correct_sum = correct_sum + jnp.where(active_h, correct_m, 0.0)
+            # the head's cotangent seeds chunk C-1's backward locally
+            inbox_b = jnp.where(
+                active_h,
+                inbox_b.at[V - 1].set(dy_head.astype(inbox_b.dtype)),
+                inbox_b)
+            # ---- backward -----------------------------------------------
+            x_bwd = stash[bsl]
+            dy_in = inbox_b[bk].astype(x_bwd.dtype)
+            _, svjp = jax.vjp(
+                stage_fn, chunk_of(params_local, bk), x_bwd)
+            dp_m, dx_m = svjp(dy_in)
+            g_chunks = jax.tree_util.tree_map(
+                lambda acc, u: acc.at[bk].add(
+                    jnp.where(ba == 1, u, 0).astype(acc.dtype)),
+                g_chunks, dp_m)
+            write0 = jnp.logical_and(
+                ba == 1, jnp.logical_and(idx == 0, bk == 0))  # chunk 0
+            d_micro = jnp.where(
+                write0,
+                d_micro.at[jnp.clip(bm, 0, M - 1)].set(
+                    dx_m.astype(d_micro.dtype)),
+                d_micro,
+            )
+            vin_f_next = jax.lax.ppermute(y, pipe_axis, ring_fwd)
+            vin_b_next = jax.lax.ppermute(dx_m, pipe_axis, ring_bwd)
+            return (vin_f_next, vin_b_next, inbox_f, inbox_b, stash,
+                    g_chunks, g_head, d_micro, loss_sum, correct_sum), None
+
+        zeros_act = jnp.zeros_like(micro_local[0])
+        act_shape = micro_local.shape[1:]
+        carry0 = (
+            zeros_act,
+            zeros_act,
+            jnp.zeros((V,) + act_shape, micro_local.dtype),
+            jnp.zeros((V,) + act_shape, micro_local.dtype),
+            jnp.zeros((S,) + act_shape, micro_local.dtype),
+            jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape[1:], jnp.float32), params_st),
+            jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, jnp.float32), head_p),
+            jnp.zeros(micro_local.shape, jnp.float32),
+            jnp.float32(0.0),
+            jnp.float32(0.0),
+        )
+        (_, _, _, _, _, g_chunks, g_head, d_micro, loss_sum,
+         correct_sum), _ = jax.lax.scan(tick, carry0, tables)
+
+        inv_m = 1.0 / M
+        g_chunks = jax.tree_util.tree_map(lambda g: g * inv_m, g_chunks)
+        g_head = jax.tree_util.tree_map(lambda g: g * inv_m, g_head)
+        d_micro = d_micro * inv_m
+        loss = jax.lax.psum(loss_sum * inv_m, pipe_axis)
+        correct = jax.lax.psum(correct_sum, pipe_axis)
+        g_head = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, pipe_axis), g_head)
+        d_micro = jax.lax.psum(d_micro, pipe_axis)
+        if has_data:
+            loss = jax.lax.pmean(loss, data_axis)
+            correct = jax.lax.psum(correct, data_axis)
+            g_chunks = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axis), g_chunks)
+            g_head = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axis), g_head)
+            d_micro = d_micro / data_size
+        g_chunks = jax.tree_util.tree_map(lambda g: g[None], g_chunks)
+        return loss, correct, g_chunks, g_head, d_micro
+
+    micro_spec = P(None, data_axis if has_data else None)
+    act_spec = P(*(micro_spec + (None,) * (micro.ndim - 2)))
+    tok_spec = P(*(micro_spec + (None,) * (micro_tok.ndim - 2)))
+    # device-major [P*V, ...] → shard leading axis over pipe: device p owns
+    # rows p·V..p·V+V-1 = its V chunks; inside the body the leading [1]
+    # block is dropped and re-added, so leaves are [V, ...] per device.
+    pv_spec = jax.tree_util.tree_map(lambda _: P(pipe_axis), chunk_params)
+    rep = jax.tree_util.tree_map(lambda _: P(), head_params)
+    # reshape [P*V, ...] → [P, V, ...] so shard_map's leading-axis split
+    # hands each device exactly its [1, V, ...] block.
+    stacked = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_stages, V) + a.shape[1:]), chunk_params)
+    loss, correct, g_chunks, g_head, d_micro = jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(pv_spec, rep, act_spec, tok_spec),
+        out_specs=(P(), P(), pv_spec, rep, act_spec),
+        check_vma=False,
+    )(stacked, head_params, micro, micro_tok)
+    g_chunks = jax.tree_util.tree_map(
+        lambda a: a.reshape((n_stages * V,) + a.shape[2:]), g_chunks)
+    count = jnp.float32(tokens.shape[0] * (tokens.shape[1] - 1))
+    dx = d_micro.reshape(x.shape)
+    return loss, correct, count, g_chunks, g_head, dx
+
+
+def interleave_order(n_stages: int, n_virtual: int) -> np.ndarray:
+    """Permutation taking natural chunk order c = 0..C-1 to the
+    device-major layout this module consumes: position p·V + k ← chunk
+    k·P + p.  ``chunk_params_dm = tree_map(lambda a: a[perm], natural)``."""
+    P_, V = n_stages, n_virtual
+    return np.asarray([k * P_ + p for p in range(P_) for k in range(V)],
+                      np.int32)
+
+
+def deinterleave_order(n_stages: int, n_virtual: int) -> np.ndarray:
+    """Inverse permutation: natural[c] = device_major[inv[c]]."""
+    perm = interleave_order(n_stages, n_virtual)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size, dtype=np.int32)
+    return inv

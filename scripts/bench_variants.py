@@ -52,8 +52,10 @@ def main():
         (False, True))
     only = sys.argv[1:] or None
     for batch, stem, in_dtype, fused in combos:
+        # Every tag carries a terminal fused-axis token so neither variant's
+        # tag is a substring of the other (precise selection stays possible).
         tag = (f"b{batch}-{stem}-{np.dtype(in_dtype).name}"
-               + ("-fusedconvbn" if fused else ""))
+               + ("-fusedconvbn" if fused else "-unfused"))
         if only and not any(o in tag for o in only):
             continue
         model = models.create_model(

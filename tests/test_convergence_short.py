@@ -36,11 +36,15 @@ def test_hard_oracle_miniature(tmp_path):
         curves[name] = ch.run_config(root, str(tmp_path), name, precision,
                                      1, False)
     for name, curve in curves.items():
-        # 2 epochs only in CI: above 2× chance = learning; the committed
-        # full run (RESULTS_convergence_hard.json) shows the real curve.
-        assert curve[-1] > 2 * 100.0 / ch.CLASSES, (name, curve)
+        # 2 epochs only in CI: ≥3× chance = learning; the committed full
+        # run (RESULTS_convergence_hard.json) shows the real curve.
+        assert curve[-1] > 3 * 100.0 / ch.CLASSES, (name, curve)
         assert curve[-1] < 97.0, (name, curve)  # doesn't saturate
-    assert abs(curves["fp32"][-1] - curves["bf16"][-1]) <= 15.0, curves
+    # Full-size round-4 curves put fp32/bf16 within 0.4 points at this
+    # epoch; 8 allows the miniature's small-sample noise while remaining
+    # falsifiable (the old ≤15 at ~12% values was near-vacuous —
+    # VERDICT r3 weak #3).
+    assert abs(curves["fp32"][-1] - curves["bf16"][-1]) <= 8.0, curves
 
 
 def test_lm_text_miniature(tmp_path):

@@ -1,0 +1,221 @@
+"""Interleaved (virtual-stage) 1F1B: schedule invariants + numerics parity.
+
+The schedule simulator is a pure host function, so its hazardous part —
+the tick mapping — is tested standalone; the pipeline function is then
+checked for exact loss/grad parity against a sequential (no-pipeline)
+oracle, the same blind-testable pattern pp_1f1b used.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+from pytorch_distributed_tpu.parallel.pp_interleaved import (
+    deinterleave_order,
+    interleave_order,
+    interleaved_pipeline_loss_and_grads,
+    simulate_interleaved_schedule,
+)
+
+
+@pytest.mark.parametrize("P_,V,M", [(2, 2, 4), (4, 2, 8), (4, 3, 4),
+                                    (8, 2, 8), (4, 1, 8)])
+def test_schedule_invariants(P_, V, M):
+    s = simulate_interleaved_schedule(P_, V, M)
+    C = P_ * V
+    # Exactly-once execution of every (chunk, micro) in both directions,
+    # correct device placement, stash slots within the reported bound.
+    fwd_ticks, bwd_ticks = {}, {}
+    for t in range(s.T):
+        for d in range(P_):
+            if s.f_active[t, d]:
+                c = s.f_k[t, d] * P_ + d
+                key = (int(c), int(s.f_m[t, d]))
+                assert key not in fwd_ticks, key
+                fwd_ticks[key] = t
+                assert s.f_slot[t, d] < s.S
+            if s.b_active[t, d]:
+                c = s.b_k[t, d] * P_ + d
+                key = (int(c), int(s.b_m[t, d]))
+                assert key not in bwd_ticks, key
+                bwd_ticks[key] = t
+    assert len(fwd_ticks) == C * M
+    assert len(bwd_ticks) == C * M
+    for (c, m), t in fwd_ticks.items():
+        # producer→consumer needs the 1-tick hop (same-device head seed
+        # for the last chunk's backward may be same-tick).
+        if c > 0:
+            assert fwd_ticks[(c - 1, m)] + 1 <= t, (c, m)
+        assert bwd_ticks[(c, m)] >= t
+        if c < C - 1:
+            assert bwd_ticks[(c, m)] >= bwd_ticks[(c + 1, m)] + 1
+    # One hop channel each way: at most one F and one B per device-tick
+    # is structural (table has one slot); verify the schedule beats plain
+    # sequential depth and the stash stays near the analytic bound.
+    assert s.T < 2 * C * M  # pipelining actually happens
+    assert s.S <= 2 * C + M
+
+
+@pytest.mark.parametrize("P_,V,M", [(2, 2, 4), (4, 2, 8), (8, 2, 8),
+                                    (4, 3, 4), (2, 4, 8), (8, 2, 16),
+                                    (4, 4, 8)])
+def test_schedule_symbolic_replay(P_, V, M):
+    """Replay the tick tables with the COMPILED BODY's exact semantics
+    (land-at-start, F phase before B phase, one vin carry per direction,
+    head seed written in the F phase) using symbolic value tags — every
+    read must see exactly the (chunk, microbatch) value the math needs.
+    This is the guard that caught the round-4 seed-overwrite hazard."""
+    s = simulate_interleaved_schedule(P_, V, M)
+    C = P_ * V
+    NONE = ("none",)
+    vin_f = [NONE] * P_
+    vin_b = [NONE] * P_
+    inbox_f = [[NONE] * V for _ in range(P_)]
+    inbox_b = [[NONE] * V for _ in range(P_)]
+    stash = [[NONE] * s.S for _ in range(P_)]
+    for t in range(s.T):
+        for d in range(P_):
+            if s.rf_active[t, d]:
+                inbox_f[d][s.rf_k[t, d]] = vin_f[d]
+            if s.rb_active[t, d]:
+                inbox_b[d][s.rb_k[t, d]] = vin_b[d]
+        sent_f = [NONE] * P_
+        sent_b = [NONE] * P_
+        for d in range(P_):  # F phase
+            if s.f_active[t, d]:
+                fk, fm = s.f_k[t, d], s.f_m[t, d]
+                c = fk * P_ + d
+                x_in = ("feed", fm) if c == 0 else inbox_f[d][fk]
+                want = ("feed", fm) if c == 0 else ("out", c - 1, fm)
+                assert x_in == want, (t, d, "F", c, fm, x_in)
+                stash[d][s.f_slot[t, d]] = ("in", c, fm)
+                sent_f[d] = ("out", c, fm)
+                if c == C - 1:
+                    inbox_b[d][V - 1] = ("dy", C - 1, fm)
+            else:
+                sent_f[d] = ("garbage", t, d)
+        for d in range(P_):  # B phase
+            if s.b_active[t, d]:
+                bk, bm = s.b_k[t, d], s.b_m[t, d]
+                c = bk * P_ + d
+                assert stash[d][s.b_slot[t, d]] == ("in", c, bm), (t, d, c)
+                assert inbox_b[d][bk] == ("dy", c, bm), (t, d, c, bm,
+                                                        inbox_b[d][bk])
+                sent_b[d] = (("dy", c - 1, bm) if c > 0
+                             else ("dmicro", bm))
+            else:
+                sent_b[d] = ("garbageB", t, d)
+        vin_f = [sent_f[(d - 1) % P_] for d in range(P_)]
+        vin_b = [sent_b[(d + 1) % P_] for d in range(P_)]
+
+
+def test_schedule_stash_reported():
+    s = simulate_interleaved_schedule(4, 2, 8)
+    # The interleave trades bubble for stash: bound must be > plain-1F1B's
+    # 2(P-1)+1 = 7 but far below GPipe's M*V = 16 per-chunk stashes.
+    assert 7 <= s.S <= 16, s.S
+
+
+def _toy(P_, V, d_model=8, mb=2, M=4, seed=0):
+    """Toy chunk stack: C linear+tanh chunks, CE-ish quadratic head."""
+    C = P_ * V
+    rng = np.random.default_rng(seed)
+    chunk_params = {
+        "w": jnp.asarray(rng.normal(size=(C, d_model, d_model), scale=0.5)
+                         .astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(C, d_model)).astype(np.float32)
+                         * 0.1),
+    }
+    head = {"h": jnp.asarray(rng.normal(size=(d_model,)).astype(np.float32))}
+    B = M * mb
+    x = jnp.asarray(rng.normal(size=(B, 4, d_model)).astype(np.float32))
+    tokens = jnp.asarray(rng.integers(0, 5, size=(B, 4)).astype(np.int32))
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def head_fn(hp, y, tok):
+        # differentiable scalar + a "correct count" aux
+        z = jnp.sum((y * hp["h"]) ** 2) / y.shape[0]
+        correct = jnp.sum(tok).astype(jnp.float32)
+        return z, correct
+
+    return chunk_params, head, x, tokens, stage_fn, head_fn
+
+
+def _sequential_oracle(chunk_params, head, x, tokens, stage_fn, head_fn, M):
+    """Mean-over-microbatches loss + autodiff grads, no pipeline."""
+    loss, grads = jax.value_and_grad(
+        lambda cp, hp: loss_fn_with_x(cp, hp, x, tokens, stage_fn,
+                                      head_fn, M),
+        argnums=(0, 1))(chunk_params, head)
+    dx = jax.grad(
+        lambda xx: loss_fn_with_x(chunk_params, head, xx, tokens,
+                                  stage_fn, head_fn, M))(x)
+    return loss, grads[0], grads[1], dx
+
+
+def loss_fn_with_x(cp, hp, x, tokens, stage_fn, head_fn, M):
+    C = cp["w"].shape[0]
+    mb = x.shape[0] // M
+    total = 0.0
+    for m in range(M):
+        y = x[m * mb:(m + 1) * mb]
+        for c in range(C):
+            y = stage_fn({"w": cp["w"][c], "b": cp["b"][c]}, y)
+        z, _ = head_fn(hp, y, tokens[m * mb:(m + 1) * mb])
+        total = total + z
+    return total / M
+
+
+@pytest.mark.parametrize("P_,V,M", [(4, 2, 8), (2, 2, 4), (4, 1, 4)])
+def test_interleaved_matches_sequential(P_, V, M):
+    mesh = build_mesh(MeshSpec(("pipe",), (P_,)), jax.devices()[:P_])
+    chunk_params, head, x, tokens, stage_fn, head_fn = _toy(P_, V, M=M)
+    want_loss, want_gc, want_gh, want_dx = _sequential_oracle(
+        chunk_params, head, x, tokens, stage_fn, head_fn, M)
+
+    perm = interleave_order(P_, V)
+    dm_params = jax.tree_util.tree_map(lambda a: a[perm], chunk_params)
+    loss, correct, count, g_dm, g_head, dx = (
+        interleaved_pipeline_loss_and_grads(
+            stage_fn, head_fn, dm_params, head, x, tokens, M, V, mesh))
+    # device-major → natural: dm[i] = nat[perm[i]] ⇒ nat = dm[inv].
+    inv = deinterleave_order(P_, V)
+    g_nat = jax.tree_util.tree_map(lambda a: a[inv], g_dm)
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(g_nat[k]),
+                                   np.asarray(want_gc[k]),
+                                   rtol=2e-4, atol=1e-5, err_msg=k)
+    np.testing.assert_allclose(np.asarray(g_head["h"]),
+                               np.asarray(want_gh["h"]),
+                               rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                               rtol=2e-4, atol=1e-5)
+    assert float(count) == x.shape[0] * (tokens.shape[1] - 1)
+
+
+def test_interleaved_composes_with_data_axis():
+    """(data 2, pipe 4) mesh: the microbatch batch dim sharded over data."""
+    P_, V, M = 4, 2, 4
+    mesh = build_mesh(MeshSpec(("data", "pipe"), (2, P_)),
+                      jax.devices()[:2 * P_])
+    chunk_params, head, x, tokens, stage_fn, head_fn = _toy(
+        P_, V, M=M, mb=2)
+    want_loss, want_gc, _, _ = _sequential_oracle(
+        chunk_params, head, x, tokens, stage_fn, head_fn, M)
+    perm = interleave_order(P_, V)
+    dm_params = jax.tree_util.tree_map(lambda a: a[perm], chunk_params)
+    loss, _, _, g_dm, _, _ = interleaved_pipeline_loss_and_grads(
+        stage_fn, head_fn, dm_params, head, x, tokens, M, V, mesh)
+    inv = deinterleave_order(P_, V)
+    g_nat = jax.tree_util.tree_map(lambda a: a[inv], g_dm)
+    np.testing.assert_allclose(float(loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(g_nat["w"]),
+                               np.asarray(want_gc["w"]),
+                               rtol=2e-4, atol=1e-5)
