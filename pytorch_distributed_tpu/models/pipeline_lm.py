@@ -66,6 +66,7 @@ class PipelinedTransformerLM:
         seq_axis: str = "seq",
         schedule: str = "gpipe",
         remat: bool = False,
+        n_virtual: int = 1,
     ):
         """``tp_size > 1``: Megatron tensor parallelism INSIDE each stage
         (``parallel/tp_stage.py`` — explicit psums under the pipeline's
@@ -74,18 +75,42 @@ class PipelinedTransformerLM:
         ``seq_axis`` (composable with ``tp_size``).
 
         ``schedule``: ``"gpipe"`` (autodiff through the forward pipeline,
-        activation stash O(M)) or ``"1f1b"`` (interleaved manual-gradient
-        schedule, stash bounded at 2(P-1)+1 stage-inputs — see
-        ``parallel/pp_1f1b.py``); ``remat=True`` checkpoints each stage under
-        the gpipe schedule (1f1b rematerializes by construction)."""
-        if schedule not in ("gpipe", "1f1b"):
+        activation stash O(M)), ``"1f1b"`` (manual-gradient schedule, stash
+        bounded at 2(P-1)+1 stage-inputs — ``parallel/pp_1f1b.py``), or
+        ``"interleaved"`` (virtual-stage 1F1B: ``n_virtual`` chunks per
+        device cut the bubble from (P-1)/M to (P-1)/(M·V) at V× the
+        bounded stash — ``parallel/pp_interleaved.py``; requires
+        ``n_microbatches % n_stages == 0``).  ``remat=True`` checkpoints
+        each stage under the gpipe schedule (the manual schedules
+        rematerialize by construction).
+
+        Layout note: under ``interleaved`` the stacked ``stages`` leaves
+        hold C = P·V chunk slices in DEVICE-MAJOR order (position
+        p·V + k = chunk k·P + p), so the standard leading-axis
+        ``P('pipe')`` sharding lands each device's V chunks locally;
+        checkpoints are therefore specific to (P, V) like they already
+        are to the stage count."""
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(f"unknown schedule {schedule!r}")
-        if schedule == "1f1b" and (tp_size > 1 or sp_size > 1):
+        if schedule in ("1f1b", "interleaved") and (tp_size > 1 or sp_size > 1):
             raise ValueError(
-                "schedule='1f1b' currently supports plain stages "
+                f"schedule={schedule!r} currently supports plain stages "
                 "(tp_size == sp_size == 1); use gpipe for TP/SP-in-stage"
             )
-        if n_layers % n_stages:
+        self.n_virtual = n_virtual
+        if n_virtual < 1 or (n_virtual > 1 and schedule != "interleaved"):
+            raise ValueError(
+                "n_virtual > 1 requires schedule='interleaved'")
+        if schedule == "interleaved":
+            if n_microbatches % n_stages:
+                raise ValueError(
+                    f"interleaved schedule needs n_microbatches "
+                    f"{n_microbatches} divisible by n_stages {n_stages}")
+            if n_layers % (n_stages * n_virtual):
+                raise ValueError(
+                    f"n_layers {n_layers} not divisible by n_stages × "
+                    f"n_virtual = {n_stages * n_virtual}")
+        elif n_layers % n_stages:
             raise ValueError(
                 f"n_layers {n_layers} not divisible by n_stages {n_stages}"
             )
@@ -125,7 +150,8 @@ class PipelinedTransformerLM:
         self.pipe_axis = pipe_axis
         self.tp_size = tp_size
         self.model_axis = model_axis
-        self.n_blocks = n_layers // n_stages
+        self.n_chunks = n_stages * n_virtual  # C (= n_stages unless interleaved)
+        self.n_blocks = n_layers // self.n_chunks
         self._embed = nn.Embed(vocab_size, d_model, dtype=dtype, name="embed")
         self._ln_f = nn.LayerNorm(dtype=jnp.float32, name="ln_f")
         self._stage = _Stage(
@@ -149,7 +175,17 @@ class PipelinedTransformerLM:
         else:
             stage_p = jax.vmap(
                 lambda r: self._stage.init(r, x0)["params"]
-            )(jax.random.split(r_stage, self.n_stages))
+            )(jax.random.split(r_stage, self.n_chunks))
+            if self.n_virtual > 1:
+                # natural depth order → device-major chunk layout (see
+                # the constructor's layout note).
+                from pytorch_distributed_tpu.parallel.pp_interleaved import (
+                    interleave_order,
+                )
+
+                perm = interleave_order(self.n_stages, self.n_virtual)
+                stage_p = jax.tree_util.tree_map(
+                    lambda a: a[perm], stage_p)
         ln_p = self._ln_f.init(r_ln, x0.astype(jnp.float32))["params"]
         return {"params": {"embed": embed_p, "stages": stage_p, "ln_f": ln_p}}
 
@@ -178,9 +214,9 @@ class PipelinedTransformerLM:
 
     def has_manual_grads(self) -> bool:
         """``make_lm_train_step`` calls ``loss_and_grads`` instead of
-        ``jax.value_and_grad`` when this returns True (the 1F1B schedule
-        computes gradients inside its own interleaved scan)."""
-        return self.schedule == "1f1b"
+        ``jax.value_and_grad`` when this returns True (the 1F1B-family
+        schedules compute gradients inside their own scans)."""
+        return self.schedule in ("1f1b", "interleaved")
 
     def loss_and_grads(self, params, tokens: jnp.ndarray):
         """``((loss, acc%), grads)`` via the 1F1B schedule — the signature
@@ -211,13 +247,27 @@ class PipelinedTransformerLM:
             return loss, correct
 
         stage_fn = lambda sp, xb: self._stage.apply({"params": sp}, xb)
-        loss, correct, count, g_stage, g_head, dx = (
-            pipeline_1f1b_loss_and_grads(
-                stage_fn, head_fn, params["stages"],
-                {"ln_f": ln_p, "embed": embed_p}, x, tokens,
-                self.n_microbatches, self.mesh, pipe_axis=self.pipe_axis,
+        if self.schedule == "interleaved":
+            from pytorch_distributed_tpu.parallel.pp_interleaved import (
+                interleaved_pipeline_loss_and_grads,
             )
-        )
+
+            loss, correct, count, g_stage, g_head, dx = (
+                interleaved_pipeline_loss_and_grads(
+                    stage_fn, head_fn, params["stages"],
+                    {"ln_f": ln_p, "embed": embed_p}, x, tokens,
+                    self.n_microbatches, self.n_virtual, self.mesh,
+                    pipe_axis=self.pipe_axis,
+                )
+            )
+        else:
+            loss, correct, count, g_stage, g_head, dx = (
+                pipeline_1f1b_loss_and_grads(
+                    stage_fn, head_fn, params["stages"],
+                    {"ln_f": ln_p, "embed": embed_p}, x, tokens,
+                    self.n_microbatches, self.mesh, pipe_axis=self.pipe_axis,
+                )
+            )
         (g_embed_in,) = embed_vjp(dx.astype(x.dtype))
         g_embed = jax.tree_util.tree_map(
             lambda a, b: a.astype(jnp.float32) + b.astype(jnp.float32),
@@ -230,14 +280,32 @@ class PipelinedTransformerLM:
               train: bool = True):
         p = variables["params"]
         x = self._embed.apply({"params": p["embed"]}, tokens)
-        x = pipeline_apply(
-            self._stage_fn(),
-            p["stages"], x, self.n_microbatches, self.mesh,
-            pipe_axis=self.pipe_axis,
-            stage_param_specs=self._stage_specs(),
-            seq_axis=self.seq_axis if self.sp_size > 1 else None,
-            remat=self.remat,
-        )
+        if self.n_virtual > 1:
+            # Forward-only path (eval/generation): run the C chunks
+            # sequentially in natural depth order — chunk k·P + p sits at
+            # device-major position p·V + k.  Static indexing; GSPMD
+            # fetches each chunk's slice where needed.  The bubble-free
+            # interleaved schedule matters for the TRAIN step
+            # (loss_and_grads); eval is forward-only and memory-light.
+            from pytorch_distributed_tpu.parallel.pp_interleaved import (
+                deinterleave_order,
+            )
+
+            # natural chunk c sits at device-major position inv[c]
+            inv = deinterleave_order(self.n_stages, self.n_virtual)
+            for c in range(self.n_chunks):
+                chunk = jax.tree_util.tree_map(
+                    lambda a, i=int(inv[c]): a[i], p["stages"])
+                x = self._stage.apply({"params": chunk}, x)
+        else:
+            x = pipeline_apply(
+                self._stage_fn(),
+                p["stages"], x, self.n_microbatches, self.mesh,
+                pipe_axis=self.pipe_axis,
+                stage_param_specs=self._stage_specs(),
+                seq_axis=self.seq_axis if self.sp_size > 1 else None,
+                remat=self.remat,
+            )
         x = self._ln_f.apply({"params": p["ln_f"]}, x.astype(jnp.float32))
         logits = self._embed.apply(
             {"params": p["embed"]}, x.astype(jnp.float32),

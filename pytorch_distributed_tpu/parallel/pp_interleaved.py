@@ -27,10 +27,12 @@ Runtime structure mirrors pp_1f1b: manual gradients inside one scan,
 remat), the loss head runs on the last chunk's device in the tick its
 forward retires and seeds that chunk's backward through a local inbox.
 
-Scope note (round 4): the schedule + pipeline function + parity tests
-vs the sequential oracle; wiring into ``models/pipeline_lm.py``'s model
-class is round-5 work.  Beyond-reference capability (SURVEY.md §2.3:
-pipeline parallelism is "explicitly absent" from the reference).
+Wired end to end: ``models/pipeline_lm.py`` dispatches here under
+``schedule="interleaved"`` (device-major chunk layout) and the
+lm_pretrain recipe exposes ``--schedule interleaved --pp-virtual V``;
+``--fsdp`` composes through the same boundary gather as 1F1B.
+Beyond-reference capability (SURVEY.md §2.3: pipeline parallelism is
+"explicitly absent" from the reference).
 """
 
 from __future__ import annotations

@@ -87,10 +87,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "--sp — Megatron TP / ring SP run inside each stage)")
     p.add_argument("--microbatches", type=int, default=0,
                    help="pipeline microbatches (default: pp)")
-    p.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
-                   help="pipeline schedule: gpipe (autodiff, stash O(M)) or "
-                        "1f1b (interleaved manual gradients, stash bounded "
-                        "at 2(pp-1)+1 microbatches — parallel/pp_1f1b.py)")
+    p.add_argument("--schedule", choices=("gpipe", "1f1b", "interleaved"),
+                   default="gpipe",
+                   help="pipeline schedule: gpipe (autodiff, stash O(M)); "
+                        "1f1b (manual gradients, stash bounded at 2(pp-1)+1 "
+                        "microbatches — parallel/pp_1f1b.py); interleaved "
+                        "(virtual-stage 1f1b, --pp-virtual chunks/device: "
+                        "bubble/(V) at V x stash — parallel/pp_interleaved.py)")
+    p.add_argument("--pp-virtual", type=int, default=2, dest="pp_virtual",
+                   help="model chunks per device under --schedule "
+                        "interleaved (V; n-layers must divide by pp*V)")
     p.add_argument("--remat", action="store_true",
                    help="checkpoint each pipeline stage (gpipe schedule): "
                         "stash stage inputs only, recompute activations in "
@@ -135,11 +141,20 @@ def main(argv=None) -> float:
     if args.sp > 1 and args.seq_len % args.sp:
         raise SystemExit(f"--seq-len {args.seq_len} not divisible by "
                          f"--sp {args.sp}")
-    if args.schedule == "1f1b" and args.pp <= 1:
-        raise SystemExit("--schedule 1f1b requires --pp > 1")
-    if args.schedule == "1f1b" and (args.tp > 1 or args.sp > 1):
-        raise SystemExit("--schedule 1f1b supports plain stages; use gpipe "
-                         "for TP/SP-in-stage")
+    if args.schedule in ("1f1b", "interleaved") and args.pp <= 1:
+        raise SystemExit(f"--schedule {args.schedule} requires --pp > 1")
+    if args.schedule in ("1f1b", "interleaved") and (args.tp > 1
+                                                     or args.sp > 1):
+        raise SystemExit(f"--schedule {args.schedule} supports plain "
+                         "stages; use gpipe for TP/SP-in-stage")
+    if args.schedule == "interleaved":
+        micro = args.microbatches or args.pp
+        if micro % args.pp:
+            raise SystemExit(f"--schedule interleaved needs --microbatches "
+                             f"{micro} divisible by --pp {args.pp}")
+        if args.n_layers % (args.pp * args.pp_virtual):
+            raise SystemExit(f"--n-layers {args.n_layers} not divisible by "
+                             f"pp*V = {args.pp * args.pp_virtual}")
     if args.remat and args.pp <= 1:
         raise SystemExit("--remat applies to the pipeline stages "
                          "(requires --pp > 1)")
@@ -218,6 +233,8 @@ def main(argv=None) -> float:
             n_microbatches=args.microbatches or args.pp,
             mesh=mesh, dtype=dtype, tp_size=args.tp, sp_size=args.sp,
             schedule=args.schedule, remat=args.remat,
+            n_virtual=(args.pp_virtual
+                       if args.schedule == "interleaved" else 1),
         )
         specs = "pp"
     else:
