@@ -31,6 +31,34 @@ from pytorch_distributed_tpu.ops.fused_conv_bn import conv1x1_bn
 ModuleDef = Any
 
 
+def _fuse_ok(fused: bool, conv: ModuleDef, norm: ModuleDef) -> bool:
+    """Shared fold gate: only stock nn.Conv / FusedBatchNormAct semantics
+    may be replaced by the fused ops — a custom ModuleDef (or a partial
+    carrying settings the combinator doesn't forward) keeps the unfused
+    composition, or its settings would be silently dropped."""
+    if not fused:
+        return False
+    if getattr(norm, "func", norm) is not FusedBatchNormAct:
+        return False
+    if getattr(conv, "func", conv) is not nn.Conv:
+        return False
+    if set(getattr(conv, "keywords", {})) - {"dtype"}:
+        return False
+    return not (set(getattr(norm, "keywords", {}))
+                - {"use_running_average", "momentum", "epsilon"})
+
+
+def _fuse_kw(conv: ModuleDef, norm: ModuleDef) -> dict:
+    nkw = getattr(norm, "keywords", {})
+    ckw = getattr(conv, "keywords", {})
+    return dict(
+        use_running_average=bool(nkw.get("use_running_average", False)),
+        momentum=nkw.get("momentum", 0.9),
+        eps=nkw.get("epsilon", 1e-5),
+        dtype=ckw.get("dtype", jnp.float32),
+    )
+
+
 class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
@@ -39,23 +67,57 @@ class BasicBlock(nn.Module):
     base_width: int = 64
     conv: ModuleDef = nn.Conv
     norm: ModuleDef = FusedBatchNormAct
-    # Accepted for uniform construction; the basic topology has no 1x1
-    # stride-1 conv→BN pair to fold (3x3 mains; downsamples are strided),
-    # so the flag is a no-op here.
+    # Fold the stride-1 3x3 conv→BN pairs (both mains when strides == 1,
+    # the second always) through ops/fused_conv_bn's whole-plane kernel;
+    # strided slots keep the XLA backward.  Param paths identical either
+    # way (same guarantee as Bottleneck).
     fused_convbn: bool = False
 
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), (self.strides, self.strides),
-                      padding=[(1, 1), (1, 1)], use_bias=False)(x)
-        y = self.norm(relu=True)(y)
-        y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False)(y)
-        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if not _fuse_ok(self.fused_convbn, self.conv, self.norm):
+            y = self.conv(self.filters, (3, 3),
+                          (self.strides, self.strides),
+                          padding=[(1, 1), (1, 1)], use_bias=False)(x)
+            y = self.norm(relu=True)(y)
+            y = self.conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)],
+                          use_bias=False)(y)
+            y = self.norm(scale_init=nn.initializers.zeros)(y)
+            if residual.shape != y.shape:
+                residual = self.conv(self.filters * self.expansion, (1, 1),
+                                     (self.strides, self.strides),
+                                     use_bias=False)(residual)
+                residual = self.norm()(residual)
+            return nn.relu(y + residual)
+
+        fkw = _fuse_kw(self.conv, self.norm)
+        if self.strides == 1:
+            y = conv1x1_bn(self, "Conv_0", "FusedBatchNormAct_0", x,
+                           self.filters, relu=True, kernel_size=(3, 3),
+                           **fkw)
+        else:
+            y = self.conv(self.filters, (3, 3),
+                          (self.strides, self.strides),
+                          padding=[(1, 1), (1, 1)], use_bias=False,
+                          name="Conv_0")(x)
+            y = self.norm(relu=True, name="FusedBatchNormAct_0")(y)
+        y = conv1x1_bn(self, "Conv_1", "FusedBatchNormAct_1", y,
+                       self.filters, relu=False,
+                       scale_init=nn.initializers.zeros,
+                       kernel_size=(3, 3), **fkw)
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * self.expansion, (1, 1),
-                                 (self.strides, self.strides), use_bias=False)(residual)
-            residual = self.norm()(residual)
+            if self.strides == 1:
+                residual = conv1x1_bn(self, "Conv_2", "FusedBatchNormAct_2",
+                                      residual,
+                                      self.filters * self.expansion,
+                                      relu=False, **fkw)
+            else:
+                residual = self.conv(self.filters * self.expansion, (1, 1),
+                                     (self.strides, self.strides),
+                                     use_bias=False,
+                                     name="Conv_2")(residual)
+                residual = self.norm(name="FusedBatchNormAct_2")(residual)
         return nn.relu(y + residual)
 
 
@@ -73,30 +135,12 @@ class Bottleneck(nn.Module):
     # declares through child scopes), so checkpoints interchange freely.
     fused_convbn: bool = False
 
-    def _fuse_active(self) -> bool:
-        # Only fold when conv/norm really are the stock nn.Conv /
-        # FusedBatchNormAct semantics — a custom ModuleDef (or a conv
-        # partial carrying more than dtype, e.g. precision) must keep the
-        # unfused composition, or its settings would be silently dropped.
-        if not self.fused_convbn:
-            return False
-        if getattr(self.norm, "func", self.norm) is not FusedBatchNormAct:
-            return False
-        if getattr(self.conv, "func", self.conv) is not nn.Conv:
-            return False
-        if set(getattr(self.conv, "keywords", {})) - {"dtype"}:
-            return False
-        # Same rule for norm extras: anything beyond what conv1x1_bn
-        # forwards (use_running_average/momentum/epsilon) would be dropped.
-        return not (set(getattr(self.norm, "keywords", {}))
-                    - {"use_running_average", "momentum", "epsilon"})
-
     @nn.compact
     def __call__(self, x):
         residual = x
         width = int(self.filters * (self.base_width / 64.0)) * self.groups
         out_ch = self.filters * self.expansion
-        if not self._fuse_active():
+        if not _fuse_ok(self.fused_convbn, self.conv, self.norm):
             y = self.conv(width, (1, 1), use_bias=False)(x)
             y = self.norm(relu=True)(y)
             y = self.conv(width, (3, 3), (self.strides, self.strides),
@@ -116,20 +160,19 @@ class Bottleneck(nn.Module):
 
         # Fused branch: explicit child names reproduce the auto-assigned
         # paths of the branch above, slot for slot.
-        nkw = getattr(self.norm, "keywords", {})
-        ckw = getattr(self.conv, "keywords", {})
-        fkw = dict(
-            use_running_average=bool(nkw.get("use_running_average", False)),
-            momentum=nkw.get("momentum", 0.9),
-            eps=nkw.get("epsilon", 1e-5),
-            dtype=ckw.get("dtype", jnp.float32),
-        )
+        fkw = _fuse_kw(self.conv, self.norm)
         y = conv1x1_bn(self, "Conv_0", "FusedBatchNormAct_0", x, width,
                        relu=True, **fkw)
-        y = self.conv(width, (3, 3), (self.strides, self.strides),
-                      padding=[(1, 1), (1, 1)], use_bias=False,
-                      feature_group_count=self.groups, name="Conv_1")(y)
-        y = self.norm(relu=True, name="FusedBatchNormAct_1")(y)
+        if self.strides == 1 and self.groups == 1:
+            # the middle 3x3 folds too (stride-1 SAME, ungrouped)
+            y = conv1x1_bn(self, "Conv_1", "FusedBatchNormAct_1", y, width,
+                           relu=True, kernel_size=(3, 3), **fkw)
+        else:
+            y = self.conv(width, (3, 3), (self.strides, self.strides),
+                          padding=[(1, 1), (1, 1)], use_bias=False,
+                          feature_group_count=self.groups,
+                          name="Conv_1")(y)
+            y = self.norm(relu=True, name="FusedBatchNormAct_1")(y)
         y = conv1x1_bn(self, "Conv_2", "FusedBatchNormAct_2", y, out_ch,
                        relu=False, scale_init=nn.initializers.zeros, **fkw)
         if residual.shape != y.shape:

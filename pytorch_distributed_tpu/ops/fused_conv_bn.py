@@ -13,16 +13,23 @@ dy is written, then read twice more:
     this kernel: reduce(y,do) + fused[dy in VMEM → dgrad+wgrad]
                  ≈ 6 tensor-passes — dy never exists in HBM
 
-For the 1×1 stride-1 convolutions (2-3 of the 4 convs in every ResNet-50
-bottleneck) the conv is exactly a matmul over channels, so the fold is a
-single Pallas kernel: per M-tile (M = N·H·W rows), recompute the ReLU mask
-and dy in VMEM from (y, do) and per-channel vectors, then
+For the 1×1 stride-1 convolutions the conv is exactly a matmul over
+channels, so the fold is a single Pallas kernel: per M-tile (M = N·H·W
+rows), recompute the ReLU mask and dy in VMEM from (y, do) and per-channel
+vectors, then
 
     da(tile)  = dy @ Wᵀ                       (MXU)
     dW       += aᵀ @ dy     (f32 accumulator, written at the last grid step)
 
-reading y, do, a from HBM exactly once each.  3×3 / strided / grouped convs
-keep the plain XLA backward (see ``models/resnet.py`` for slot selection).
+reading y, do, a from HBM exactly once each.  The 3×3 stride-1 SAME conv
+(the bottleneck's middle conv) folds the same way with per-IMAGE tiling —
+every ResNet-50 3×3 plane fits VMEM whole, so dgrad/wgrad become 9
+shifted matmuls each off the in-VMEM dy with no halo exchange
+(``_bwd3_kernel``).  Together that folds every conv of a stride-1
+bottleneck whose plane passes the VMEM guard below (ResNet-50 bf16:
+stages 1-3; the 512-wide 7×7 stage declines — its W + f32 dW alone are
+~14 MiB) plus the 1×1s of strided blocks; strided / grouped / oversized
+slots keep the plain XLA backward (``models/resnet.py`` selects).
 
 Forward is unchanged XLA (conv + the one-pass BN+ReLU of ops/fused_bn.py) —
 forward fusion is something XLA already does well; the backward pass is where
@@ -145,17 +152,102 @@ def _fused_dgrad_wgrad(y, do, a, w, s, t, u, v, relu: bool, interpret: bool
     return da2[:M].reshape(a.shape), dw
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def conv1x1_bn_act(a, w, gamma, beta, eps: float, relu: bool,
-                   interpret: Optional[bool] = None):
-    """``(o, mu, var) = BN+ReLU(conv1x1(a, w))`` with the fused backward.
-
-    ``a``: NHWC activations; ``w``: [1, 1, Ci, Co] (HWIO) f32 params cast to
-    ``a.dtype`` for compute, like ``nn.Conv(dtype=...)``.  mu/var are exposed
-    for the EMA update (stop-gradiented by the caller, like ops/fused_bn).
+def _bwd3_kernel(y_ref, do_ref, a_ref, w_ref, vec_ref, da_ref, dw_ref,
+                 *, relu: bool, cdt, H: int, Wd: int):
+    """One image (grid over N): dy for the full [H, W, Co] plane in VMEM,
+    then the 3x3 dgrad and wgrad as 9 shifted matmuls each — the same
+    one-read-per-tensor economics as the 1x1 kernel, with the halo problem
+    dissolved by whole-plane tiling (every ResNet-50 3x3 plane fits VMEM;
+    56x56x64 bf16 is ~400 KB, 7x7x512 is ~50 KB).
     """
-    (o, mu, var), _ = _conv1x1_bn_fwd(a, w, gamma, beta, eps, relu, interpret)
-    return o, mu, var
+    n = pl.program_id(0)
+    Co = y_ref.shape[-1]
+    Ci = a_ref.shape[-1]
+    yf = y_ref[0].astype(jnp.float32)                    # [H, W, Co]
+    dof = do_ref[0].astype(jnp.float32)
+    s = vec_ref[0:1, :].reshape(1, 1, Co)
+    t = vec_ref[1:2, :].reshape(1, 1, Co)
+    u = vec_ref[2:3, :].reshape(1, 1, Co)
+    if relu:
+        v = vec_ref[3:4, :].reshape(1, 1, Co)
+        dof = jnp.where(yf * s + v > 0, dof, 0.0)
+    dy = (dof * s + yf * t + u).astype(cdt)              # [H, W, Co]
+    af = a_ref[0].astype(cdt)                            # [H, W, Ci]
+    # Zero-pad once; every (kh, kw) tap is then a static slice.
+    dyp = jnp.pad(dy, ((1, 1), (1, 1), (0, 0)))
+    ap = jnp.pad(af, ((1, 1), (1, 1), (0, 0)))
+    dx = jnp.zeros((H * Wd, Ci), jnp.float32)
+    for kh in range(3):
+        for kw in range(3):
+            # dgrad: dx[p,q] += dy[p-kh+1, q-kw+1] @ W[kh,kw]^T
+            sh = dyp[2 - kh:2 - kh + H, 2 - kw:2 - kw + Wd, :]
+            dx = dx + jax.lax.dot_general(
+                sh.reshape(H * Wd, Co), w_ref[kh, kw],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # wgrad: dW[kh,kw] += a[h+kh-1, w+kw-1]^T @ dy[h, w]
+            sa = ap[kh:kh + H, kw:kw + Wd, :]
+            contrib = jax.lax.dot_general(
+                sa.reshape(H * Wd, Ci), dy.reshape(H * Wd, Co),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+            @pl.when(n == 0)
+            def _():
+                dw_ref[kh, kw] = contrib
+
+            @pl.when(n > 0)
+            def _():
+                dw_ref[kh, kw] = dw_ref[kh, kw] + contrib
+    da_ref[0] = dx.reshape(H, Wd, Ci).astype(da_ref.dtype)
+
+
+def _fused_dgrad_wgrad_3x3(y, do, a, w, s, t, u, v, relu: bool,
+                           interpret: bool):
+    """da, dW for the 3x3 stride-1 SAME conv whose output fed BN.
+
+    Shapes: y/do [N, H, W, Co], a [N, H, W, Ci], w [3, 3, Ci, Co]."""
+    N, H, Wd, Co = y.shape
+    Ci = a.shape[-1]
+    cdt = a.dtype
+    vec = jnp.stack([s, t, u, v]).astype(jnp.float32)
+    da, dw = pl.pallas_call(
+        functools.partial(_bwd3_kernel, relu=relu, cdt=cdt, H=H, Wd=Wd),
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, H, Wd, Co), lambda n: (n, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, H, Wd, Co), lambda n: (n, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, H, Wd, Ci), lambda n: (n, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 3, Ci, Co), lambda n: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, Co), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, Wd, Ci), lambda n: (n, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, 3, Ci, Co), lambda n: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, H, Wd, Ci), cdt),
+            jax.ShapeDtypeStruct((3, 3, Ci, Co), jnp.float32),
+        ],
+        interpret=interpret,
+    )(y, do, a, w.astype(cdt), vec)
+    return da, dw
+
+
+def _conv3x3(a, w):
+    return jax.lax.conv_general_dilated(
+        a, w.astype(a.dtype), (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
 
 
 def _conv1x1(a, w):
@@ -165,17 +257,88 @@ def _conv1x1(a, w):
     )
 
 
-def _conv1x1_bn_fwd(a, w, gamma, beta, eps, relu, interpret):
-    y = _conv1x1(a, w)
-    (o, mu, var), (y_res, mu_res, inv, g_res, b_res) = _bn_act_fwd(
-        y, gamma, beta, eps, relu
-    )
-    return (o, mu, var), (a, w, y_res, mu_res, inv, g_res, b_res)
+def _make_conv_bn_op(conv_fwd, dgrad_wgrad, doc: str):
+    """Build a ``(o, mu, var) = BN+ReLU(conv(a, w))`` custom-VJP op from a
+    forward conv primitive and a fused dgrad+wgrad backward — one
+    residual-packing / cotangent-unpacking implementation for both kernel
+    shapes."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+    def op(a, w, gamma, beta, eps: float, relu: bool,
+           interpret: Optional[bool] = None):
+        (o, mu, var), _ = fwd(a, w, gamma, beta, eps, relu, interpret)
+        return o, mu, var
+
+    def fwd(a, w, gamma, beta, eps, relu, interpret):
+        y = conv_fwd(a, w)
+        (o, mu, var), (y_res, mu_res, inv, g_res, b_res) = _bn_act_fwd(
+            y, gamma, beta, eps, relu
+        )
+        return (o, mu, var), (a, w, y_res, mu_res, inv, g_res, b_res)
+
+    def bwd(eps, relu, interpret, res, cts):
+        a, w, y, mu, inv, gamma, beta = res
+        do = cts[0]  # mu/var cotangents are zero (EMA is stop-grad)
+        s, t, u, v, dgamma, dbeta = _bn_bwd_vectors(y, do, mu, inv, gamma,
+                                                    beta, relu)
+        da, dw = dgrad_wgrad(y, do, a, w, s, t, u, v, relu,
+                             _resolve_interpret(interpret))
+        return (da.astype(a.dtype), dw.reshape(w.shape).astype(w.dtype),
+                dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype))
+
+    op.defvjp(fwd, bwd)
+    op.__doc__ = doc
+    return op
 
 
-def _conv1x1_bn_bwd(eps, relu, interpret, res, cts):
-    a, w, y, mu, inv, gamma, beta = res
-    do = cts[0]  # mu/var cotangents are zero (EMA is stop-grad)
+conv1x1_bn_act = _make_conv_bn_op(
+    _conv1x1,
+    lambda y, do, a, w, *r: _fused_dgrad_wgrad(
+        y, do, a, w.reshape(w.shape[-2], w.shape[-1]), *r),
+    doc="""``(o, mu, var) = BN+ReLU(conv1x1(a, w))`` with the fused backward.
+
+    ``a``: NHWC activations; ``w``: [1, 1, Ci, Co] (HWIO) f32 params cast to
+    ``a.dtype`` for compute, like ``nn.Conv(dtype=...)``.  mu/var are exposed
+    for the EMA update (stop-gradiented by the caller, like ops/fused_bn).
+    """,
+)
+
+conv3x3_bn_act = _make_conv_bn_op(
+    _conv3x3,
+    _fused_dgrad_wgrad_3x3,
+    doc="""``(o, mu, var) = BN+ReLU(conv3x3_s1_SAME(a, w))`` with the fused
+    backward — the 3x3 counterpart of ``conv1x1_bn_act`` (the bottleneck's
+    middle conv when stride 1 and ungrouped).""",
+)
+
+
+def conv3x3_plane_fits_vmem(h: int, w_: int, ci: int, co: int,
+                            itemsize: int, budget: int = 12 << 20) -> bool:
+    """Conservative per-grid-step working-set estimate for ``_bwd3_kernel``
+    (blocks + padded copies + f32 accumulators + weights and the f32 dW):
+    whole-plane tiling only engages when it fits comfortably; otherwise the
+    caller keeps the unfused XLA backward for that slot (e.g. wide-resnet
+    f32 stage-1 planes)."""
+    hw = (h + 2) * (w_ + 2)
+    # planes (y/do/a/da blocks + f32 dy intermediates + padded copies) +
+    # the grid-constant weights and f32 dW accumulator (not
+    # double-buffered).  Conservative: at ResNet-50's 512-wide 7x7 stage
+    # the 14 MiB of W+dW alone make the fit marginal, so that slot
+    # declines too (a Co-split grid axis would recover it — future work).
+    est = (hw * (12 * co + 8 * ci + 3 * itemsize * (ci + co))
+           + 9 * ci * co * (itemsize + 4))
+    return est <= budget
+
+
+def _bn_bwd_vectors(y, do, mu, inv, gamma, beta, relu: bool):
+    """Pass 1 (XLA, fused reductions): dβ, dγ and the per-channel vectors
+    the fused kernels consume.  Under GSPMD with a sharded batch the
+    reductions are global (SyncBN backward); under shard_map per-shard —
+    identical to the unfused _bn_act_bwd.
+
+    dy = s·(dof − dβ/n − x̂·dγ/n) rearranged to two per-channel FMAs:
+    dy = s∘dof + t∘y + u with t = −s·inv·dγ/n, u = −s·dβ/n − t·μ; the
+    ReLU mask pre-activation is s∘y + v with v = β − s·μ."""
     f32 = jnp.float32
     axes = tuple(range(y.ndim - 1))
     n = 1
@@ -183,9 +346,6 @@ def _conv1x1_bn_bwd(eps, relu, interpret, res, cts):
         n *= y.shape[ax]
     yf = y.astype(f32)
     dof = do.astype(f32)
-    # Pass 1 (XLA, fused reductions): dβ, dγ.  Under GSPMD with a sharded
-    # batch these are global means/sums (SyncBN backward); under shard_map
-    # they are per-shard — identical to the unfused _bn_act_bwd.
     s = gamma * inv
     v = beta - s * mu
     if relu:
@@ -193,43 +353,44 @@ def _conv1x1_bn_bwd(eps, relu, interpret, res, cts):
     dbeta = dof.sum(axes)
     xhat = (yf - mu) * inv
     dgamma = (dof * xhat).sum(axes)
-    # dy = s·(dof − dβ/n − x̂·dγ/n) rearranged to two per-channel FMAs:
-    #   dy = s∘dof + t∘y + u,  t = −s·inv·dγ/n,  u = −s·dβ/n − t·μ
     t = -(s * inv) * (dgamma / n)
     u = -s * (dbeta / n) - t * mu
-    da, dw2 = _fused_dgrad_wgrad(
-        y, do, a, w.reshape(w.shape[-2], w.shape[-1]), s, t, u, v,
-        relu, _resolve_interpret(interpret),
-    )
-    dw = dw2.reshape(w.shape).astype(w.dtype)
-    return (da.astype(a.dtype), dw,
-            dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype))
-
-
-conv1x1_bn_act.defvjp(_conv1x1_bn_fwd, _conv1x1_bn_bwd)
+    return s, t, u, v, dgamma, dbeta
 
 
 def conv1x1_bn(mdl, conv_name: str, bn_name: str, x, features: int, *,
                relu: bool, use_running_average: bool, dtype,
                momentum: float = 0.9, eps: float = 1e-5,
                scale_init=None, fused: bool = True,
-               interpret: Optional[bool] = None):
+               interpret: Optional[bool] = None,
+               kernel_size: Tuple[int, int] = (1, 1)):
     """Flax-level combinator: a ``Conv_k``→``FusedBatchNormAct_k`` pair whose
     params live at EXACTLY the unfused pair's paths (declared through child
     scopes), so toggling the fused backward never invalidates a checkpoint —
     asserted by tests/test_fused_conv_bn.py.
 
     ``mdl`` is the calling (compact) module; names are the explicit child
-    names the unfused branch would auto-assign.
+    names the unfused branch would auto-assign.  ``kernel_size`` selects
+    the fused op: (1, 1) or (3, 3) stride-1 SAME (the two bottleneck
+    shapes with fused backwards).
     """
     from flax import linen as nn
 
+    if kernel_size not in ((1, 1), (3, 3)):
+        raise ValueError(f"no fused backward for kernel {kernel_size}")
+    is3 = kernel_size == (3, 3)
+    conv_fwd = _conv3x3 if is3 else _conv1x1
+    fused_op = conv3x3_bn_act if is3 else conv1x1_bn_act
+    if is3 and fused and not conv3x3_plane_fits_vmem(
+            x.shape[1], x.shape[2], x.shape[-1], features,
+            jnp.dtype(dtype).itemsize):
+        fused = False  # unfused XLA backward for this oversized slot
     if scale_init is None:
         scale_init = nn.initializers.ones
     ci = x.shape[-1]
     csc = mdl.scope.push(conv_name)
     kernel = csc.param("kernel", nn.initializers.lecun_normal(),
-                       (1, 1, ci, features), jnp.float32)
+                       kernel_size + (ci, features), jnp.float32)
     bsc = mdl.scope.push(bn_name)
     gamma = bsc.param("scale", scale_init, (features,), jnp.float32)
     beta = bsc.param("bias", nn.initializers.zeros, (features,), jnp.float32)
@@ -240,7 +401,7 @@ def conv1x1_bn(mdl, conv_name: str, bn_name: str, x, features: int, *,
 
     xd = x.astype(dtype)
     if use_running_average:
-        y = _conv1x1(xd, kernel)
+        y = conv_fwd(xd, kernel)
         invr = jax.lax.rsqrt(ra_var.value + eps)
         scale = gamma * invr
         shift = beta - ra_mean.value * scale
@@ -248,11 +409,11 @@ def conv1x1_bn(mdl, conv_name: str, bn_name: str, x, features: int, *,
         return jax.nn.relu(o) if relu else o
 
     if mdl.is_initializing() or not fused:
-        y = _conv1x1(xd, kernel)
+        y = conv_fwd(xd, kernel)
         o, mu, var = _bn_act(y, gamma, beta, eps, relu)
     else:
-        o, mu, var = conv1x1_bn_act(xd, kernel, gamma, beta, eps, relu,
-                                    interpret)
+        o, mu, var = fused_op(xd, kernel, gamma, beta, eps, relu,
+                              interpret)
     if not mdl.is_initializing():
         m = momentum
         ra_mean.value = m * ra_mean.value + (1 - m) * jax.lax.stop_gradient(mu)

@@ -165,9 +165,10 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="ResNet stem: torchvision conv7 or the numerically "
                    "identical space-to-depth packing (TPU MXU-friendly)")
     p.add_argument("--fused-convbn", action="store_true", dest="fused_convbn",
-                   help="fuse BN-backward dx into the bottleneck 1x1 "
-                   "dgrad/wgrad (Pallas; dy never hits HBM); checkpoints "
-                   "stay interchangeable with the unfused model")
+                   help="fuse BN-backward dx into the bottleneck conv "
+                   "dgrad/wgrad (Pallas, 1x1 + stride-1 3x3; dy never hits "
+                   "HBM); checkpoints stay interchangeable with the "
+                   "unfused model")
     return p
 
 

@@ -16,6 +16,7 @@ import pytest
 
 from pytorch_distributed_tpu.ops.fused_conv_bn import (
     conv1x1_bn_act,
+    conv3x3_bn_act,
     _fused_dgrad_wgrad,
 )
 
@@ -23,10 +24,12 @@ EPS = 1e-5
 
 
 def _ref(a, w, gamma, beta, relu):
-    """Pure-jnp conv1x1 + BN(+ReLU), f32 stats — autodiff provides the oracle
-    backward.  Variance uses the same one-pass clamped formula as _stats."""
+    """Pure-jnp conv + BN(+ReLU), f32 stats — autodiff provides the oracle
+    backward.  Variance uses the same one-pass clamped formula as _stats.
+    Kernel spatial shape selects 1x1 VALID vs 3x3 stride-1 SAME."""
+    pad = "VALID" if w.shape[:2] == (1, 1) else ((1, 1), (1, 1))
     y = jax.lax.conv_general_dilated(
-        a, w.astype(a.dtype), (1, 1), "VALID",
+        a, w.astype(a.dtype), (1, 1), pad,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
     yf = y.astype(jnp.float32)
@@ -68,18 +71,48 @@ def test_op_parity_f32(relu):
         np.testing.assert_allclose(f, r, rtol=2e-4, atol=2e-5, err_msg=name)
 
 
-def test_op_parity_bf16_inputs():
-    """bf16 activations (the bench policy): kernel matmuls run in bf16 with
-    f32 accumulation, like XLA's conv backward — looser tolerance."""
+@pytest.mark.parametrize("relu", [True, False])
+def test_op_parity_3x3_f32(relu):
+    """The 3x3 stride-1 fold (whole-plane per-image tiling): N=3 images
+    exercises the dW accumulation across the grid; 5x6 spatial exercises
+    non-square planes and the zero-pad taps."""
+    k = jax.random.split(jax.random.PRNGKey(4), 5)
+    a = _rand(k[0], 3, 5, 6, 8)
+    w = _rand(k[1], 3, 3, 8, 16) * 0.4
+    gamma = _rand(k[2], 16) * 0.5 + 1.0
+    beta = _rand(k[3], 16) * 0.1
+    cot = _rand(k[4], 3, 5, 6, 16)
+
+    def fused_loss(a, w, g, b):
+        o, _, _ = conv3x3_bn_act(a, w, g, b, EPS, relu, True)
+        return jnp.sum(o * cot)
+
+    def ref_loss(a, w, g, b):
+        return jnp.sum(_ref(a, w, g, b, relu) * cot)
+
+    np.testing.assert_allclose(fused_loss(a, w, gamma, beta),
+                               ref_loss(a, w, gamma, beta), rtol=1e-5)
+    fg = jax.grad(fused_loss, argnums=(0, 1, 2, 3))(a, w, gamma, beta)
+    rg = jax.grad(ref_loss, argnums=(0, 1, 2, 3))(a, w, gamma, beta)
+    for f, r, name in zip(fg, rg, ("da", "dw", "dgamma", "dbeta")):
+        np.testing.assert_allclose(f, r, rtol=3e-4, atol=3e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("ksz,op", [((1, 1), conv1x1_bn_act),
+                                    ((3, 3), conv3x3_bn_act)])
+def test_op_parity_bf16_inputs(ksz, op):
+    """bf16 activations (the bench policy) for BOTH kernel shapes: the
+    fused matmuls run in bf16 with f32 accumulation, like XLA's conv
+    backward — looser tolerance."""
     k = jax.random.split(jax.random.PRNGKey(1), 5)
     a = _rand(k[0], 4, 4, 4, 32, dtype=jnp.bfloat16)
-    w = _rand(k[1], 1, 1, 32, 16)
+    w = _rand(k[1], *ksz, 32, 16) * (0.4 if ksz == (3, 3) else 1.0)
     gamma = _rand(k[2], 16) * 0.5 + 1.0
     beta = _rand(k[3], 16) * 0.1
     cot = _rand(k[4], 4, 4, 4, 16)
 
     def fused_loss(a, w, g, b):
-        o, _, _ = conv1x1_bn_act(a, w, g, b, EPS, True, True)
+        o, _, _ = op(a, w, g, b, EPS, True, True)
         return jnp.sum(o.astype(jnp.float32) * cot)
 
     def ref_loss(a, w, g, b):
@@ -89,6 +122,21 @@ def test_op_parity_bf16_inputs():
     rg = jax.grad(ref_loss, argnums=(1, 2, 3))(a, w, gamma, beta)
     for f, r, name in zip(fg, rg, ("dw", "dgamma", "dbeta")):
         np.testing.assert_allclose(f, r, rtol=0.05, atol=0.05, err_msg=name)
+
+
+def test_vmem_guard_declines_oversized_planes():
+    from pytorch_distributed_tpu.ops.fused_conv_bn import (
+        conv3x3_plane_fits_vmem,
+    )
+
+    # ResNet-50 bf16 3x3 planes through stage 3 fit ...
+    for h, ci, co in ((56, 64, 64), (28, 128, 128), (14, 256, 256)):
+        assert conv3x3_plane_fits_vmem(h, h, ci, co, 2), (h, ci, co)
+    # ... the 512-wide stage declines (W + f32 dW alone are ~14 MiB —
+    # conservative until a Co-split grid axis lands), as does the
+    # wide-resnet f32 stage-1 plane (the review case).
+    assert not conv3x3_plane_fits_vmem(7, 7, 512, 512, 2)
+    assert not conv3x3_plane_fits_vmem(56, 56, 128, 128, 4)
 
 
 def test_kernel_accumulates_across_tiles():
@@ -109,18 +157,26 @@ def test_kernel_accumulates_across_tiles():
     np.testing.assert_allclose(da, do @ w.T, rtol=1e-5, atol=1e-5)
 
 
-def _tiny_resnet(fused, nc=7):
-    from pytorch_distributed_tpu.models.resnet import Bottleneck, ResNet
+def _tiny_resnet(fused, nc=7, block="bottleneck"):
+    from pytorch_distributed_tpu.models.resnet import (
+        BasicBlock,
+        Bottleneck,
+        ResNet,
+    )
 
-    return ResNet(stage_sizes=[1, 1], block_cls=Bottleneck, num_classes=nc,
+    cls = Bottleneck if block == "bottleneck" else BasicBlock
+    return ResNet(stage_sizes=[1, 1], block_cls=cls, num_classes=nc,
                   num_filters=16, fused_convbn=fused)
 
 
-def test_model_tree_and_forward_parity():
+@pytest.mark.parametrize("block", ["bottleneck", "basic"])
+def test_model_tree_and_forward_parity(block):
     """Toggling fused_convbn changes NEITHER the param tree nor the forward
-    numbers — the checkpoint-interchange guarantee."""
+    numbers — the checkpoint-interchange guarantee (both block families:
+    Bottleneck folds 1x1s + the stride-1 3x3; BasicBlock its 3x3 mains)."""
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 16, 16, 3))
-    m0, m1 = _tiny_resnet(False), _tiny_resnet(True)
+    m0 = _tiny_resnet(False, block=block)
+    m1 = _tiny_resnet(True, block=block)
     v0 = m0.init(jax.random.PRNGKey(7), x, train=False)
     v1 = m1.init(jax.random.PRNGKey(7), x, train=False)
     assert (jax.tree_util.tree_structure(v0)
@@ -136,11 +192,13 @@ def test_model_tree_and_forward_parity():
         np.testing.assert_allclose(a_, b_, rtol=1e-5, atol=1e-5)
 
 
-def test_model_grad_parity():
+@pytest.mark.parametrize("block", ["bottleneck", "basic"])
+def test_model_grad_parity(block):
     """Full-model gradients agree between the fused and unfused backward."""
     x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8, 3))
     labels = jnp.array([0, 1, 2, 3])
-    m0, m1 = _tiny_resnet(False), _tiny_resnet(True)
+    m0 = _tiny_resnet(False, block=block)
+    m1 = _tiny_resnet(True, block=block)
     v = m0.init(jax.random.PRNGKey(7), x, train=False)
 
     def loss(m):
@@ -162,24 +220,28 @@ def test_model_grad_parity():
             err_msg=jax.tree_util.keystr(path))
 
 
-def test_gspmd_sharded_batch_parity():
-    """The fused op inside a GSPMD-jitted, data-sharded step: compiles and
-    matches the unsharded result (single-program semantics are what the
+@pytest.mark.parametrize("ksz,op", [((1, 1), conv1x1_bn_act),
+                                    ((3, 3), conv3x3_bn_act)])
+def test_gspmd_sharded_batch_parity(ksz, op):
+    """The fused ops inside a GSPMD-jitted, data-sharded step: compile and
+    match the unsharded result (single-program semantics are what the
     bench's 1-chip GSPMD step uses; multi-chip prefers the shard_map /
-    explicit-collectives recipe where the kernel sees local shards)."""
+    explicit-collectives recipe where the kernels see local shards).  The
+    3x3 case matters specifically because its pallas grid runs per-image
+    over the very axis GSPMD shards."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = np.array(jax.devices()[:8]).reshape(8)
     mesh = Mesh(devs, ("data",))
     k = jax.random.split(jax.random.PRNGKey(3), 5)
     a = _rand(k[0], 16, 4, 4, 8)
-    w = _rand(k[1], 1, 1, 8, 8)
+    w = _rand(k[1], *ksz, 8, 8) * (0.4 if ksz == (3, 3) else 1.0)
     gamma = jnp.ones(8)
     beta = jnp.zeros(8)
     cot = _rand(k[4], 16, 4, 4, 8)
 
     def loss(a, w, g, b):
-        o, _, _ = conv1x1_bn_act(a, w, g, b, EPS, True, True)
+        o, _, _ = op(a, w, g, b, EPS, True, True)
         return jnp.sum(o * cot)
 
     grads = jax.grad(loss, argnums=(0, 1))(a, w, gamma, beta)
