@@ -235,7 +235,10 @@ class DataLoader:
         """The spawn pool persists across epochs (advisor r3: a per-__iter__
         pool re-pays full worker spawn + dataset pickling every epoch) —
         rebuilt when ``self.dataset`` is rebound to a different object or
-        the worker count changes; ``close()``/``__del__`` tear it down.
+        the worker count changes; ``close()``/``__del__`` tear it down, and
+        a module atexit reaper terminates any still-live pool so process
+        exit never hangs joining pool machinery (observed: the full test
+        suite wedging after its last test with workers still up).
 
         The key holds a STRONG reference to the keyed dataset and compares
         by identity, so a freed-then-reallocated object can never alias the
@@ -252,8 +255,10 @@ class DataLoader:
             return self._pool
         self.close()
         ctx = mp.get_context("spawn")
+        _install_pool_reaper()  # after mp's own atexit hook → ours runs first
         self._pool = ctx.Pool(self.num_workers, initializer=_process_init,
                               initargs=(self.dataset,))
+        _LIVE_POOLS.append(self._pool)
         self._pool_key = (self.dataset, self.num_workers)
         return self._pool
 
@@ -261,6 +266,8 @@ class DataLoader:
         if self._pool is not None:
             self._pool.terminate()
             self._pool.join()
+            if self._pool in _LIVE_POOLS:
+                _LIVE_POOLS.remove(self._pool)
             self._pool = None
             self._pool_key = None
 
@@ -303,6 +310,33 @@ class DataLoader:
                 for s in chunk
             ]
             yield self._assemble(b, val, samples)
+
+
+_LIVE_POOLS: list = []
+_REAPER_INSTALLED = False
+
+
+def _install_pool_reaper() -> None:
+    """Terminate any still-live worker pool at interpreter exit.  atexit
+    hooks run LIFO, so installing ours lazily (after multiprocessing has
+    registered its own) guarantees pools are already dead when the stdlib's
+    exit machinery would otherwise block joining their queue threads."""
+    global _REAPER_INSTALLED
+    if _REAPER_INSTALLED:
+        return
+    import atexit
+
+    def _reap():
+        for p in list(_LIVE_POOLS):
+            try:
+                p.terminate()
+                p.join()
+            except Exception:  # noqa: BLE001 — exit path, best effort
+                pass
+        _LIVE_POOLS.clear()
+
+    atexit.register(_reap)
+    _REAPER_INSTALLED = True
 
 
 _PROC_DATASET = None  # per-worker global, set by _process_init
