@@ -325,6 +325,12 @@ def _install_pool_reaper() -> None:
     if _REAPER_INSTALLED:
         return
     import atexit
+    # Force multiprocessing.util's atexit.register(_exit_function) to
+    # happen BEFORE ours: it is lazily imported only inside Pool(...), so
+    # without this import the first-ever pool would register our hook
+    # first and LIFO would run mp's exit machinery before the reap —
+    # exactly the inversion this function exists to prevent.
+    import multiprocessing.util  # noqa: F401
 
     def _reap():
         for p in list(_LIVE_POOLS):
