@@ -26,12 +26,15 @@ python -m pytorch_distributed_tpu.recipes.dataparallel --data "$DATA"
 
 # 7. canonical TPU-native recipe (BASELINE.json north star)
 python -m pytorch_distributed_tpu.recipes.tpu_native --data "$DATA" -a resnet50
+# python -m pytorch_distributed_tpu.recipes.tpu_native --data "$DATA" -a resnet50 --fused-convbn   # BN-dx fold (round 4)
 
 # 8. long-context LM pretraining (beyond reference): composable parallelism
 python -m pytorch_distributed_tpu.recipes.lm_pretrain --tp 4 --seq-len 2048 -b 32 --steps 1000
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --sp 4 --seq-len 16384 -b 8 --steps 1000
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --tp 2 --sp 2 --seq-len 8192 -b 8 --steps 1000   # composed mesh
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 4 --n-layers 8 -b 32 --steps 1000           # GPipe pipeline
+# python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 4 --schedule 1f1b --n-layers 8 -b 32 --microbatches 16 --steps 1000        # memory-bounded 1F1B
+# python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 4 --schedule interleaved --pp-virtual 2 --n-layers 8 -b 32 --steps 1000    # virtual-stage 1F1B
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --ep 4 --moe-top-k 2 -b 32 --steps 1000          # MoE top-2
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 2 --sp 2 --tp 2 -b 16 --steps 1000          # quad mesh
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --fsdp --tp 2 -b 32 --steps 1000                 # ZeRO-3 + TP
