@@ -1,17 +1,33 @@
 """Fused conv1x1+BN(+ReLU) backward — the BN-dx fold (ROADMAP item 1).
 
+**Measured outcome (v5e, 2026-07-31): the fold LOSES — keep it off.**  The
+full-model fused variant runs the b256 ResNet-50 step at 1,208-1,395 img/s
+vs 2,536 unfused (scripts/fused_triage.py); per-shape, the kernels never beat
+the XLA backward at any of the 13 distinct conv->BN backward shapes in the
+model (0.54-0.96x, scripts/profile_fused_conv_bn.py).  The premise was
+traffic: autodiff writes dy to HBM and the dgrad/wgrad convs read it back.
+The optimized HLO (scripts/hlo_dy_check.py) shows XLA instead *clones* the
+cheap elementwise dy computation into each consumer's input fusion and its
+conv emitters stream near HBM peak — so the fold saves less traffic than
+theorized and pays for it with hand-scheduled Mosaic matmuls that reach a
+fraction of the conv emitters' effective bandwidth, plus custom-call
+boundaries that break XLA's surrounding fusions.  The module stays as an
+opt-in (``--fused-convbn``), fully parity-tested, as the measured record of
+why the obvious kernel-fusion route past the step's memory roofline does
+not work on this chip.
+
 The round-2 roofline (scripts/profile_trace.py) showed the ResNet-50 step is
 HBM-bound with a ~3,080 img/s ceiling at b256; the only route past it is
-removing whole memory passes.  The largest remaining pass is the BN-backward
-dx: autodiff materializes ``dy`` (the gradient at the conv output / BN input)
-to HBM, then the dgrad and wgrad convolutions each read it back — for every
-conv→BN pair, (y, do) are read for the reductions, read again to form dy,
-dy is written, then read twice more:
+removing whole memory passes.  The largest remaining pass *appeared* to be
+the BN-backward dx: autodiff materializes ``dy`` (the gradient at the conv
+output / BN input) to HBM, then the dgrad and wgrad convolutions each read
+it back — for every conv→BN pair, (y, do) are read for the reductions, read
+again to form dy, dy is written, then read twice more:
 
-    XLA today:   reduce(y,do) + write dy(y,do) + dgrad(dy) + wgrad(dy,a)
-                 ≈ 9 tensor-passes per pair
-    this kernel: reduce(y,do) + fused[dy in VMEM → dgrad+wgrad]
-                 ≈ 6 tensor-passes — dy never exists in HBM
+    XLA (theorized): reduce(y,do) + write dy(y,do) + dgrad(dy) + wgrad(dy,a)
+                     ≈ 9 tensor-passes per pair
+    this kernel:     reduce(y,do) + fused[dy in VMEM → dgrad+wgrad]
+                     ≈ 6 tensor-passes — dy never exists in HBM
 
 For the 1×1 stride-1 convolutions the conv is exactly a matmul over
 channels, so the fold is a single Pallas kernel: per M-tile (M = N·H·W
@@ -26,10 +42,11 @@ reading y, do, a from HBM exactly once each.  The 3×3 stride-1 SAME conv
 every ResNet-50 3×3 plane fits VMEM whole, so dgrad/wgrad become 9
 shifted matmuls each off the in-VMEM dy with no halo exchange
 (``_bwd3_kernel``).  Together that folds every conv of a stride-1
-bottleneck whose plane passes the VMEM guard below (ResNet-50 bf16:
-stages 1-3; the 512-wide 7×7 stage declines — its W + f32 dW alone are
-~14 MiB) plus the 1×1s of strided blocks; strided / grouped / oversized
-slots keep the plain XLA backward (``models/resnet.py`` selects).
+bottleneck whose plane passes the VMEM guard below (under the 96 MiB
+``CompilerParams`` cap all four ResNet-50 bf16 stages engage, full-model
+compile validated on v5e) plus the 1×1s of strided blocks; strided /
+grouped / genuinely oversized slots keep the plain XLA backward
+(``models/resnet.py`` selects).
 
 Forward is unchanged XLA (conv + the one-pass BN+ReLU of ops/fused_bn.py) —
 forward fusion is something XLA already does well; the backward pass is where
@@ -61,6 +78,40 @@ def _resolve_interpret(interpret: Optional[bool]) -> bool:
     if interpret is not None:
         return interpret
     return jax.default_backend() != "tpu"
+
+
+# Mosaic's default scoped-VMEM cap is 16 MiB; the whole-plane 3x3 kernel's
+# stack (f32 dy/dof temporaries + padded copies, every channel dim lane-
+# padded to 128) measures 21.7 MiB at ResNet-50's 56x56x64 slot on a real
+# v5e.  The chip has 128 MiB of VMEM — raise the cap for these kernels and
+# let conv3x3_plane_fits_vmem keep genuinely oversized slots on the XLA
+# backward.
+_VMEM_LIMIT_BYTES = 96 << 20
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT_BYTES)
+
+
+def _pick_mtile(M: int, Ci: int, Co: int, itemsize: int) -> int:
+    """M-tile for ``_bwd_kernel``: as many rows as fit a ~24 MiB stack.
+
+    A v5e measurement (runs of 2026-07-31) showed the original fixed
+    128/256-row tiles cost the full-model step 45%: stage 1 becomes a
+    3,136-step grid moving 32 KB blocks — far too little work per step to
+    amortize DMA issue + grid overhead.  Per-row footprint counts the
+    lane-padded (128) channel dims: the y/do/a/da blocks (double-buffered
+    by Mosaic's pipeline), the f32 y/do temporaries, and the f32 dgrad
+    accumulator before the output cast."""
+    ci_p = ((Ci + 127) // 128) * 128
+    co_p = ((Co + 127) // 128) * 128
+    # Per-row: y/do/a/da blocks (double-buffered), f32 y/do temps, the
+    # cast dy tile, and the f32 dgrad accumulator pre-cast.
+    row = (2 * (ci_p + co_p) * itemsize * 2 + 2 * co_p * 4
+           + co_p * itemsize + ci_p * 4)
+    # Grid-constant: the weights tile and the f32 dW accumulator.
+    fixed = ci_p * co_p * (itemsize + 4)
+    mt = max(0, (24 << 20) - fixed) // row
+    mt = max(256, min(8192, (mt // 256) * 256))
+    # Never tile far past M itself (small call sites pad to one tile).
+    return min(mt, ((M + 255) // 256) * 256)
 
 
 def _bwd_kernel(y_ref, do_ref, a_ref, w_ref, vec_ref, da_ref, dw_ref,
@@ -119,9 +170,7 @@ def _fused_dgrad_wgrad(y, do, a, w, s, t, u, v, relu: bool, interpret: bool
     do2 = do.reshape(M, Co)
     a2 = a.reshape(M, Ci)
     cdt = a.dtype
-    # Tile choice: 256 rows amortizes the grid; drop to 128 when the
-    # weight + f32 dW accumulator get big so VMEM stays comfortable.
-    mt = 128 if Ci * Co >= (1 << 20) else 256
+    mt = _pick_mtile(M, Ci, Co, jnp.dtype(cdt).itemsize)
     mp = ((M + mt - 1) // mt) * mt
     if mp != M:
         pad = ((0, mp - M), (0, 0))
@@ -147,6 +196,7 @@ def _fused_dgrad_wgrad(y, do, a, w, s, t, u, v, relu: bool, interpret: bool
             jax.ShapeDtypeStruct((mp, Ci), cdt),
             jax.ShapeDtypeStruct((Ci, Co), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(y2, do2, a2, w.astype(cdt), vec)
     return da2[:M].reshape(a.shape), dw
@@ -238,6 +288,7 @@ def _fused_dgrad_wgrad_3x3(y, do, a, w, s, t, u, v, relu: bool,
             jax.ShapeDtypeStruct((N, H, Wd, Ci), cdt),
             jax.ShapeDtypeStruct((3, 3, Ci, Co), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(y, do, a, w.astype(cdt), vec)
     return da, dw
@@ -313,21 +364,31 @@ conv3x3_bn_act = _make_conv_bn_op(
 
 
 def conv3x3_plane_fits_vmem(h: int, w_: int, ci: int, co: int,
-                            itemsize: int, budget: int = 12 << 20) -> bool:
-    """Conservative per-grid-step working-set estimate for ``_bwd3_kernel``
-    (blocks + padded copies + f32 accumulators + weights and the f32 dW):
-    whole-plane tiling only engages when it fits comfortably; otherwise the
-    caller keeps the unfused XLA backward for that slot (e.g. wide-resnet
-    f32 stage-1 planes)."""
+                            itemsize: int, budget: int = 48 << 20) -> bool:
+    """Per-grid-step working-set estimate for ``_bwd3_kernel`` (blocks +
+    padded copies + f32 accumulators + weights and the f32 dW): whole-plane
+    tiling only engages when it fits comfortably under the raised
+    ``_VMEM_LIMIT_BYTES``; otherwise the caller keeps the unfused XLA
+    backward for that slot.  Under the 96 MiB cap every ResNet-50 bf16
+    plane engages (and the wide-resnet f32 stage-1 plane, ~30 MiB
+    estimated, now fits too); genuinely oversized working sets — e.g.
+    112x112 planes at 256+ f32 channels — still decline.
+
+    Mosaic lays every [..., C] VMEM buffer out in (8, 128) tiles, so channel
+    dims are lane-padded to 128 — at ResNet-50's 64-channel stage that
+    doubles every plane buffer.  With padded channels this formula estimates
+    14.7 MiB for the 56x56x64 slot; a real v5e measures a 21.7 MiB scoped
+    allocation (extra Mosaic temporaries for the 9 shifted-slice matmuls),
+    so the estimate carries a 1.5x headroom factor."""
+    ci_p = ((ci + 127) // 128) * 128
+    co_p = ((co + 127) // 128) * 128
     hw = (h + 2) * (w_ + 2)
     # planes (y/do/a/da blocks + f32 dy intermediates + padded copies) +
     # the grid-constant weights and f32 dW accumulator (not
-    # double-buffered).  Conservative: at ResNet-50's 512-wide 7x7 stage
-    # the 14 MiB of W+dW alone make the fit marginal, so that slot
-    # declines too (a Co-split grid axis would recover it — future work).
-    est = (hw * (12 * co + 8 * ci + 3 * itemsize * (ci + co))
-           + 9 * ci * co * (itemsize + 4))
-    return est <= budget
+    # double-buffered).
+    est = (hw * (12 * co_p + 8 * ci_p + 3 * itemsize * (ci_p + co_p))
+           + 9 * ci_p * co_p * (itemsize + 4))
+    return (est * 3) // 2 <= budget
 
 
 def _bn_bwd_vectors(y, do, mu, inv, gamma, beta, relu: bool):

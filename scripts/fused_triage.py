@@ -1,0 +1,45 @@
+"""Which fused family is slow? unfused vs 1x1-only vs both, same discipline as bench.py."""
+import time, json
+import jax, jax.numpy as jnp, numpy as np
+from pytorch_distributed_tpu import models
+from pytorch_distributed_tpu.ops import fused_conv_bn as fcb
+from pytorch_distributed_tpu.parallel import data_parallel_mesh
+from pytorch_distributed_tpu.train.optim import sgd_init
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.steps import make_train_step
+
+mesh = data_parallel_mesh()
+batch, image = 256, 224
+rng = np.random.default_rng(0)
+db = {"images": jnp.asarray(rng.normal(size=(batch, image, image, 3)), dtype=jnp.bfloat16),
+      "labels": jnp.asarray(rng.integers(0, 1000, size=batch).astype(np.int32)),
+      "weights": jnp.ones((batch,), jnp.float32)}
+
+def measure(fused, allow3):
+    orig = fcb.conv3x3_plane_fits_vmem
+    if not allow3:
+        fcb.conv3x3_plane_fits_vmem = lambda *a, **k: False
+    try:
+        model = models.create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16,
+                                    stem="space_to_depth", fused_convbn=fused)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)), train=False)
+        state = TrainState.create(variables, sgd_init(variables["params"]))
+        step = make_train_step(model, mesh)
+        for _ in range(3):
+            state, metrics = step(state, db, jnp.float32(0.1))
+        float(metrics["loss"])
+        iters = 20
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, metrics = step(state, db, jnp.float32(0.1))
+        assert np.isfinite(float(metrics["loss"]))
+        dt = time.perf_counter() - t0
+        return batch * iters / dt
+    finally:
+        fcb.conv3x3_plane_fits_vmem = orig
+
+out = {}
+out["unfused"] = round(measure(False, True), 1)
+out["fused_1x1_only"] = round(measure(True, False), 1)
+out["fused_both"] = round(measure(True, True), 1)
+print(json.dumps(out))

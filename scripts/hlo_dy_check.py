@@ -1,0 +1,38 @@
+"""Does XLA materialize dy (the BN-backward conv-output gradient) to HBM in
+the unfused ResNet step, or fuse it into the dgrad/wgrad consumers?"""
+import jax, jax.numpy as jnp, re
+import numpy as np
+
+B, H, Ci, Co = 256, 56, 64, 64
+dtype = jnp.bfloat16
+s = jnp.ones((Co,), jnp.float32); t = jnp.full((Co,), .1, jnp.float32)
+u = jnp.zeros((Co,), jnp.float32); v = jnp.zeros((Co,), jnp.float32)
+
+def unfused(y, do, a, w):
+    yf = y.astype(jnp.float32); dof = do.astype(jnp.float32)
+    dof = jnp.where(yf * s + v > 0, dof, 0.0)
+    dy = (dof * s + yf * t + u).astype(dtype)
+    da = jax.lax.conv_general_dilated(
+        dy, jnp.transpose(w, (0, 1, 3, 2))[::-1, ::-1].astype(dtype),
+        (1, 1), ((1, 1), (1, 1)), dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    dw = jax.lax.conv_general_dilated(
+        jnp.transpose(a, (3, 1, 2, 0)).astype(dtype),
+        jnp.transpose(dy, (1, 2, 0, 3)).astype(dtype),
+        (1, 1), ((1, 1), (1, 1)), dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    return da.astype(jnp.float32).sum() + dw.sum()
+
+y = jnp.ones((B, H, H, Co), dtype); do = jnp.ones((B, H, H, Co), dtype)
+a = jnp.ones((B, H, H, Ci), dtype); w = jnp.ones((3, 3, Ci, Co), jnp.float32)
+txt = jax.jit(unfused).lower(y, do, a, w).compile().as_text()
+# count fusions producing a [B,H,H,Co]-shaped bf16 output (a materialized dy)
+# vs convolution fusions with elementwise producers inside
+convs = re.findall(r"kind=kCustom.*convolution", txt)
+fus = [l for l in txt.splitlines() if "fusion" in l and "bf16[256,56,56,64]" in l and "ROOT" not in l]
+print("convolution custom-calls:", len(re.findall(r'custom_call_target="__cudnn|convolution', txt)))
+print("lines w/ fusion producing bf16[256,56,56,64]:")
+for l in fus[:12]: print("  ", l.strip()[:160])
+import os
+os.makedirs("runs", exist_ok=True)
+open("runs/hlo_unfused_bwd.txt","w").write(txt)
+print("total HLO lines:", len(txt.splitlines()))

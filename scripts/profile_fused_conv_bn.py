@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Per-shape micro-bench of the fused conv+BN backward kernels vs the
+unfused XLA sequence they replace (form dy from (y, do) -> dgrad -> wgrad).
+
+The full-model triage (runs/fused_triage.py, v5e 2026-07-31) showed the
+fused variant losing 2,536 -> 1,208 img/s; this isolates which shapes lose
+and by how much so the kernels (tile sizing, matmul shaping) can be tuned
+one shape at a time without 5-minute full-model compiles.
+
+Run on the real chip:  PYTHONPATH=. python scripts/profile_fused_conv_bn.py
+"""
+
+import functools
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from benchlib import timed_scalar  # noqa: E402
+
+from pytorch_distributed_tpu.ops import fused_conv_bn as fcb  # noqa: E402
+
+B = int(os.environ.get("FCB_BATCH", "256"))
+
+# (H, Ci, Co, ksz): every distinct conv->BN backward shape in ResNet-50
+# bottlenecks (1x1 reduce / 1x1 expand / 3x3 middle per stage).
+SHAPES = [
+    (56, 64, 64, 1), (56, 64, 256, 1), (56, 256, 64, 1), (56, 64, 64, 3),
+    (28, 128, 128, 3), (28, 128, 512, 1), (28, 512, 128, 1),
+    (14, 256, 256, 3), (14, 256, 1024, 1), (14, 1024, 256, 1),
+    (7, 512, 512, 3), (7, 512, 2048, 1), (7, 2048, 512, 1),
+]
+
+
+def run_shape(h, ci, co, ksz, dtype=jnp.bfloat16):
+    key = jax.random.split(jax.random.PRNGKey(0), 3)
+    y = jax.random.normal(key[0], (B, h, h, co), dtype)
+    do = jax.random.normal(key[1], (B, h, h, co), dtype)
+    a = jax.random.normal(key[2], (B, h, h, ci), dtype)
+    if ksz == 3:
+        w = jnp.ones((3, 3, ci, co), jnp.float32) / (3 * ci)
+    else:
+        w = jnp.ones((ci, co), jnp.float32) / ci
+    s = jnp.ones((co,), jnp.float32)
+    t = jnp.full((co,), 0.1, jnp.float32)
+    u = jnp.zeros((co,), jnp.float32)
+    v = jnp.zeros((co,), jnp.float32)
+
+    # Bytes the backward must move at minimum: read y, do, a once; write da.
+    nbytes = (y.nbytes + do.nbytes + a.nbytes
+              + a.size * jnp.dtype(dtype).itemsize)
+
+    @jax.jit
+    def fused(y, do, a, w):
+        if ksz == 3:
+            da, dw = fcb._fused_dgrad_wgrad_3x3(
+                y, do, a, w, s, t, u, v, True, False)
+        else:
+            da, dw = fcb._fused_dgrad_wgrad(
+                y, do, a, w, s, t, u, v, True, False)
+        return da.astype(jnp.float32).sum() + dw.sum()
+
+    @jax.jit
+    def unfused(y, do, a, w):
+        yf = y.astype(jnp.float32)
+        dof = do.astype(jnp.float32)
+        dof = jnp.where(yf * s + v > 0, dof, 0.0)
+        dy = (dof * s + yf * t + u).astype(dtype)
+        if ksz == 3:
+            da = jax.lax.conv_general_dilated(
+                dy, jnp.transpose(w, (0, 1, 3, 2))[::-1, ::-1].astype(dtype),
+                (1, 1), ((1, 1), (1, 1)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            dw = jax.lax.conv_general_dilated(
+                jnp.transpose(a, (3, 1, 2, 0)).astype(dtype),
+                jnp.transpose(dy, (1, 2, 0, 3)).astype(dtype),
+                (1, 1), ((1, 1), (1, 1)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32)
+            dw = jnp.transpose(dw, (1, 2, 0, 3))
+        else:
+            m = y.shape[0] * h * h
+            da = (dy.reshape(m, co) @ w.astype(dtype).T).reshape(a.shape)
+            dw = jax.lax.dot_general(
+                a.reshape(m, ci), dy.reshape(m, co),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        return da.astype(jnp.float32).sum() + dw.sum()
+
+    tf = timed_scalar(fused, y, do, a, w, iters=10, warmup=3)
+    tu = timed_scalar(unfused, y, do, a, w, iters=10, warmup=3)
+    tag = f"{ksz}x{ksz} {h:3d}x{h:<3d} {ci:4d}->{co:<4d}"
+    print(f"{tag}  fused {tf*1e3:7.3f} ms ({nbytes/tf/1e9:6.1f} GB/s)  "
+          f"xla {tu*1e3:7.3f} ms ({nbytes/tu/1e9:6.1f} GB/s)  "
+          f"ratio {tu/tf:5.2f}x", flush=True)
+    return tf, tu
+
+
+def main():
+    total_f = total_u = 0.0
+    for h, ci, co, ksz in SHAPES:
+        tf, tu = run_shape(h, ci, co, ksz)
+        total_f += tf
+        total_u += tu
+    print(f"TOTAL fused {total_f*1e3:.2f} ms  xla {total_u*1e3:.2f} ms  "
+          f"ratio {total_u/total_f:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

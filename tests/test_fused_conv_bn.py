@@ -129,20 +129,27 @@ def test_vmem_guard_declines_oversized_planes():
         conv3x3_plane_fits_vmem,
     )
 
-    # ResNet-50 bf16 3x3 planes through stage 3 fit ...
-    for h, ci, co in ((56, 64, 64), (28, 128, 128), (14, 256, 256)):
+    # Every ResNet-50 bf16 3x3 plane fits under the raised (96 MiB)
+    # Mosaic cap — including the 512-wide 7x7 stage, whose full-model
+    # compile was validated on a real v5e (2026-07-31) ...
+    for h, ci, co in ((56, 64, 64), (28, 128, 128), (14, 256, 256),
+                      (7, 512, 512)):
         assert conv3x3_plane_fits_vmem(h, h, ci, co, 2), (h, ci, co)
-    # ... the 512-wide stage declines (W + f32 dW alone are ~14 MiB —
-    # conservative until a Co-split grid axis lands), as does the
-    # wide-resnet f32 stage-1 plane (the review case).
-    assert not conv3x3_plane_fits_vmem(7, 7, 512, 512, 2)
-    assert not conv3x3_plane_fits_vmem(56, 56, 128, 128, 4)
+    # ... while genuinely oversized working sets still decline to the
+    # XLA backward (stage-1-sized planes at 256+ f32 channels).
+    assert not conv3x3_plane_fits_vmem(112, 112, 256, 256, 4)
+    assert not conv3x3_plane_fits_vmem(56, 56, 512, 512, 4)
 
 
 def test_kernel_accumulates_across_tiles():
     """dW accumulation across >1 grid step (M spans multiple tiles)."""
+    from pytorch_distributed_tpu.ops.fused_conv_bn import _pick_mtile
+
     k = jax.random.split(jax.random.PRNGKey(2), 3)
-    M, Ci, Co = 600, 8, 8                  # 600 -> 3 tiles of 256 (padded)
+    M, Ci, Co = 10_000, 8, 8
+    # The adaptive tile must leave >1 grid step or this test is vacuous.
+    mt = _pick_mtile(M, Ci, Co, 4)
+    assert M > mt, (M, mt)
     y = _rand(k[0], M, Co)
     do = _rand(k[1], M, Co)
     a = _rand(k[2], M, Ci)
@@ -152,8 +159,10 @@ def test_kernel_accumulates_across_tiles():
     u = jnp.zeros(Co)
     v = jnp.zeros(Co)
     # With s=1, t=u=0, relu off: dy == do, so dW = aT @ do, da = do @ wT.
+    # f32 tolerance scales with the M-length contraction (summation-order
+    # drift vs numpy), not with the default 1e-7.
     da, dw = _fused_dgrad_wgrad(y, do, a, w, s, t, u, v, False, True)
-    np.testing.assert_allclose(dw, a.T @ do, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(dw, a.T @ do, rtol=1e-4, atol=1e-3)
     np.testing.assert_allclose(da, do @ w.T, rtol=1e-5, atol=1e-5)
 
 

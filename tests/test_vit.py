@@ -71,3 +71,26 @@ def test_trainer_epoch_with_vit(tmp_path):
     assert not np.array_equal(p0, p1), "params must move"
     assert 0.0 <= best <= 100.0
     assert (tmp_path / "checkpoint.msgpack").exists()
+
+
+def test_remat_parity():
+    """remat=True must change NOTHING but memory: same param tree, same
+    forward, same grads (guards the static_argnums=(2,) convention in
+    models/vit.py against EncoderBlock signature drift)."""
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    kw = dict(patch_size=16, d_model=32, n_layers=2, n_heads=2, mlp_dim=64,
+              num_classes=5)
+    m0 = models.VisionTransformer(**kw)
+    m1 = models.VisionTransformer(**kw, remat=True)
+    v0 = m0.init(jax.random.PRNGKey(0), x, train=False)
+    v1 = m1.init(jax.random.PRNGKey(0), x, train=False)
+    assert (jax.tree_util.tree_structure(v0)
+            == jax.tree_util.tree_structure(v1)), "param tree changed"
+    y0 = m0.apply(v0, x, train=False)
+    y1 = m1.apply(v1, x, train=False)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1), rtol=1e-6)
+    g0 = jax.grad(lambda v: m0.apply(v, x, train=False).sum())(v0)
+    g1 = jax.grad(lambda v: m1.apply(v, x, train=False).sum())(v1)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g0, g1)
