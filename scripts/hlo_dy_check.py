@@ -29,7 +29,7 @@ txt = jax.jit(unfused).lower(y, do, a, w).compile().as_text()
 # vs convolution fusions with elementwise producers inside
 convs = re.findall(r"kind=kCustom.*convolution", txt)
 fus = [l for l in txt.splitlines() if "fusion" in l and "bf16[256,56,56,64]" in l and "ROOT" not in l]
-print("convolution custom-calls:", len(re.findall(r'custom_call_target="__cudnn|convolution', txt)))
+print("convolution custom-calls:", len(convs))
 print("lines w/ fusion producing bf16[256,56,56,64]:")
 for l in fus[:12]: print("  ", l.strip()[:160])
 import os

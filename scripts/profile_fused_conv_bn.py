@@ -10,7 +10,6 @@ one shape at a time without 5-minute full-model compiles.
 Run on the real chip:  PYTHONPATH=. python scripts/profile_fused_conv_bn.py
 """
 
-import functools
 import os
 import sys
 

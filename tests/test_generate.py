@@ -120,3 +120,41 @@ def test_nucleus_sampling():
                   seed=12)
     np.testing.assert_array_equal(np.asarray(n1), np.asarray(n1b))
     assert (np.asarray(n1) != np.asarray(n2)).any()
+
+
+def test_generate_reuses_compiled_program():
+    """Repeated generate() calls with identical shapes/config must hit the
+    lru-cached jitted program instead of re-tracing per call (the serving
+    loop would otherwise recompile on every request)."""
+    from pytorch_distributed_tpu.models import generate as gen_mod
+
+    params = _trained_params(seed=4)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    gen_mod._make_run.cache_clear()
+    greedy_generate(params, prompt, 3, **CFG)
+    info1 = gen_mod._make_run.cache_info()
+    out2 = greedy_generate(params, prompt, 3, **CFG)
+    info2 = gen_mod._make_run.cache_info()
+    assert info2.misses == info1.misses == 1
+    assert info2.hits == info1.hits + 1
+    # a different sampling config is a different program, not a stale hit
+    gen_mod.generate(params, prompt, 3, **CFG, temperature=1.0, top_k=2,
+                     seed=1)
+    assert gen_mod._make_run.cache_info().misses == 2
+    assert out2.shape == (1, 3)
+
+
+def test_topk_nucleus_fast_path_matches_full_sort():
+    """With top_k >= vocab the k-truncation is a no-op, so the top-k fast
+    nucleus path (cutoff from the sorted k-vector) must produce the same
+    stream as the full-vocab-argsort nucleus path."""
+    from pytorch_distributed_tpu.models.generate import generate
+
+    params = _trained_params(seed=6)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    for seed in (0, 3, 17):
+        slow = generate(params, prompt, 8, **CFG, temperature=1.3,
+                        top_p=0.8, seed=seed)
+        fast = generate(params, prompt, 8, **CFG, temperature=1.3,
+                        top_k=CFG["vocab_size"], top_p=0.8, seed=seed)
+        np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
