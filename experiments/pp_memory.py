@@ -38,7 +38,8 @@ import numpy as np  # noqa: E402
 
 def compiled_temp_bytes(schedule: str, remat: bool, n_micro: int,
                         d_model: int, seq: int, stages: int,
-                        vocab: int, mb: int, time_iters: int = 0) -> dict:
+                        vocab: int, mb: int, time_iters: int = 0,
+                        n_layers: int = 0, n_virtual: int = 1) -> dict:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from pytorch_distributed_tpu.models.pipeline_lm import (
@@ -54,9 +55,10 @@ def compiled_temp_bytes(schedule: str, remat: bool, n_micro: int,
     mesh = build_mesh(MeshSpec(("data", "pipe"), (1, stages)),
                       jax.devices()[:stages])
     model = PipelinedTransformerLM(
-        vocab_size=vocab, d_model=d_model, n_heads=8, n_layers=stages,
+        vocab_size=vocab, d_model=d_model, n_heads=8,
+        n_layers=n_layers or stages,
         n_stages=stages, n_microbatches=n_micro, mesh=mesh,
-        schedule=schedule, remat=remat,
+        schedule=schedule, remat=remat, n_virtual=n_virtual,
     )
     B = mb * n_micro
     tokens = jnp.zeros((B, seq), jnp.int32)
@@ -93,6 +95,52 @@ def compiled_temp_bytes(schedule: str, remat: bool, n_micro: int,
     return row
 
 
+def interleaved_section(args) -> None:
+    """Same 8-layer model on a P=4 pipe mesh: 1F1B (2 layers/stage) vs
+    interleaved 1F1B (V=2 single-layer chunks/device).  On real chips the
+    interleave shrinks the bubble ~V x; on the serialized 1-core sim
+    bubbles are free, so the comparable columns are the stash high-water
+    (temp_bytes — the V x memory trade) and schedule compute overhead."""
+    stages, n_layers, V = 4, 8, 2
+    if args.stages != 8:
+        raise SystemExit("--interleaved-only runs a fixed P=4 / 8-layer "
+                         "comparison; --stages does not apply to it")
+    if not os.path.exists(args.out):
+        raise SystemExit(f"--interleaved-only appends to an existing "
+                         f"{args.out}; run the main table first")
+    rows = []
+    for n_micro in args.micro:
+        for schedule, n_virtual in (("1f1b", 1), ("interleaved", V)):
+            r = compiled_temp_bytes(
+                schedule, False, n_micro, args.d_model, args.seq, stages,
+                args.vocab, args.mb, time_iters=args.time_iters,
+                n_layers=n_layers, n_virtual=n_virtual)
+            if n_virtual > 1:
+                r["schedule"] = f"interleaved_v{n_virtual}"
+            rows.append(r)
+            print(f"M={n_micro:3d} {r['schedule']:15s} "
+                  f"temp={r['temp_bytes']/2**20:9.1f} MiB "
+                  f"ms/step={r.get('ms_per_step', '-')}", flush=True)
+    with open(args.out) as f:
+        out = json.load(f)
+    out["interleaved_p4"] = {
+        "config": {"d_model": args.d_model, "seq": args.seq,
+                   "stages": stages, "n_layers": n_layers, "vocab":
+                   args.vocab, "mb": args.mb, "n_virtual": V,
+                   "note": "same 8-layer LM, P=4 pipe mesh: 2 layers/stage "
+                           "(1f1b) vs V=2 single-layer chunks/device "
+                           "(interleaved).  Bubble shrink needs real "
+                           "parallel chips; here the columns quantify the "
+                           "interleave's stash/memory trade and compute "
+                           "overhead"},
+        "rows": rows,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(f"appended interleaved_p4 section to {args.out}")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--d-model", type=int, default=512)
@@ -105,7 +153,13 @@ def main() -> None:
                     help="timed executions per config after one warm step "
                     "(0 = compile-only, the round-3 behavior)")
     ap.add_argument("--out", default="RESULTS_pp_memory.json")
+    ap.add_argument("--interleaved-only", action="store_true",
+                    help="append the P=4 interleaved-vs-1f1b section to an "
+                    "existing --out file without re-running the main table")
     args = ap.parse_args()
+
+    if args.interleaved_only:
+        return interleaved_section(args)
 
     rows = []
     for n_micro in args.micro:
