@@ -200,19 +200,13 @@ def main() -> None:
         )
         state = TrainState.create(variables, sgd_init(variables["params"]))
         step = make_train_step(model, mesh)
-        # Warmup / compile.  Synchronize via a scalar *value fetch*: on
-        # tunneled platforms block_until_ready alone can return before the
-        # device queue drains, inflating throughput by orders of magnitude.
-        for _ in range(3):
-            state, metrics = step(state, device_batch, lr)
-        float(metrics["loss"])
-        iters = 20
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, metrics = step(state, device_batch, lr)
-        assert np.isfinite(float(metrics["loss"]))  # value fetch = flush
-        dt = time.perf_counter() - t0
-        return batch * iters / dt / jax.device_count()
+        # Warmup + timing with the shared value-fetch sync discipline
+        # (utils/benchstep.py): on tunneled platforms block_until_ready
+        # alone can return before the device queue drains.
+        from pytorch_distributed_tpu.utils.benchstep import measure_train_step
+
+        dt, _ = measure_train_step(step, state, device_batch, lr, iters=20)
+        return batch / dt / jax.device_count()
 
     baseline = measure(fused=False)
     # Round-4 lever: the fused conv+BN backward (ops/fused_conv_bn.py).

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 import numpy as np
 
@@ -44,6 +43,11 @@ def bench_arch(arch: str):
     from pytorch_distributed_tpu.train.optim import sgd_init
     from pytorch_distributed_tpu.train.state import TrainState
     from pytorch_distributed_tpu.train.steps import make_train_step
+
+    from pytorch_distributed_tpu.utils.benchstep import (
+        looks_like_oom,
+        measure_train_step,
+    )
 
     image = 299 if arch == "inception_v3" else 224
     mesh = data_parallel_mesh()
@@ -69,25 +73,20 @@ def bench_arch(arch: str):
                     variables["params"]))
             state = TrainState.create(variables, sgd_init(variables["params"]))
             step = make_train_step(model, mesh)
-            lr = jnp.float32(0.1)
-            for _ in range(3):
-                state, metrics = step(state, device_batch, lr)
-            float(metrics["loss"])  # value fetch = the only reliable barrier
-            t0 = time.perf_counter()
-            for _ in range(ITERS):
-                state, metrics = step(state, device_batch, lr)
-            assert np.isfinite(float(metrics["loss"]))
-            dt = time.perf_counter() - t0
+            dt, _ = measure_train_step(
+                step, state, device_batch, jnp.float32(0.1), iters=ITERS)
             return {
                 "img_per_sec_per_chip": round(
-                    batch * ITERS / dt / jax.device_count(), 1),
-                "ms_per_step": round(dt / ITERS * 1e3, 2),
+                    batch / dt / jax.device_count(), 1),
+                "ms_per_step": round(dt * 1e3, 2),
                 "batch": batch,
                 "image": image,
                 "params_m": round(n_params / 1e6, 1),
             }
-        except Exception as e:  # noqa: BLE001 — halve the batch and retry
-            last_err = e
+        except Exception as e:  # noqa: BLE001
+            if not looks_like_oom(e):
+                raise  # deterministic failure — halving cannot fix it
+            last_err = e  # OOM/VMEM: halve the batch and retry
     raise RuntimeError(f"{arch} failed at every batch: {last_err!r}")
 
 
@@ -120,9 +119,11 @@ def main() -> int:
             },
             "configs": results,
         }
-        with open(path, "w") as f:
+        tmp = path + ".tmp"  # atomic: a mid-write kill must not eat rows
+        with open(tmp, "w") as f:
             json.dump(out, f, indent=1)
             f.write("\n")
+        os.replace(tmp, path)
 
     for arch in ARCHS:
         if arch in results:

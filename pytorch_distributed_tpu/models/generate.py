@@ -171,3 +171,19 @@ def greedy_generate(params, prompt, max_new_tokens, **kw):
         )
     kw.pop("temperature", None)
     return generate(params, prompt, max_new_tokens, temperature=0.0, **kw)
+
+
+def tp_generate(params, prompt, max_new_tokens, *, mesh, **kw):
+    """Model-parallel decode: Megatron-sharded params over ``mesh``'s
+    ``model`` axis (qkv/fc1 column-, proj/fc2 row-parallel, vocab-sharded
+    embedding — ``parallel/tp.py``), same compiled prefill+scan program.
+    XLA places the two per-block all-reduces and propagates head-sharding
+    into the KV caches, so decode state is sharded too — the serving-side
+    counterpart of TP training, for models too big for one chip.
+
+    ``jit`` specializes on the committed input shardings, so TP and
+    single-device calls coexist in the program cache."""
+    from pytorch_distributed_tpu.parallel.tp import shard_pytree, tp_specs
+
+    sharded = shard_pytree(params, tp_specs(params), mesh)
+    return generate(sharded, prompt, max_new_tokens, **kw)

@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--precision", choices=("fp32", "bf16"), default="fp32")
+    p.add_argument("--tp", type=int, default=1,
+                   help="model-parallel decode over this many devices "
+                        "(Megatron-sharded params + KV caches)")
     return p
 
 
@@ -99,11 +102,18 @@ def main(argv=None) -> int:
               f"arch {meta.get('arch') or 'transformer_lm'})")
 
     prompt = jnp.asarray(_encode_prompt(args))
-    out = generate(
-        params, prompt, args.max_new_tokens, **cfg, dtype=dtype,
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        seed=args.seed,
-    )
+    sample_kw = dict(cfg, dtype=dtype, temperature=args.temperature,
+                     top_k=args.top_k, top_p=args.top_p, seed=args.seed)
+    if args.tp > 1:
+        from pytorch_distributed_tpu.models.generate import tp_generate
+        from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+
+        mesh = build_mesh(MeshSpec(("model",), (args.tp,)),
+                          jax.devices()[:args.tp])
+        out = tp_generate(params, prompt, args.max_new_tokens, mesh=mesh,
+                          **sample_kw)
+    else:
+        out = generate(params, prompt, args.max_new_tokens, **sample_kw)
     toks = np.asarray(out)[0].tolist()
     print("tokens:", toks)
     if args.vocab >= 256 and args.prompt:

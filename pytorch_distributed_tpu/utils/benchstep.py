@@ -1,0 +1,46 @@
+"""Shared measurement harness for train-step throughput benchmarks.
+
+One implementation of the tunneled-platform timing discipline used by
+``bench.py`` (the driver headline) and ``experiments/arch_bench.py`` (the
+zoo table), so the two can never drift apart on the subtle part: on the
+tunneled axon backend ``block_until_ready`` can return before the device
+queue drains, so a scalar VALUE FETCH is the only reliable barrier — the
+warmup ends with ``float(metrics["loss"])`` and the timed loop closes with
+an isfinite assert on the same fetch (see ``scripts/benchlib.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+
+def measure_train_step(step, state, device_batch, lr,
+                       iters: int = 20, warmup: int = 3) -> Tuple[float, object]:
+    """Seconds per compiled train-step call, value-fetch synchronized.
+
+    ``step(state, device_batch, lr) -> (state, metrics)`` with a scalar
+    ``metrics["loss"]``.  Returns ``(sec_per_step, final_state)``; raises
+    AssertionError if the final loss is not finite.
+    """
+    import numpy as np
+
+    for _ in range(warmup):
+        state, metrics = step(state, device_batch, lr)
+    float(metrics["loss"])  # barrier: drain the queue before t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, device_batch, lr)
+    assert np.isfinite(float(metrics["loss"]))  # value fetch = flush
+    dt = (time.perf_counter() - t0) / iters
+    return dt, state
+
+
+def looks_like_oom(err: BaseException) -> bool:
+    """Heuristic: is this a memory/VMEM-capacity failure a smaller batch
+    could fix (vs a deterministic error retrying cannot)?"""
+    text = f"{type(err).__name__}: {err}"
+    needles = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+               "OOM", "Attempting to allocate", "vmem", "VMEM",
+               "exceeds the limit", "Ran out of memory")
+    return any(n in text for n in needles)

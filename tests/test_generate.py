@@ -158,3 +158,19 @@ def test_topk_nucleus_fast_path_matches_full_sort():
         fast = generate(params, prompt, 8, **CFG, temperature=1.3,
                         top_k=CFG["vocab_size"], top_p=0.8, seed=seed)
         np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
+
+
+def test_tp_generate_matches_single_device():
+    """Model-parallel decode (Megatron-sharded params over a 4-way model
+    axis) must produce the same greedy stream as single-device generate."""
+    from pytorch_distributed_tpu.models.generate import tp_generate
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+
+    params = _trained_params(seed=8)
+    rng = np.random.default_rng(8)
+    prompt = jnp.asarray(rng.integers(0, 64, size=(2, 5)).astype(np.int32))
+
+    want = greedy_generate(params, prompt, 6, **CFG)
+    mesh = build_mesh(MeshSpec(("model",), (4,)), jax.devices()[:4])
+    got = tp_generate(params, prompt, 6, mesh=mesh, **CFG)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -115,3 +115,44 @@ def test_telemetry_reports_real_bytes_without_memory_stats(tmp_path):
     # peak tracks at least the current in-use
     assert all(r[4] >= r[3] or r[2] > 0 for r in rows)
     del keep
+
+
+def test_measure_train_step_and_oom_heuristic():
+    """Shared bench harness (utils/benchstep.py): measures a real compiled
+    step with the value-fetch barrier; the OOM heuristic separates
+    capacity failures (halve and retry) from deterministic ones."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+    from pytorch_distributed_tpu.utils.benchstep import (
+        looks_like_oom,
+        measure_train_step,
+    )
+
+    mesh = data_parallel_mesh()
+    rng = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(rng.normal(size=(8, 32, 32, 3)),
+                              dtype=jnp.float32),
+        "labels": jnp.asarray(rng.integers(0, 10, size=8).astype(np.int32)),
+        "weights": jnp.ones((8,), jnp.float32),
+    }
+    model = models.create_model("squeezenet1_1", num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3)), train=False)
+    state = TrainState.create(variables, sgd_init(variables["params"]))
+    step = make_train_step(model, mesh)
+    dt, new_state = measure_train_step(step, state, batch, jnp.float32(0.1),
+                                       iters=2, warmup=1)
+    assert dt > 0
+    assert int(new_state.step) == 3  # warmup + timed iters all executed
+
+    assert looks_like_oom(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert looks_like_oom(MemoryError("Out of memory allocating 1GB"))
+    assert not looks_like_oom(ValueError("unknown arch 'resnet999'"))
