@@ -10,9 +10,10 @@ discipline as bench.py, minus the resnet50-specific space-to-depth stem so
 every row is the arch's *default* config (the tuned resnet50 headline lives
 in BENCH_*.json).
 
-Per-arch global batch starts at 256 and halves on OOM/compile failure —
-the fallback batch is recorded in the row.  Inception runs its canonical
-299 input; everything else 224.
+Per-arch global batch starts at 256 and halves on OOM/VMEM-capacity
+failure (deterministic errors fail the arch immediately); the fallback
+batch is recorded in the row.  Inception runs its canonical 299 input;
+everything else 224.
 
 Run on the TPU chip:
     PYTHONPATH=/root/repo:/root/.axon_site python experiments/arch_bench.py

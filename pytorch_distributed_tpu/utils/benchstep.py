@@ -27,7 +27,8 @@ def measure_train_step(step, state, device_batch, lr,
 
     for _ in range(warmup):
         state, metrics = step(state, device_batch, lr)
-    float(metrics["loss"])  # barrier: drain the queue before t0
+    if warmup:
+        float(metrics["loss"])  # barrier: drain the queue before t0
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, device_batch, lr)

@@ -415,3 +415,66 @@ def test_two_process_lm_pretrain(tmp_path, tp):
     # Exactly one rank wrote the checkpoint.
     files = sorted(p.name for p in ckpt_dir.iterdir())
     assert files.count("checkpoint.msgpack") == 1, files
+
+
+_TP_GENERATE_WORKER = textwrap.dedent(
+    """
+    import os, sys, json
+    pid = sys.argv[1]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["PTD_TPU_COORDINATOR"] = "127.0.0.1:%(port)d"
+    os.environ["PTD_TPU_NUM_PROCESSES"] = "2"
+    os.environ["PTD_TPU_PROCESS_ID"] = pid
+    sys.path.insert(0, %(repo)r)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from pytorch_distributed_tpu.parallel import initialize
+    ctx = initialize()
+    assert ctx.process_count == 2
+
+    import numpy as np
+    import jax.numpy as jnp
+    from pytorch_distributed_tpu.models.generate import (
+        generate, tp_generate,
+    )
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+
+    CFG = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2)
+    model = TransformerLM(**CFG)
+    params = model.init(jax.random.PRNGKey(3),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, 64, size=(2, 5)).astype(np.int32))
+
+    # Cross-process model axis: each process holds one member of the TP
+    # pair — the multi-host serving layout, LIVE (params identical on
+    # both ranks, device_put places only the addressable half).
+    mesh = build_mesh(MeshSpec(("model",), (2,)), jax.devices())
+    got = tp_generate(params, prompt, 6, mesh=mesh, **CFG)
+    # Replicate the (tiny) token array so every process holds all shards.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = jax.jit(lambda x: x,
+                  out_shardings=NamedSharding(mesh, P()))(got)
+    toks = np.asarray(rep)
+    # oracle: the same decode on this rank's local device alone
+    want = np.asarray(generate(params, prompt, 6, **CFG))
+    print("TOKENS", pid, json.dumps(toks.reshape(-1).tolist()), flush=True)
+    print("ORACLE", pid, json.dumps(want.reshape(-1).tolist()), flush=True)
+    """
+)
+
+
+def test_two_process_tp_generate(tmp_path):
+    """Model-parallel decode with the TP pair split ACROSS processes:
+    both ranks run one global program and produce the single-device
+    oracle's greedy stream."""
+    import json
+
+    outs = _run_workers(tmp_path, _TP_GENERATE_WORKER, 2)
+    toks = _parse(outs, "TOKENS")
+    oracle = _parse(outs, "ORACLE")
+    assert set(toks) == {0, 1}
+    assert toks[0] == toks[1]
+    assert json.loads(toks[0]) == json.loads(oracle[0]) == json.loads(
+        oracle[1])
