@@ -71,28 +71,42 @@ def main() -> int:
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     param_bytes = 2 * n_params  # decode streams the bf16 copy
 
+    from pytorch_distributed_tpu.models.quant import quantize_lm_params
+
+    qparams = jax.device_put(quantize_lm_params(params))
+    # Streamed bytes: int8 kernels as-is; every fp leaf streams as the
+    # bf16 compute copy (the f32->bf16 cast is hoisted out of the scan).
+    q_bytes = sum(
+        x.size * (1 if x.dtype == jnp.int8 else 2)
+        for x in jax.tree_util.tree_leaves(qparams))
+
     results = {}
-    for batch, sampling in ((1, "greedy"), (8, "greedy"), (32, "greedy"),
-                            (8, "topk50_topp0.9")):
+    for batch, sampling, quant in (
+            (1, "greedy", ""), (8, "greedy", ""), (32, "greedy", ""),
+            (8, "topk50_topp0.9", ""),
+            (1, "greedy", "int8"), (8, "greedy", "int8")):
         prompt = jnp.asarray(
             rng.integers(0, VOCAB, size=(batch, PROMPT)).astype(np.int32))
-        kw = dict(cfg, dtype=jnp.bfloat16)
+        kw = dict(cfg, dtype=jnp.bfloat16, quant=quant)
         if sampling != "greedy":
             kw.update(temperature=1.0, top_k=50, top_p=0.9)
-        tag = f"b{batch}_p{PROMPT}_{sampling}"
+        tag = f"b{batch}_p{PROMPT}_{sampling}" + ("_int8w" if quant else "")
+        p = qparams if quant else params
         try:
-            t1 = _time(lambda: generate(params, prompt, 1, **kw), REPS)
-            tn = _time(lambda: generate(params, prompt, NEW, **kw), REPS)
+            t1 = _time(lambda: generate(p, prompt, 1, **kw), REPS)
+            tn = _time(lambda: generate(p, prompt, NEW, **kw), REPS)
         except Exception as e:  # noqa: BLE001 — record per-config OOM/abort
             print(f"{tag}: FAILED {repr(e)[:200]}", flush=True)
             continue
         per_tok = (tn - t1) / max(NEW - 1, 1)
         toks_per_s = batch / per_tok
-        # Per-step HBM floor: full bf16 params + the mean-filled KV cache
-        # (k and v, bf16) for every sequence in the batch.
+        # Per-step HBM floor: the streamed parameter bytes (bf16, or the
+        # int8 tree's actual footprint) + the mean-filled KV cache (k and
+        # v, bf16) for every sequence in the batch.
         mean_ctx = PROMPT + NEW / 2
         kv_bytes = 2 * N_LAYERS * batch * mean_ctx * D_MODEL * 2
-        floor_s = (param_bytes + kv_bytes) / (HBM_GBPS * 1e9)
+        stream_bytes = q_bytes if quant else param_bytes
+        floor_s = (stream_bytes + kv_bytes) / (HBM_GBPS * 1e9)
         results[tag] = {
             "prefill_plus_1tok_ms": round(t1 * 1e3, 2),
             "per_token_ms": round(per_tok * 1e3, 3),

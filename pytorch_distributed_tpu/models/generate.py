@@ -34,6 +34,7 @@ def _make_run(
     temperature: float,
     top_k: int,
     top_p: float,
+    quant: str = "",
 ):
     """Build (and cache) the compiled prefill+decode program.
 
@@ -46,7 +47,7 @@ def _make_run(
     model = TransformerLM(
         vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
         n_layers=n_layers, dtype=jnp.dtype(dtype), attn_impl="dense",
-        decode=True, max_len=P + max_new_tokens,
+        decode=True, max_len=P + max_new_tokens, quant=quant,
     )
 
     # Zeroed cache built from abstract shapes only — no throwaway forward
@@ -141,6 +142,7 @@ def generate(
     top_k: int = 0,
     top_p: float = 0.0,
     seed: int = 0,
+    quant: str = "",
 ) -> jnp.ndarray:
     """Decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
 
@@ -157,7 +159,7 @@ def generate(
     run = _make_run(
         B, P, max_new_tokens, vocab_size, d_model, n_heads, n_layers,
         jnp.dtype(dtype).name,
-        float(temperature), int(top_k), float(top_p),
+        float(temperature), int(top_k), float(top_p), quant,
     )
     return run(params, prompt, jax.random.PRNGKey(seed))
 

@@ -1,0 +1,96 @@
+"""Int8 weight-only quantization for LM serving.
+
+Batch-1 decode is bound by the parameter HBM stream (measured 72% of the
+params+KV roofline, RESULTS_decode.json), so halving the bytes the chip
+reads per token is the one lever that moves it: block Dense kernels are
+stored int8 (per-output-channel symmetric scales, f32) and dequantized on
+the fly — XLA fuses the int8→bf16 convert into the matmul's operand load,
+so HBM sees int8 while the MXU still computes in bf16.  Embedding/head and
+norms stay full precision (the embedding doubles as the tied output head;
+its lookup is a gather, not a streamed matmul).
+
+Post-training, weight-only: no calibration data needed, activations stay
+bf16.  ``quantize_lm_params`` converts a trained fp tree in one pass;
+``TransformerLM(quant="int8")`` consumes the converted tree (same scope
+names, ``kernel`` → ``w_q`` + ``scale``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+# Block Dense modules that stream the bulk of the parameter bytes per
+# decoded token (SelfAttention qkv/proj, MLP fc1/fc2 — models/transformer.py).
+QUANT_MODULES = ("qkv", "proj", "fc1", "fc2")
+
+
+class QuantDense(nn.Module):
+    """Dense over an int8 kernel with per-output-channel f32 scales.
+
+    ``y = (x @ w_q.astype(dtype)) * scale [+ bias]`` — numerically the
+    dequantized matmul, but the kernel lives in HBM as int8 (half the
+    bf16 bytes, a quarter of f32)."""
+
+    features: int
+    dtype: Any = jnp.float32
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        import jax
+
+        in_features = x.shape[-1]
+        w_q = self.param("w_q", nn.initializers.zeros,
+                         (in_features, self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        # Pin the dequant next to the matmul: the astype is loop-invariant
+        # inside the decode scan, and hoisting it would materialize a bf16
+        # copy of every kernel in HBM — exactly the 2x parameter stream
+        # this module exists to remove.  The barrier keeps the int8->bf16
+        # convert fused into the matmul's operand load.
+        w_q = jax.lax.optimization_barrier(w_q)
+        y = jnp.dot(x.astype(self.dtype), w_q.astype(self.dtype))
+        y = y * scale.astype(y.dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+def quantize_kernel(kernel) -> tuple:
+    """``[in, out]`` fp kernel → (int8 ``w_q``, f32 per-out-channel scale)."""
+    w = np.asarray(kernel, np.float32)
+    scale = np.abs(w).max(axis=0) / 127.0
+    scale = np.where(scale == 0.0, 1.0, scale)  # all-zero channels
+    w_q = np.clip(np.round(w / scale[None, :]), -127, 127).astype(np.int8)
+    return jnp.asarray(w_q), jnp.asarray(scale.astype(np.float32))
+
+
+def quantize_lm_params(params):
+    """Convert a trained TransformerLM ``params`` tree for ``quant="int8"``.
+
+    Every ``kernel`` under a ``QUANT_MODULES`` scope becomes ``w_q`` +
+    ``scale`` (bias, norms, embedding untouched); the result matches the
+    param structure ``TransformerLM(quant="int8")`` initializes."""
+
+    def walk(tree, name):
+        if not isinstance(tree, dict):
+            return tree
+        if (name in QUANT_MODULES and "kernel" in tree
+                and getattr(tree["kernel"], "ndim", 0) == 2):
+            # The ndim guard skips MoE expert stacks ([E, in, out] kernels
+            # under the same fc1/fc2 scope names, models/moe.py) — experts
+            # stay fp; only plain block Dense kernels quantize.
+            w_q, scale = quantize_kernel(tree["kernel"])
+            out = {k: v for k, v in tree.items() if k != "kernel"}
+            out.update(w_q=w_q, scale=scale)
+            return out
+        return {k: walk(v, k) for k, v in tree.items()}
+
+    return walk(dict(params), "")

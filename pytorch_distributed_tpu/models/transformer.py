@@ -52,6 +52,17 @@ def _pick_attention(L: int, attn_impl: str):
     return pick_attention_impl(L, attn_impl)
 
 
+def _dense_cls(quant: str):
+    """nn.Dense, or the int8 weight-only variant (models/quant.py)."""
+    if not quant:
+        return nn.Dense
+    if quant == "int8":
+        from pytorch_distributed_tpu.models.quant import QuantDense
+
+        return QuantDense
+    raise ValueError(f"unknown quant mode {quant!r} (expected '' or 'int8')")
+
+
 class SelfAttention(nn.Module):
     n_heads: int
     dtype: Any = jnp.float32
@@ -61,12 +72,14 @@ class SelfAttention(nn.Module):
     decode: bool = False     # KV-cached autoregressive mode
     max_len: int = 0         # cache capacity (decode mode)
     sp_impl: str = "ring"    # ring | a2a (Ulysses-style all-to-all SP)
+    quant: str = ""          # "" | "int8" weight-only (serving)
 
     @nn.compact
     def __call__(self, x):
         B, L, C = x.shape
         D = C // self.n_heads
-        qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype, name="qkv")(x)
+        dense = _dense_cls(self.quant)
+        qkv = dense(3 * C, use_bias=False, dtype=self.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, L, self.n_heads, D)
         q, k, v = (t.reshape(shape) for t in (q, k, v))
@@ -93,7 +106,8 @@ class SelfAttention(nn.Module):
         else:
             out = dense_attention(q, k, v, causal=True)
         out = out.reshape(B, L, C)
-        return nn.Dense(C, use_bias=False, dtype=self.dtype, name="proj")(out)
+        return _dense_cls(self.quant)(
+            C, use_bias=False, dtype=self.dtype, name="proj")(out)
 
     def _decode_attend(self, q, k, v, B, L, C, D):
         """KV-cached attention: new tokens' k/v land in the cache at the
@@ -118,8 +132,8 @@ class SelfAttention(nn.Module):
         if initializing:
             q, k = rope(q), rope(k)
             out = dense_attention(q, k, v, causal=True).reshape(B, L, C)
-            return nn.Dense(C, use_bias=False, dtype=self.dtype,
-                            name="proj")(out)
+            return _dense_cls(self.quant)(
+                C, use_bias=False, dtype=self.dtype, name="proj")(out)
         idx = ci.value
         q = rope(q, offset=idx)
         k = rope(k, offset=idx)
@@ -140,7 +154,8 @@ class SelfAttention(nn.Module):
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", w, values.astype(jnp.float32)
         ).astype(q.dtype).reshape(B, L, C)
-        return nn.Dense(C, use_bias=False, dtype=self.dtype, name="proj")(out)
+        return _dense_cls(self.quant)(
+            C, use_bias=False, dtype=self.dtype, name="proj")(out)
 
 
 class Block(nn.Module):
@@ -154,6 +169,7 @@ class Block(nn.Module):
     decode: bool = False
     max_len: int = 0
     sp_impl: str = "ring"
+    quant: str = ""
 
     @nn.compact
     def __call__(self, x):
@@ -162,7 +178,7 @@ class Block(nn.Module):
         x = x + SelfAttention(self.n_heads, self.dtype, self.mesh, self.ring,
                               self.attn_impl, decode=self.decode,
                               max_len=self.max_len, sp_impl=self.sp_impl,
-                              name="attn")(h)
+                              quant=self.quant, name="attn")(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.moe_experts > 0:
             from pytorch_distributed_tpu.models.moe import MoEMLP
@@ -170,9 +186,10 @@ class Block(nn.Module):
             h = MoEMLP(self.moe_experts, dtype=self.dtype,
                        top_k=self.moe_top_k, name="moe")(h)
         else:
-            h = nn.Dense(4 * C, dtype=self.dtype, name="fc1")(h)
+            dense = _dense_cls(self.quant)
+            h = dense(4 * C, dtype=self.dtype, name="fc1")(h)
             h = nn.gelu(h)
-            h = nn.Dense(C, dtype=self.dtype, name="fc2")(h)
+            h = dense(C, dtype=self.dtype, name="fc2")(h)
         return x + h
 
 
@@ -195,6 +212,8 @@ class TransformerLM(nn.Module):
     decode: bool = False  # KV-cached autoregressive inference mode
     max_len: int = 0      # cache capacity (decode mode)
     sp_impl: str = "ring"  # ring | a2a (Ulysses-style; parallel/ulysses.py)
+    quant: str = ""        # "" | "int8" weight-only block kernels (serving;
+    #                        params from models/quant.py:quantize_lm_params)
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -206,7 +225,8 @@ class TransformerLM(nn.Module):
             x = block_cls(self.n_heads, self.dtype, self.mesh, self.ring,
                           self.attn_impl, self.moe_experts, self.moe_top_k,
                           decode=self.decode, max_len=self.max_len,
-                          sp_impl=self.sp_impl, name=f"block_{i}")(x)
+                          sp_impl=self.sp_impl, quant=self.quant,
+                          name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied output head (embed.attend) keeps params lean at long context.
         return embed.attend(x.astype(jnp.float32)).astype(jnp.float32)

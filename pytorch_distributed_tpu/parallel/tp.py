@@ -34,9 +34,15 @@ _ROW_PARALLEL = ("proj", "fc2")
 
 
 def transformer_tp_spec(path: tuple, leaf, model_axis: str = "model") -> P:
-    """PartitionSpec for one TransformerLM parameter, by its tree path."""
+    """PartitionSpec for one TransformerLM parameter, by its tree path.
+
+    Covers both the fp tree (``kernel``) and the int8 weight-only serving
+    tree (``w_q`` + per-output-channel ``scale``, models/quant.py): ``w_q``
+    shards exactly like ``kernel``; ``scale`` follows the OUTPUT dim, so it
+    shards with column-parallel modules and replicates with row-parallel
+    ones."""
     names = [getattr(k, "key", str(k)) for k in path]
-    is_kernel = names[-1] == "kernel"
+    is_kernel = names[-1] in ("kernel", "w_q")
     module = names[-2] if len(names) >= 2 else ""
     if names[-1] == "embedding":
         return P(model_axis, None)  # vocab-sharded (tied head stays sharded)
@@ -44,7 +50,9 @@ def transformer_tp_spec(path: tuple, leaf, model_axis: str = "model") -> P:
         return P(None, model_axis)
     if is_kernel and module in _ROW_PARALLEL:
         return P(model_axis, None)
-    return P()  # norms, biases: replicated
+    if names[-1] == "scale" and module in _COLUMN_PARALLEL:
+        return P(model_axis)
+    return P()  # norms, biases, row-parallel scales: replicated
 
 
 def tp_specs(params: Pytree, model_axis: str = "model") -> Pytree:

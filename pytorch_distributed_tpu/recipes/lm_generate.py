@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1,
                    help="model-parallel decode over this many devices "
                         "(Megatron-sharded params + KV caches)")
+    p.add_argument("--quant", choices=("", "int8"), default="",
+                   help="int8 = weight-only quantized block kernels "
+                        "(halves the parameter HBM stream that bounds "
+                        "small-batch decode)")
     return p
 
 
@@ -101,9 +105,15 @@ def main(argv=None) -> int:
         print(f"loaded {args.resume} (epoch {meta.get('epoch')}, "
               f"arch {meta.get('arch') or 'transformer_lm'})")
 
+    if args.quant:
+        from pytorch_distributed_tpu.models.quant import quantize_lm_params
+
+        params = quantize_lm_params(params)
+
     prompt = jnp.asarray(_encode_prompt(args))
     sample_kw = dict(cfg, dtype=dtype, temperature=args.temperature,
-                     top_k=args.top_k, top_p=args.top_p, seed=args.seed)
+                     top_k=args.top_k, top_p=args.top_p, seed=args.seed,
+                     quant=args.quant)
     if args.tp > 1:
         from pytorch_distributed_tpu.models.generate import tp_generate
         from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
