@@ -35,6 +35,7 @@ def _make_run(
     top_k: int,
     top_p: float,
     quant: str = "",
+    flash_prefill: bool = False,
 ):
     """Build (and cache) the compiled prefill+decode program.
 
@@ -48,6 +49,7 @@ def _make_run(
         vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
         n_layers=n_layers, dtype=jnp.dtype(dtype), attn_impl="dense",
         decode=True, max_len=P + max_new_tokens, quant=quant,
+        flash_prefill=flash_prefill,
     )
 
     # Zeroed cache built from abstract shapes only — no throwaway forward
@@ -143,6 +145,7 @@ def generate(
     top_p: float = 0.0,
     seed: int = 0,
     quant: str = "",
+    flash_prefill: "bool | None" = None,
 ) -> jnp.ndarray:
     """Decode ``max_new_tokens`` continuations of ``prompt [B, P]``.
 
@@ -156,10 +159,23 @@ def generate(
     one executable.
     """
     B, P = prompt.shape
+    if flash_prefill is None:
+        # generate() prefills the prompt as ONE block at cache index 0 —
+        # exactly the flash_prefill contract — so long, aligned prompts
+        # take the fused kernel (no O(P·max_len) dense score tensor)
+        # under the shared auto policy.  Callers running the program
+        # SHARDED (tp_generate) pass False: the Pallas call has no SPMD
+        # partitioning rule.
+        from pytorch_distributed_tpu.ops.flash_attention import (
+            pick_attention_impl,
+        )
+
+        flash_prefill = pick_attention_impl(P, "auto") == "flash"
     run = _make_run(
         B, P, max_new_tokens, vocab_size, d_model, n_heads, n_layers,
         jnp.dtype(dtype).name,
         float(temperature), int(top_k), float(top_p), quant,
+        bool(flash_prefill),
     )
     return run(params, prompt, jax.random.PRNGKey(seed))
 
@@ -188,4 +204,7 @@ def tp_generate(params, prompt, max_new_tokens, *, mesh, **kw):
     from pytorch_distributed_tpu.parallel.tp import shard_pytree, tp_specs
 
     sharded = shard_pytree(params, tp_specs(params), mesh)
+    # The Pallas prefill kernel has no SPMD partitioning rule — keep the
+    # sharded program on the dense prefill path (GSPMD partitions it).
+    kw.setdefault("flash_prefill", False)
     return generate(sharded, prompt, max_new_tokens, **kw)

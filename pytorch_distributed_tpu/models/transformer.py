@@ -73,6 +73,7 @@ class SelfAttention(nn.Module):
     max_len: int = 0         # cache capacity (decode mode)
     sp_impl: str = "ring"    # ring | a2a (Ulysses-style all-to-all SP)
     quant: str = ""          # "" | "int8" weight-only (serving)
+    flash_prefill: bool = False  # fused-kernel prompt prefill (decode mode)
 
     @nn.compact
     def __call__(self, x):
@@ -142,6 +143,20 @@ class SelfAttention(nn.Module):
         cv.value = jax.lax.dynamic_update_slice(
             cv.value, v.astype(cv.value.dtype), (0, idx, 0, 0))
         ci.value = idx + L
+        if L > 1 and self.flash_prefill:
+            # Prefill via the fused kernel.  OPT-IN (generate() sets it):
+            # assumes a multi-token block only arrives as THE prompt at
+            # cache index 0 — then causal attention within the block is
+            # the whole answer, no O(L·max_len) dense score tensor.
+            # Chunked-prefill callers must leave this off: a later chunk
+            # needs the masked cache attention below.
+            from pytorch_distributed_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            out = flash_attention(q, k, v, True).reshape(B, L, C)
+            return _dense_cls(self.quant)(
+                C, use_bias=False, dtype=self.dtype, name="proj")(out)
         keys, values = ck.value, cv.value                 # [B, Lmax, H, D]
         scores = jnp.einsum(
             "bqhd,bkhd->bhqk", q.astype(jnp.float32),
@@ -170,6 +185,7 @@ class Block(nn.Module):
     max_len: int = 0
     sp_impl: str = "ring"
     quant: str = ""
+    flash_prefill: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -178,7 +194,9 @@ class Block(nn.Module):
         x = x + SelfAttention(self.n_heads, self.dtype, self.mesh, self.ring,
                               self.attn_impl, decode=self.decode,
                               max_len=self.max_len, sp_impl=self.sp_impl,
-                              quant=self.quant, name="attn")(h)
+                              quant=self.quant,
+                              flash_prefill=self.flash_prefill,
+                              name="attn")(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.moe_experts > 0:
             from pytorch_distributed_tpu.models.moe import MoEMLP
@@ -214,6 +232,8 @@ class TransformerLM(nn.Module):
     sp_impl: str = "ring"  # ring | a2a (Ulysses-style; parallel/ulysses.py)
     quant: str = ""        # "" | "int8" weight-only block kernels (serving;
     #                        params from models/quant.py:quantize_lm_params)
+    flash_prefill: bool = False  # decode mode: fused-kernel prompt prefill
+    #                              (single-block prompts only — generate())
 
     @nn.compact
     def __call__(self, tokens, train: bool = True):
@@ -226,6 +246,7 @@ class TransformerLM(nn.Module):
                           self.attn_impl, self.moe_experts, self.moe_top_k,
                           decode=self.decode, max_len=self.max_len,
                           sp_impl=self.sp_impl, quant=self.quant,
+                          flash_prefill=self.flash_prefill,
                           name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied output head (embed.attend) keeps params lean at long context.

@@ -7,6 +7,10 @@ cd /root/repo || exit 1
 mkdir -p runs
 LOG=runs/tunnel_watch.log
 want=${ARCH_WATCH_WANT:-13}
+# Fresh retry budget per watcher launch: the cap separates deterministic
+# failures within ONE session from transient tunnel deaths; it must not
+# outlive the session that observed them.
+rm -f runs/decode_bench.tries
 for i in $(seq 1 300); do
   # Count every recorded row, error rows included: a deterministically
   # failing arch is a final answer, not a reason to re-run forever.
@@ -22,11 +26,15 @@ PY
 import json
 try:
     d = json.load(open("RESULTS_decode.json"))["configs"]
-    print(1 if any(k.endswith("_int8w") for k in d) else 0)
+    # BOTH promised int8 rows (a partial capture is not done).
+    keys = {k for k in d if k.endswith("_int8w")}
+    print(1 if {"b1_p512_greedy_int8w", "b8_p512_greedy_int8w"} <= keys
+          else 0)
 except Exception:
     print(0)
 PY
 )
+  [ "${quant_done:-0}" = "1" ] && rm -f runs/decode_bench.tries
   tries_now=$(cat runs/decode_bench.tries 2>/dev/null || echo 0)
   if [ "${have:-0}" -ge "$want" ] && { [ "${quant_done:-0}" = "1" ] || [ "$tries_now" -ge 3 ]; }; then
     echo "$(date -u +%H:%M:%S) captures finished (int8 ok=$quant_done tries=$tries_now)" >> "$LOG"

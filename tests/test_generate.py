@@ -174,3 +174,41 @@ def test_tp_generate_matches_single_device():
     mesh = build_mesh(MeshSpec(("model",), (4,)), jax.devices()[:4])
     got = tp_generate(params, prompt, 6, mesh=mesh, **CFG)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_flash_prefill_matches_dense_prefill():
+    """Decode-mode prompt prefill through the fused kernel (interpret on
+    CPU) must produce the same logits and the same cache as the masked
+    dense-over-cache path."""
+    params = _trained_params(seed=9)
+    rng = np.random.default_rng(9)
+    P, EXTRA = 256, 4
+    tokens = jnp.asarray(rng.integers(0, 64, size=(2, P)).astype(np.int32))
+
+    outs, caches = [], []
+    for fp in (False, True):
+        model = TransformerLM(**CFG, decode=True, max_len=P + EXTRA,
+                              flash_prefill=fp)
+        cache = model.init(jax.random.PRNGKey(0), tokens)["cache"]
+        out, mut = model.apply({"params": params, "cache": cache},
+                               tokens, mutable=["cache"])
+        outs.append(np.asarray(out))
+        caches.append(mut["cache"])
+    np.testing.assert_allclose(outs[1], outs[0], rtol=2e-4, atol=2e-4)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(caches[0]),
+            jax.tree_util.tree_leaves_with_path(caches[1])):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=jax.tree_util.keystr(pa))
+    # incremental steps after a flash prefill continue correctly
+    model = TransformerLM(**CFG, decode=True, max_len=P + EXTRA,
+                          flash_prefill=True)
+    ref = TransformerLM(**CFG, decode=True, max_len=P + EXTRA)
+    nxt = jnp.asarray(rng.integers(0, 64, size=(2, 1)).astype(np.int32))
+    o1, _ = model.apply({"params": params, "cache": caches[1]}, nxt,
+                        mutable=["cache"])
+    o0, _ = ref.apply({"params": params, "cache": caches[0]}, nxt,
+                      mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o0),
+                               rtol=2e-4, atol=2e-4)
