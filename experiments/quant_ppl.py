@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Accuracy cost of int8 weight-only quantization, measured on real text.
+
+The serving-side counterpart of RESULTS_lm_text.json: train the byte-LM on
+the in-repo corpus, then score the SAME held-out windows with the fp
+params and with the int8-quantized tree (models/quant.py) through one
+shared eval implementation.  The deliverable is the perplexity delta —
+the number a user trades for halving the decode parameter stream.
+
+Writes ``RESULTS_quant_ppl.json``.  Run (CPU 8-device mesh, ~10 min):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    PYTHONPATH=/root/repo python experiments/quant_ppl.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import jax
+
+if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+SEQ = int(os.environ.get("QUANTPPL_SEQ", "256"))
+D_MODEL = int(os.environ.get("QUANTPPL_D", "128"))
+STEPS = int(os.environ.get("QUANTPPL_STEPS", "300"))
+BATCH = 16
+EVAL_BATCHES = int(os.environ.get("QUANTPPL_EVAL_BATCHES", "8"))
+
+
+def eval_ppl(model, params, ds, n_batches: int) -> float:
+    """Mean held-out token perplexity — one implementation for both trees."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    total_nll, total_tok = 0.0, 0
+    for b in range(n_batches):
+        idx = [(b * BATCH + i) % len(ds) for i in range(BATCH)]
+        win = np.stack([np.asarray(ds[i]) for i in idx])  # [B, SEQ] bytes
+        # (SEQ-byte windows ⇒ SEQ-1 scored targets per window)
+        tokens = jnp.asarray(win[:, :-1].astype(np.int32))
+        targets = jnp.asarray(win[:, 1:].astype(np.int32))
+        logits = model.apply({"params": params}, tokens)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        total_nll += float(nll.sum())
+        total_tok += targets.size
+    return math.exp(total_nll / total_tok)
+
+
+def main() -> int:
+    from experiments.lm_text import corpus_paths
+    from pytorch_distributed_tpu.models.quant import quantize_lm_params
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.lm import (
+        LMTrainer,
+        TextFileDataset,
+        warmup_cosine_lr,
+    )
+
+    n = jax.device_count()
+    mesh = build_mesh(MeshSpec(("data",), (n,)))
+    paths = corpus_paths()
+    train_ds = TextFileDataset(paths, SEQ, span=(0.0, 0.9))
+    eval_ds = TextFileDataset(paths, SEQ, span=(0.9, 1.0))
+
+    cfg = dict(vocab_size=256, d_model=D_MODEL, n_heads=4, n_layers=2)
+    model = TransformerLM(**cfg)
+    with mesh:
+        trainer = LMTrainer(
+            model, mesh, train_ds, BATCH, lr=0.5,
+            lr_schedule=warmup_cosine_lr(0.5, max(10, STEPS // 20), STEPS),
+            clip_grad_norm=1.0,
+        )
+        trainer.fit(STEPS, print_freq=max(50, STEPS // 4))
+        params = jax.device_get(trainer.state.params)
+
+    fp_ppl = eval_ppl(TransformerLM(**cfg), params, eval_ds, EVAL_BATCHES)
+    q_ppl = eval_ppl(TransformerLM(**cfg, quant="int8"),
+                     quantize_lm_params(params), eval_ds, EVAL_BATCHES)
+    delta_pct = 100.0 * (q_ppl - fp_ppl) / fp_ppl
+    print(f"held-out ppl: fp {fp_ppl:.3f}  int8 {q_ppl:.3f}  "
+          f"delta {delta_pct:+.2f}%", flush=True)
+
+    out = {
+        "meta": {
+            "what": "held-out byte-LM perplexity, fp vs int8 weight-only "
+                    "(models/quant.py), same eval code and windows",
+            "corpus": "in-repo corpus (experiments/lm_text.py split)",
+            "model": {**cfg, "seq": SEQ},
+            "steps": STEPS, "batch": BATCH,
+            "eval_windows": EVAL_BATCHES * BATCH,
+            "note": "SEQ-byte windows => SEQ-1 scored targets per window "
+                    "(TextFileDataset returns SEQ bytes)",
+        },
+        "fp_ppl": round(fp_ppl, 3),
+        "int8_ppl": round(q_ppl, 3),
+        "delta_pct": round(delta_pct, 3),
+    }
+    with open(os.path.join(REPO, "RESULTS_quant_ppl.json"), "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print("wrote RESULTS_quant_ppl.json", flush=True)
+    # Weight-only int8 at per-channel scales should cost ~nothing; fail
+    # loudly if it doesn't, so the feature ships with a falsifiable claim.
+    assert q_ppl <= fp_ppl * 1.05, (fp_ppl, q_ppl)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
