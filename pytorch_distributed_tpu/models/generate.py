@@ -21,6 +21,52 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.models.transformer import TransformerLM
 
 
+def filter_logits(logits, temperature: float, top_k: int,
+                  top_p: float) -> jnp.ndarray:
+    """Temperature + top-k + nucleus filtering over ``[..., V]`` logits —
+    the module's SAMPLING DISTRIBUTION in logit form (f32, -inf outside
+    the kept set).  Shared by ``generate`` and speculative decoding, which
+    must agree exactly on p/q for the acceptance math to be lossless.
+
+    ``temperature`` must be > 0 here (greedy is the caller's argmax
+    fast path)."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        # lax.top_k returns values already sorted descending, so both
+        # the k-th-value threshold AND the nucleus cutoff come from the
+        # k-vector — no full-vocab argsort inside the decode scan
+        # (6.696 -> 1.761 ms/tok measured at b8 / vocab 32k).
+        vals = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0]
+        cut = vals[..., -1:]
+        if 0.0 < top_p < 1.0:
+            # Renormalized over the survivors (identical to softmaxing
+            # the -inf-masked full vocab), keep the smallest descending
+            # prefix reaching top_p mass; its last value is the cutoff.
+            probs = jax.nn.softmax(vals, axis=-1)
+            mass_before = jnp.cumsum(probs, axis=-1) - probs
+            kept = jnp.where(mass_before < top_p, vals, jnp.inf)
+            # NB: dropping by value threshold keeps ALL tokens tied at
+            # the cutoff (the full-sort path half-drops ties by sorted
+            # position) — matching the module's top-k tie convention.
+            cut = jnp.maximum(
+                cut, jnp.min(kept, axis=-1, keepdims=True))
+        return jnp.where(logits < cut, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # Nucleus: keep the smallest prefix (by descending probability)
+        # whose mass reaches top_p — i.e. drop tokens whose preceding
+        # cumulative mass already covers it.  Static shapes: sort +
+        # cumsum + gather back through the inverse permutation.
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_probs = jax.nn.softmax(
+            jnp.take_along_axis(logits, order, axis=-1), axis=-1)
+        mass_before = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
+        drop_sorted = mass_before >= top_p
+        inv = jnp.argsort(order, axis=-1)
+        drop = jnp.take_along_axis(drop_sorted, inv, axis=-1)
+        return jnp.where(drop, -jnp.inf, logits)
+    return logits
+
+
 @functools.lru_cache(maxsize=32)
 def _make_run(
     B: int,
@@ -62,40 +108,7 @@ def _make_run(
     def pick(logits, key):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        logits = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            # lax.top_k returns values already sorted descending, so both
-            # the k-th-value threshold AND the nucleus cutoff come from the
-            # k-vector — no full-vocab argsort inside the decode scan
-            # (6.696 -> 1.761 ms/tok measured at b8 / vocab 32k).
-            vals = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0]
-            cut = vals[..., -1:]
-            if 0.0 < top_p < 1.0:
-                # Renormalized over the survivors (identical to softmaxing
-                # the -inf-masked full vocab), keep the smallest descending
-                # prefix reaching top_p mass; its last value is the cutoff.
-                probs = jax.nn.softmax(vals, axis=-1)
-                mass_before = jnp.cumsum(probs, axis=-1) - probs
-                kept = jnp.where(mass_before < top_p, vals, jnp.inf)
-                # NB: dropping by value threshold keeps ALL tokens tied at
-                # the cutoff (the full-sort path half-drops ties by sorted
-                # position) — matching the module's top-k tie convention.
-                cut = jnp.maximum(
-                    cut, jnp.min(kept, axis=-1, keepdims=True))
-            logits = jnp.where(logits < cut, -jnp.inf, logits)
-        elif 0.0 < top_p < 1.0:
-            # Nucleus: keep the smallest prefix (by descending probability)
-            # whose mass reaches top_p — i.e. drop tokens whose preceding
-            # cumulative mass already covers it.  Static shapes: sort +
-            # cumsum + gather back through the inverse permutation.
-            order = jnp.argsort(-logits, axis=-1)
-            sorted_probs = jax.nn.softmax(
-                jnp.take_along_axis(logits, order, axis=-1), axis=-1)
-            mass_before = jnp.cumsum(sorted_probs, axis=-1) - sorted_probs
-            drop_sorted = mass_before >= top_p
-            inv = jnp.argsort(order, axis=-1)
-            drop = jnp.take_along_axis(drop_sorted, inv, axis=-1)
-            logits = jnp.where(drop, -jnp.inf, logits)
+        logits = filter_logits(logits, temperature, top_k, top_p)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     @jax.jit

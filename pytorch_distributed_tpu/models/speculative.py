@@ -1,0 +1,241 @@
+"""Speculative decoding: draft-model proposals, target-model rejection
+sampling (Leviathan et al. 2023 / Chen et al. 2023).
+
+Small-batch decode pays one full parameter stream per token
+(RESULTS_decode.json: b1 at 72% of the HBM roofline), so the only way
+past it at batch 1 is fewer target passes per token: a cheap draft model
+proposes ``gamma`` tokens autoregressively, the target scores the whole
+block in ONE cached forward (the masked cache attention handles L>1
+blocks at any index), and rejection sampling keeps the output distributed
+EXACTLY as target-only sampling:
+
+- accept draft token x_i with prob min(1, p_i(x_i)/q_i(x_i));
+- at the first rejection, emit a sample from norm(max(p_i − q_i, 0));
+- if all gamma survive, sample a bonus token from the target's last
+  distribution — up to gamma+1 tokens per target pass.
+
+p and q are the *post-filter* sampling distributions (shared
+``filter_logits``), so temperature/top-k/top-p compose losslessly.
+Greedy (temperature 0) uses one-hot p/q: the output equals the target's
+own greedy stream token-for-token, regardless of the draft — the test
+suite pins that.
+
+Cache bookkeeping: the target's scoring pass writes k/v for every
+proposed token; on a rejection at offset a we only rewind each layer's
+``cache_index`` to n+a — the stale k/v beyond it are overwritten before
+they can ever be attended (positions are rewritten before attention, and
+the causal mask hides everything past the current query block).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.models.generate import filter_logits
+from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+
+@functools.lru_cache(maxsize=64)
+def _make_block_apply(L: int, B: int, max_len: int, vocab_size: int,
+                      d_model: int, n_heads: int, n_layers: int,
+                      dtype_name: str, quant: str):
+    """Jitted cached-model application of an ``[B, L]`` token block:
+    returns (logits[B, L, V], new_cache).  One compiled program per block
+    length — speculative rounds reuse two of these (draft L=1, target
+    L=gamma+1) plus the prefill lengths."""
+    model = TransformerLM(
+        vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, dtype=jnp.dtype(dtype_name), attn_impl="dense",
+        decode=True, max_len=max_len, quant=quant,
+    )
+    cache_shapes = jax.eval_shape(
+        lambda p: model.init(jax.random.PRNGKey(0), p),
+        jax.ShapeDtypeStruct((B, L), jnp.int32),
+    )["cache"]
+
+    @jax.jit
+    def fresh_cache():
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+    @jax.jit
+    def apply(params, cache, tokens):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tokens, mutable=["cache"])
+        return logits, mut["cache"]
+
+    return fresh_cache, apply
+
+
+def _set_cache_index(cache, value):
+    """Rewind every layer's cache_index (stale k/v beyond it are dead —
+    rewritten before any query can attend to them)."""
+    val = jnp.asarray(value, jnp.int32)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (
+            val if getattr(path[-1], "key", "") == "cache_index" else leaf),
+        cache,
+    )
+
+
+def _accept(p: np.ndarray, q: np.ndarray, x: int, rng,
+            greedy: bool) -> bool:
+    """Accept draft token ``x`` with prob min(1, p(x)/q(x)).  Greedy
+    one-hots reduce exactly to argmax equality (no rng draw)."""
+    p_x, q_x = p[x], q[x]
+    if greedy:
+        return p_x > 0.0
+    return rng.uniform() < min(1.0, p_x / max(q_x, 1e-20))
+
+
+def _resample(p: np.ndarray, q: np.ndarray, rng, greedy: bool) -> int:
+    """Sample from the residual norm(max(p − q, 0)); degenerate p == q
+    falls back to p itself (the residual is then undefined 0/0)."""
+    resid = np.maximum(p - q, 0.0)
+    total = resid.sum()
+    if total <= 0:
+        resid, total = p, p.sum()
+    resid = resid / total
+    return int(np.argmax(resid)) if greedy else int(
+        rng.choice(len(resid), p=resid))
+
+
+def _dist(logits_row, temperature, top_k, top_p):
+    """[V] logits -> [V] probability vector of the ACTUAL sampling
+    distribution (one-hot argmax when greedy)."""
+    if temperature <= 0.0:
+        probs = np.zeros(logits_row.shape[-1], np.float64)
+        probs[int(np.argmax(logits_row))] = 1.0
+        return probs
+    filt = filter_logits(jnp.asarray(logits_row), temperature, top_k, top_p)
+    return np.asarray(jax.nn.softmax(filt, axis=-1), np.float64)
+
+
+def speculative_generate(
+    target_params,
+    draft_params,
+    prompt: jnp.ndarray,
+    max_new_tokens: int,
+    *,
+    target_cfg: dict,
+    draft_cfg: dict,
+    gamma: int = 4,
+    dtype: Any = jnp.float32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    seed: int = 0,
+    quant: str = "",
+    draft_quant: str = "",
+) -> Tuple[jnp.ndarray, dict]:
+    """Decode ``[B=1, P]`` prompt continuations with draft speculation.
+
+    ``target_cfg``/``draft_cfg``: dicts of vocab_size/d_model/n_heads/
+    n_layers (the two vocabularies must match).  Returns ``(tokens
+    [1, max_new_tokens] int32, stats)`` where stats records target passes
+    and the mean accepted-per-round — the speedup numerator.  Output is
+    distributed exactly as target-only sampling (greedy: identical
+    stream); randomness is driven by a seeded host RNG.
+    """
+    if target_cfg["vocab_size"] != draft_cfg["vocab_size"]:
+        raise ValueError("target and draft must share a vocabulary")
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative decode is batch-1 (serving latency)")
+    B, P = prompt.shape
+    V = target_cfg["vocab_size"]
+    max_len = P + max_new_tokens + gamma + 1  # scoring may overshoot
+    dt = jnp.dtype(dtype).name
+    rng = np.random.default_rng(seed)
+
+    def mk(cfg, L, q):
+        return _make_block_apply(
+            L, B, max_len, cfg["vocab_size"], cfg["d_model"],
+            cfg["n_heads"], cfg["n_layers"], dt, q)
+
+    t_fresh, t_prefill = mk(target_cfg, P, quant)
+    _, t_score = mk(target_cfg, gamma + 1, quant)
+    d_fresh, d_prefill = mk(draft_cfg, P, draft_quant)
+    _, d_step = mk(draft_cfg, 1, draft_quant)
+
+    # Prefill both caches; the target's last-position logits seed x_cur.
+    t_logits, t_cache = t_prefill(target_params, t_fresh(), prompt)
+    _, d_cache = d_prefill(draft_params, d_fresh(), prompt)
+    p0 = _dist(np.asarray(t_logits)[0, -1], temperature, top_k, top_p)
+    x_cur = int(rng.choice(V, p=p0)) if temperature > 0 else int(np.argmax(p0))
+
+    out = [x_cur]
+    n = P  # tokens whose k/v are final in both caches
+    target_passes = 1
+    accepted_hist = []
+    while len(out) < max_new_tokens:
+        g = min(gamma, max_new_tokens - len(out))
+        # keep ONE compiled score shape: pad the block with draft steps
+        # even when fewer are needed; extras are discarded.
+        # --- draft proposes gamma tokens (collect its q distributions)
+        d_tokens, q_dists = [], []
+        tok = x_cur
+        for _ in range(gamma):
+            dl, d_cache = d_step(
+                draft_params, d_cache, jnp.full((1, 1), tok, jnp.int32))
+            q = _dist(np.asarray(dl)[0, -1], temperature, top_k, top_p)
+            tok = int(rng.choice(V, p=q)) if temperature > 0 \
+                else int(np.argmax(q))
+            d_tokens.append(tok)
+            q_dists.append(q)
+        # --- target scores [x_cur, d_1..d_gamma] in one pass
+        block = jnp.asarray([[x_cur] + d_tokens], jnp.int32)
+        tl, t_cache = t_score(target_params, t_cache, block)
+        target_passes += 1
+        p_dists = [
+            _dist(np.asarray(tl)[0, i], temperature, top_k, top_p)
+            for i in range(gamma + 1)
+        ]
+        # --- rejection sampling
+        accepted = 0
+        for i in range(g):
+            x_i = d_tokens[i]
+            if not _accept(p_dists[i], q_dists[i], x_i, rng,
+                           greedy=temperature <= 0):
+                x_cur = _resample(p_dists[i], q_dists[i], rng,
+                                  greedy=temperature <= 0)
+                break
+            accepted += 1
+            out.append(x_i)
+            if len(out) >= max_new_tokens:
+                break
+        else:
+            # every proposal survived: bonus token from the target's
+            # last distribution (position gamma of the scored block)
+            if accepted == gamma:
+                # the draft never consumed its own last proposal — feed
+                # it so the draft cache has no hole at position n+gamma
+                # (the rewind below cannot repair a missing entry).
+                _, d_cache = d_step(
+                    draft_params, d_cache,
+                    jnp.full((1, 1), d_tokens[-1], jnp.int32))
+            pg = p_dists[g]
+            x_cur = (int(rng.choice(V, p=pg)) if temperature > 0
+                     else int(np.argmax(pg)))
+        accepted_hist.append(accepted)
+        if len(out) < max_new_tokens:
+            out.append(x_cur)
+        # --- rewind: the scoring pass advanced both caches past the
+        # accepted prefix; only cache_index needs to move back.
+        n += 1 + accepted  # x_cur (previous) + accepted draft tokens
+        t_cache = _set_cache_index(t_cache, n)
+        d_cache = _set_cache_index(d_cache, n)
+
+    stats = {
+        "target_passes": target_passes,
+        "tokens": len(out[:max_new_tokens]),
+        "mean_accepted": (float(np.mean(accepted_hist))
+                          if accepted_hist else 0.0),
+        "tokens_per_target_pass":
+            len(out[:max_new_tokens]) / max(target_passes, 1),
+    }
+    return jnp.asarray([out[:max_new_tokens]], jnp.int32), stats

@@ -1,0 +1,107 @@
+"""Speculative decoding: greedy stream EXACTLY equals the target's own,
+the acceptance math preserves the target distribution, self-draft accepts
+everything."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models.generate import greedy_generate
+from pytorch_distributed_tpu.models.speculative import (
+    _accept,
+    _resample,
+    speculative_generate,
+)
+from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+TARGET = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2)
+DRAFT = dict(vocab_size=64, d_model=16, n_heads=2, n_layers=1)
+
+
+def _init(cfg, seed):
+    model = TransformerLM(**cfg)
+    return model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 16), jnp.int32))["params"]
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 4])
+def test_greedy_equals_target_stream(gamma):
+    """Temperature 0: the speculative output must be the target model's
+    greedy stream token-for-token, whatever the draft proposes."""
+    tp, dp = _init(TARGET, 0), _init(DRAFT, 7)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 64, size=(1, 6)).astype(np.int32))
+    n_new = 12
+
+    want = np.asarray(greedy_generate(tp, prompt, n_new, **TARGET))
+    got, stats = speculative_generate(
+        tp, dp, prompt, n_new, target_cfg=TARGET, draft_cfg=DRAFT,
+        gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["tokens"] == n_new
+    assert stats["target_passes"] >= 1
+
+
+def test_self_draft_accepts_everything():
+    """Draft == target (greedy): every proposal is accepted, so each
+    target pass yields gamma+1 tokens and the stream is still exact."""
+    tp = _init(TARGET, 1)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    n_new = 13
+    gamma = 4
+
+    want = np.asarray(greedy_generate(tp, prompt, n_new, **TARGET))
+    got, stats = speculative_generate(
+        tp, tp, prompt, n_new, target_cfg=TARGET, draft_cfg=TARGET,
+        gamma=gamma)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # full rounds accept all gamma proposals
+    assert stats["mean_accepted"] == pytest.approx(gamma, abs=1.0)
+    assert stats["tokens_per_target_pass"] > 2.0
+
+
+def test_sampled_mode_runs_and_is_reproducible():
+    tp, dp = _init(TARGET, 2), _init(DRAFT, 3)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    a, sa = speculative_generate(
+        tp, dp, prompt, 10, target_cfg=TARGET, draft_cfg=DRAFT, gamma=3,
+        temperature=1.2, top_k=20, top_p=0.95, seed=5)
+    b, _ = speculative_generate(
+        tp, dp, prompt, 10, target_cfg=TARGET, draft_cfg=DRAFT, gamma=3,
+        temperature=1.2, top_k=20, top_p=0.95, seed=5)
+    c, _ = speculative_generate(
+        tp, dp, prompt, 10, target_cfg=TARGET, draft_cfg=DRAFT, gamma=3,
+        temperature=1.2, top_k=20, top_p=0.95, seed=6)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (np.asarray(a) != np.asarray(c)).any()
+    assert np.asarray(a).min() >= 0 and np.asarray(a).max() < 64
+    assert sa["tokens"] == 10
+
+
+def test_acceptance_math_preserves_target_distribution():
+    """The Leviathan identity, verified empirically on crafted p/q:
+    accept-or-resample must produce samples distributed as p."""
+    p = np.array([0.5, 0.3, 0.15, 0.05])
+    q = np.array([0.1, 0.6, 0.1, 0.2])
+    rng = np.random.default_rng(0)
+    n = 60_000
+    counts = np.zeros(4)
+    for _ in range(n):
+        x = int(rng.choice(4, p=q))  # draft proposes from q
+        if _accept(p, q, x, rng, greedy=False):
+            counts[x] += 1
+        else:
+            counts[_resample(p, q, rng, greedy=False)] += 1
+    emp = counts / n
+    np.testing.assert_allclose(emp, p, atol=0.01)
+
+
+def test_acceptance_math_degenerate_equal_dists():
+    """p == q: everything accepts (ratio 1), and the residual fallback
+    still samples from p instead of crashing on the 0/0 residual."""
+    p = np.array([0.25, 0.25, 0.25, 0.25])
+    rng = np.random.default_rng(1)
+    assert all(_accept(p, p, x, rng, greedy=False) for x in range(4))
+    tok = _resample(p, p, rng, greedy=False)
+    assert 0 <= tok < 4
