@@ -80,7 +80,48 @@ def main() -> int:
         x.size * (1 if x.dtype == jnp.int8 else 2)
         for x in jax.tree_util.tree_leaves(qparams))
 
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "..", "RESULTS_decode.json")
+    # Resumable per-row writes (arch_bench pattern): the watcher runs this
+    # under a timeout with a capped retry budget — completed rows must
+    # survive a killed sweep or retries redo everything and land nothing.
     results = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                prior = json.load(f)
+            pm = prior.get("meta", {})
+            if (pm.get("d_model") == D_MODEL and pm.get("vocab") == VOCAB
+                    and pm.get("n_layers") == N_LAYERS
+                    and pm.get("n_heads") == N_HEADS
+                    and pm.get("prompt") == PROMPT
+                    and pm.get("platform") == jax.default_backend()):
+                results = prior.get("configs", {})
+        except ValueError:
+            pass
+
+    def write():
+        out = {
+            "meta": {
+                "d_model": D_MODEL, "n_layers": N_LAYERS,
+                "n_heads": N_HEADS, "vocab": VOCAB, "prompt": PROMPT,
+                "new_tokens": NEW,
+                "params_m": round(n_params / 1e6, 1),
+                "hbm_gbps_assumed": HBM_GBPS,
+                "platform": jax.default_backend(),
+                "what": "KV-cached generate(): prefill latency + "
+                        "steady-state decode tok/s vs the params+KV "
+                        "HBM-stream floor",
+                "topk_nucleus_note": "top-k+top-p samples from the sorted "
+                        "k-vector (no full-vocab argsort in the scan): "
+                        "6.696 -> 1.761 ms/tok measured at b8/vocab 32k",
+            },
+            "configs": results,
+        }
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+            f.write("\n")
+
     for batch, sampling, quant in (
             (1, "greedy", ""), (8, "greedy", ""), (32, "greedy", ""),
             (8, "topk50_topp0.9", ""),
@@ -91,6 +132,9 @@ def main() -> int:
         if sampling != "greedy":
             kw.update(temperature=1.0, top_k=50, top_p=0.9)
         tag = f"b{batch}_p{PROMPT}_{sampling}" + ("_int8w" if quant else "")
+        if tag in results:
+            print(f"{tag}: cached", flush=True)
+            continue
         p = qparams if quant else params
         try:
             t1 = _time(lambda: generate(p, prompt, 1, **kw), REPS)
@@ -114,30 +158,187 @@ def main() -> int:
             "hbm_floor_ms": round(floor_s * 1e3, 3),
             "pct_of_bw_roofline": round(100 * floor_s / per_tok, 1),
         }
+        write()
         print(f"{tag}: prefill+1 {t1*1e3:.1f} ms  decode "
               f"{per_tok*1e3:.3f} ms/tok  {toks_per_s:,.0f} tok/s  "
               f"({results[tag]['pct_of_bw_roofline']}% of HBM roofline)",
               flush=True)
 
-    out = {
-        "meta": {
-            "d_model": D_MODEL, "n_layers": N_LAYERS, "n_heads": N_HEADS,
-            "vocab": VOCAB, "prompt": PROMPT, "new_tokens": NEW,
-            "params_m": round(n_params / 1e6, 1),
-            "hbm_gbps_assumed": HBM_GBPS,
-            "platform": jax.default_backend(),
-            "what": "KV-cached generate(): prefill latency + steady-state "
-                    "decode tok/s vs the params+KV HBM-stream floor",
-            "topk_nucleus_note": "top-k+top-p samples from the sorted "
-                    "k-vector (no full-vocab argsort in the scan): "
-                    "6.696 -> 1.761 ms/tok measured at b8/vocab 32k",
-        },
-        "configs": results,
-    }
-    here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "..", "RESULTS_decode.json"), "w") as f:
-        json.dump(out, f, indent=1)
-        f.write("\n")
+    # --- b32 roofline-gap breakdown (VERDICT r4 weak 6): where do the
+    # extra ms/tok go at batch 32?  Decompose by re-measuring b32 with a
+    # tiny KV cache (prompt 64): params stream is batch-invariant, so
+    #   per_tok(b32, p512) - per_tok(b32, p64)  ~= attention-over-cache +
+    # KV stream for the extra context, and per_tok(b32, p64) ~= params
+    # stream + batched-MLP compute + dispatch.  b1@p64 pins the dispatch+
+    # params floor.
+    b32_tag = f"b32_p{PROMPT}_greedy"
+    if b32_tag in results and "b32_breakdown" not in results:
+        try:
+            gap = {}
+            for b in (1, 32):
+                pshort = jnp.asarray(
+                    rng.integers(0, VOCAB, size=(b, 64)).astype(np.int32))
+                kw = dict(cfg, dtype=jnp.bfloat16)
+                t1s = _time(lambda: generate(params, pshort, 1, **kw), REPS)
+                tns = _time(lambda: generate(params, pshort, NEW, **kw),
+                            REPS)
+                gap[f"b{b}_p64_per_token_ms"] = round(
+                    (tns - t1s) / max(NEW - 1, 1) * 1e3, 3)
+            long_ms = results[b32_tag]["per_token_ms"]
+            short_ms = gap["b32_p64_per_token_ms"]
+            results["b32_breakdown"] = {
+                **gap,
+                f"b32_p{PROMPT}_per_token_ms": long_ms,
+                "attn_over_cache_ms": round(long_ms - short_ms, 3),
+                "note": "per_tok(b32,p512)-per_tok(b32,p64) isolates "
+                        "attention-over-cache + long-context KV stream; "
+                        "b1_p64 is the params+dispatch floor",
+            }
+            write()
+            print(f"b32 breakdown: {results['b32_breakdown']}", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"b32_breakdown: FAILED {repr(e)[:200]}", flush=True)
+
+    # --- long-prompt flash prefill (VERDICT r4: parity-tested, never
+    # timed).  P=4096: the dense prefill materializes the O(P·max_len)
+    # score tensor; the Pallas kernel streams it.  Rows record prefill+1
+    # latency for both paths at b1 (dense may OOM — that row then records
+    # the failure, which is itself the result).
+    long_p = int(os.environ.get("DECODE_BENCH_LONG_PROMPT", "4096"))
+    lp_prompt = jnp.asarray(
+        rng.integers(0, VOCAB, size=(1, long_p)).astype(np.int32))
+    for fp in (False, True):
+        tag = f"b1_p{long_p}_prefill_{'flash' if fp else 'dense'}"
+        if tag in results:
+            print(f"{tag}: cached", flush=True)
+            continue
+        try:
+            kw = dict(cfg, dtype=jnp.bfloat16, flash_prefill=fp)
+            t1 = _time(lambda: generate(params, lp_prompt, 1, **kw), REPS)
+        except Exception as e:  # noqa: BLE001
+            print(f"{tag}: FAILED {repr(e)[:200]}", flush=True)
+            results[tag] = {"failed": repr(e)[:200]}
+            write()
+            continue
+        results[tag] = {"prefill_plus_1tok_ms": round(t1 * 1e3, 2)}
+        write()
+        print(f"{tag}: prefill+1 {t1*1e3:.1f} ms", flush=True)
+
+    # --- speculative decoding (models/speculative.py): draft proposes
+    # gamma tokens, target scores the block in ONE cached pass.  On
+    # random-init weights the measured acceptance is the FLOOR (a trained
+    # draft tracks its target far better), so alongside the end-to-end
+    # rows we record the component times (draft ms/step, target ms/pass)
+    # and project tok/s at trained-draft acceptance rates from the
+    # rejection-sampling algebra: E[tokens/round] = (1-a^(g+1))/(1-a),
+    # round cost = g*t_draft + t_target.
+    from pytorch_distributed_tpu.models.speculative import (
+        speculative_generate,
+    )
+
+    draft_cfg = dict(vocab_size=VOCAB, d_model=D_MODEL // 4,
+                     n_heads=max(1, N_HEADS // 4),
+                     n_layers=max(1, N_LAYERS // 4))
+    draft_model = TransformerLM(**draft_cfg, dtype=jnp.bfloat16)
+    draft_params = jax.device_put(draft_model.init(
+        jax.random.PRNGKey(1), init_tokens)["params"])
+    spec_prompt = jnp.asarray(
+        rng.integers(0, VOCAB, size=(1, PROMPT)).astype(np.int32))
+    gamma = int(os.environ.get("DECODE_BENCH_GAMMA", "4"))
+    spec_new = int(os.environ.get("DECODE_BENCH_SPEC_NEW", "129"))
+    for tag, temp in (("b1_spec_greedy", 0.0), ("b1_spec_t1.0", 1.0)):
+        if tag in results:
+            print(f"{tag}: cached", flush=True)
+            continue
+        try:
+            kw = dict(target_cfg=cfg, draft_cfg=draft_cfg, gamma=gamma,
+                      dtype=jnp.bfloat16, temperature=temp, seed=0)
+            # Warm at the SAME max_new_tokens: max_len keys the compiled
+            # cache shapes, so a shorter warm call would leave the timed
+            # run recompiling all four block programs.
+            speculative_generate(
+                params, draft_params, spec_prompt, spec_new, **kw)
+            t0 = time.perf_counter()
+            _, stats = speculative_generate(
+                params, draft_params, spec_prompt, spec_new, **kw)
+            dt = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001
+            print(f"{tag}: FAILED {repr(e)[:200]}", flush=True)
+            continue
+        results[tag] = {
+            "gamma": gamma,
+            "end_to_end_tok_s": round(stats["tokens"] / dt, 1),
+            "mean_accepted": round(stats["mean_accepted"], 3),
+            "tokens_per_target_pass":
+                round(stats["tokens_per_target_pass"], 3),
+            "target_passes": stats["target_passes"],
+            "note": "random-init draft = acceptance FLOOR; see "
+                    "spec_projection for trained-draft projections",
+        }
+        write()
+        print(f"{tag}: {results[tag]['end_to_end_tok_s']} tok/s  "
+              f"accepted {stats['mean_accepted']:.2f}/{gamma}  "
+              f"{stats['tokens_per_target_pass']:.2f} tok/target-pass",
+              flush=True)
+
+    # Component times for the projection: one draft step (L=1) and one
+    # target scoring pass (L=gamma+1), both cached-model applies.
+    if "spec_projection" in results:
+        print("spec_projection: cached", flush=True)
+        write()
+        print("wrote RESULTS_decode.json", flush=True)
+        return 0
+    try:
+        from pytorch_distributed_tpu.models.speculative import (
+            _make_block_apply,
+        )
+
+        max_len = PROMPT + spec_new + gamma + 1
+
+        def _component_ms(c, L, p):
+            fresh, apply = _make_block_apply(
+                L, 1, max_len, c["vocab_size"], c["d_model"], c["n_heads"],
+                c["n_layers"], "bfloat16", "")
+            cache = fresh()
+            toks = jnp.zeros((1, L), jnp.int32)
+            _, cache = apply(p, cache, toks)  # compile
+            jax.block_until_ready(cache)
+            best = float("inf")
+            for _ in range(max(REPS, 3)):
+                t0 = time.perf_counter()
+                lg, c2 = apply(p, cache, toks)
+                float(jnp.sum(lg))
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e3
+
+        t_draft = _component_ms(draft_cfg, 1, draft_params)
+        t_target = _component_ms(cfg, gamma + 1, params)
+        base_tok_ms = results.get(
+            f"b1_p{PROMPT}_greedy", {}).get("per_token_ms")
+        proj = {}
+        for a in (0.5, 0.7, 0.9):
+            exp_toks = (1 - a ** (gamma + 1)) / (1 - a)
+            round_ms = gamma * t_draft + t_target
+            proj[f"accept_{a}"] = {
+                "tokens_per_round": round(exp_toks, 2),
+                "proj_tok_s": round(1e3 * exp_toks / round_ms, 1),
+            }
+        results["spec_projection"] = {
+            "draft_step_ms": round(t_draft, 3),
+            "target_scorepass_ms": round(t_target, 3),
+            "target_only_per_token_ms": base_tok_ms,
+            "gamma": gamma,
+            "projections": proj,
+            "note": "proj_tok_s = E[toks/round]/(gamma*t_draft+t_target); "
+                    "host-loop dispatch excluded (measured rows include it)",
+        }
+        print(f"spec components: draft {t_draft:.2f} ms/step, target "
+              f"score {t_target:.2f} ms/pass; projections {proj}",
+              flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(f"spec_projection: FAILED {repr(e)[:200]}", flush=True)
+
+    write()
     print("wrote RESULTS_decode.json", flush=True)
     return 0
 

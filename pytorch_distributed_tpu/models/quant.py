@@ -17,6 +17,7 @@ names, ``kernel`` → ``w_q`` + ``scale``).
 
 from __future__ import annotations
 
+from collections.abc import Mapping
 from typing import Any
 
 import flax.linen as nn
@@ -79,8 +80,13 @@ def quantize_lm_params(params):
     ``scale`` (bias, norms, embedding untouched); the result matches the
     param structure ``TransformerLM(quant="int8")`` initializes."""
 
+    converted = 0
+
     def walk(tree, name):
-        if not isinstance(tree, dict):
+        nonlocal converted
+        # Mapping (not just dict): flax FrozenDict subtrees must be walked
+        # too, or the conversion silently no-ops below the top level.
+        if not isinstance(tree, Mapping):
             return tree
         if (name in QUANT_MODULES and "kernel" in tree
                 and getattr(tree["kernel"], "ndim", 0) == 2):
@@ -90,7 +96,14 @@ def quantize_lm_params(params):
             w_q, scale = quantize_kernel(tree["kernel"])
             out = {k: v for k, v in tree.items() if k != "kernel"}
             out.update(w_q=w_q, scale=scale)
+            converted += 1
             return out
         return {k: walk(v, k) for k, v in tree.items()}
 
-    return walk(dict(params), "")
+    out = walk(dict(params), "")
+    if converted == 0:
+        raise ValueError(
+            "quantize_lm_params converted no kernels — the tree has no "
+            f"2-D 'kernel' under any of {QUANT_MODULES}; is this a "
+            "TransformerLM params tree (or already quantized)?")
+    return out

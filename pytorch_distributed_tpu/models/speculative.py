@@ -113,7 +113,11 @@ def _dist(logits_row, temperature, top_k, top_p):
         probs[int(np.argmax(logits_row))] = 1.0
         return probs
     filt = filter_logits(jnp.asarray(logits_row), temperature, top_k, top_p)
-    return np.asarray(jax.nn.softmax(filt, axis=-1), np.float64)
+    probs = np.asarray(jax.nn.softmax(filt, axis=-1), np.float64)
+    # Renormalize in float64: the float32-accumulated softmax sum deviates
+    # from 1 by up to ~1e-7 at vocab 32k, past numpy Generator.choice's
+    # ~1.5e-8 sum-to-1 tolerance.
+    return probs / probs.sum()
 
 
 def speculative_generate(
@@ -209,15 +213,17 @@ def speculative_generate(
             if len(out) >= max_new_tokens:
                 break
         else:
-            # every proposal survived: bonus token from the target's
-            # last distribution (position gamma of the scored block)
-            if accepted == gamma:
-                # the draft never consumed its own last proposal — feed
-                # it so the draft cache has no hole at position n+gamma
-                # (the rewind below cannot repair a missing entry).
-                _, d_cache = d_step(
-                    draft_params, d_cache,
-                    jnp.full((1, 1), d_tokens[-1], jnp.int32))
+            # Every proposal survived (the no-break path implies
+            # accepted == g == gamma: accepting fewer than gamma means
+            # either a rejection broke out, or max_new_tokens was hit —
+            # also a break): bonus token from the target's last
+            # distribution (position gamma of the scored block).  The
+            # draft never consumed its own last proposal — feed it so
+            # the draft cache has no hole at position n+gamma (the
+            # rewind below cannot repair a missing entry).
+            _, d_cache = d_step(
+                draft_params, d_cache,
+                jnp.full((1, 1), d_tokens[-1], jnp.int32))
             pg = p_dists[g]
             x_cur = (int(rng.choice(V, p=pg)) if temperature > 0
                      else int(np.argmax(pg)))

@@ -66,6 +66,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="int8 = weight-only quantized block kernels "
                         "(halves the parameter HBM stream that bounds "
                         "small-batch decode)")
+    # Speculative decoding: a cheap draft proposes gamma tokens, the
+    # target scores the block in ONE cached pass; rejection sampling keeps
+    # the output distributed exactly as target-only (models/speculative.py).
+    p.add_argument("--spec-draft", default="",
+                   help="enable speculative decoding: draft checkpoint "
+                        "path, or 'random' for a fresh-init draft (smoke)")
+    p.add_argument("--spec-d-model", type=int, default=0,
+                   help="draft d_model (default: target d_model // 4)")
+    p.add_argument("--spec-n-heads", type=int, default=0,
+                   help="draft n_heads (default: max(1, target // 4))")
+    p.add_argument("--spec-n-layers", type=int, default=0,
+                   help="draft n_layers (default: max(1, target // 4))")
+    p.add_argument("--spec-gamma", type=int, default=4,
+                   help="draft tokens proposed per target scoring pass")
     return p
 
 
@@ -114,7 +128,40 @@ def main(argv=None) -> int:
     sample_kw = dict(cfg, dtype=dtype, temperature=args.temperature,
                      top_k=args.top_k, top_p=args.top_p, seed=args.seed,
                      quant=args.quant)
-    if args.tp > 1:
+    if args.spec_draft:
+        if args.tp > 1:
+            raise SystemExit("--spec-draft is batch-1 single-device "
+                             "serving; it does not compose with --tp")
+        from pytorch_distributed_tpu.models.speculative import (
+            speculative_generate,
+        )
+
+        draft_cfg = dict(
+            vocab_size=args.vocab,
+            d_model=args.spec_d_model or max(32, args.d_model // 4),
+            n_heads=args.spec_n_heads or max(1, args.n_heads // 4),
+            n_layers=args.spec_n_layers or max(1, args.n_layers // 4),
+        )
+        draft_model = TransformerLM(**draft_cfg, dtype=dtype)
+        draft_params = draft_model.init(
+            jax.random.PRNGKey(args.seed + 1), init_tokens)["params"]
+        if args.spec_draft != "random":
+            d_template = TrainState.create(
+                {"params": draft_params}, sgd_init(draft_params))
+            d_state, d_meta = load_checkpoint(args.spec_draft, d_template)
+            draft_params = d_state.params
+            print(f"loaded draft {args.spec_draft} "
+                  f"(epoch {d_meta.get('epoch')})")
+        out, stats = speculative_generate(
+            params, draft_params, prompt, args.max_new_tokens,
+            target_cfg=cfg, draft_cfg=draft_cfg, gamma=args.spec_gamma,
+            dtype=dtype, temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, seed=args.seed, quant=args.quant)
+        print(f"speculative: {stats['target_passes']} target passes for "
+              f"{stats['tokens']} tokens "
+              f"({stats['tokens_per_target_pass']:.2f} tok/pass, "
+              f"mean accepted {stats['mean_accepted']:.2f}/{args.spec_gamma})")
+    elif args.tp > 1:
         from pytorch_distributed_tpu.models.generate import tp_generate
         from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
 

@@ -106,3 +106,22 @@ def test_lm_generate_recipe(tmp_path, capsys):
         "4", "--n-layers", "2", "--prompt-tokens", "1,2,3", "-n", "3",
     ])
     assert rc2 == 0
+
+
+def test_lm_generate_speculative(capsys):
+    """--spec-draft: the greedy speculative stream through the CLI equals
+    the CLI's own target-only greedy stream (output-distribution identity
+    at temperature 0), and the stats line is printed."""
+    from pytorch_distributed_tpu.recipes import lm_generate
+
+    base = ["--random-init", "--vocab", "64", "--d-model", "32",
+            "--n-heads", "4", "--n-layers", "2", "--prompt-tokens",
+            "1,2,3", "-n", "8", "--seed", "3"]
+    assert lm_generate.main(base) == 0
+    want = capsys.readouterr().out
+    assert lm_generate.main(
+        base + ["--spec-draft", "random", "--spec-gamma", "2"]) == 0
+    got = capsys.readouterr().out
+    assert "speculative:" in got and "tok/pass" in got
+    tok = [ln for ln in want.splitlines() if ln.startswith("tokens:")]
+    assert tok and tok[0] in got

@@ -10,6 +10,7 @@ import pytest
 from pytorch_distributed_tpu.models.generate import greedy_generate
 from pytorch_distributed_tpu.models.speculative import (
     _accept,
+    _dist,
     _resample,
     speculative_generate,
 )
@@ -77,6 +78,40 @@ def test_sampled_mode_runs_and_is_reproducible():
     assert (np.asarray(a) != np.asarray(c)).any()
     assert np.asarray(a).min() >= 0 and np.asarray(a).max() < 64
     assert sa["tokens"] == 10
+
+
+def test_dist_sums_within_choice_tolerance_at_large_vocab():
+    """Regression: f32-accumulated softmax sums deviate up to ~1.3e-7 at
+    vocab 32k — past numpy Generator.choice's ~1.5e-8 tolerance.  _dist
+    must renormalize so every vector it returns is choice-safe."""
+    rng = np.random.default_rng(0)
+    for seed in range(4):
+        logits = np.asarray(
+            np.random.default_rng(seed).normal(0, 4, size=32_768), np.float32)
+        p = _dist(logits, temperature=1.1, top_k=0, top_p=0.0)
+        assert abs(p.sum() - 1.0) <= 1e-12
+        # the actual contract: choice must not raise
+        rng.choice(len(p), p=p)
+        # filtered variants too (top-k/top-p change the support)
+        pk = _dist(logits, temperature=0.8, top_k=50, top_p=0.9)
+        assert abs(pk.sum() - 1.0) <= 1e-12
+        rng.choice(len(pk), p=pk)
+
+
+@pytest.mark.parametrize("seed", [5, 11, 17])
+def test_sampled_mode_multiseed(seed):
+    """Sampled-mode speculative decode runs (no sum-to-1 crash) and is
+    seed-reproducible across several seeds."""
+    tp, dp = _init(TARGET, 2), _init(DRAFT, 3)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    a, sa = speculative_generate(
+        tp, dp, prompt, 8, target_cfg=TARGET, draft_cfg=DRAFT, gamma=2,
+        temperature=1.0, top_k=0, top_p=0.9, seed=seed)
+    b, _ = speculative_generate(
+        tp, dp, prompt, 8, target_cfg=TARGET, draft_cfg=DRAFT, gamma=2,
+        temperature=1.0, top_k=0, top_p=0.9, seed=seed)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert sa["tokens"] == 8
 
 
 def test_acceptance_math_preserves_target_distribution():
