@@ -41,7 +41,8 @@ def count_params(params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
 
 
-def bench(L: int, batch: int, attn_impl: str, remat: bool):
+def bench(L: int, batch: int, attn_impl: str, remat: bool,
+          fused_ce: int = 0):
     import jax
     import jax.numpy as jnp
 
@@ -66,7 +67,8 @@ def bench(L: int, batch: int, attn_impl: str, remat: bool):
     n_params = count_params(params)
     n_embed = params["embed"]["embedding"].size
     state = TrainState.create({"params": params}, sgd_init(params))
-    step = make_lm_train_step(model, mesh, replicated_like(params))
+    step = make_lm_train_step(model, mesh, replicated_like(params),
+                              fused_ce_chunks=fused_ce)
     lr = jnp.float32(1e-3)
 
     for _ in range(3):
@@ -103,43 +105,85 @@ def n_layers_d() -> int:
 def main() -> int:
     import jax
 
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(here, "..", "RESULTS_lm.json")
+    # Resumable: completed rows survive a killed sweep (the watcher runs
+    # this under a timeout; without per-row writes a long sweep could
+    # burn every retry re-doing the early rows — arch_bench pattern).
     results = {}
+    extra = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                prior = json.load(f)
+            if (prior.get("meta", {}).get("d_model") == D_MODEL
+                    and prior["meta"].get("vocab") == VOCAB
+                    and prior["meta"].get("n_layers") == N_LAYERS
+                    and prior["meta"].get("n_heads") == N_HEADS
+                    and prior["meta"].get("peak_tflops") == PEAK_TFLOPS
+                    and prior["meta"].get("platform")
+                    == jax.default_backend()):
+                results = prior.get("configs", {})
+                extra = {k: v for k, v in prior.items()
+                         if k not in ("meta", "configs")}
+        except ValueError:
+            pass
+
+    def write():
+        out = {
+            "meta": {
+                "d_model": D_MODEL, "n_layers": N_LAYERS,
+                "n_heads": N_HEADS, "vocab": VOCAB,
+                "peak_tflops": PEAK_TFLOPS,
+                "platform": jax.default_backend(),
+                "what": "full LM train step (fwd+bwd+SGD), bf16, "
+                        "PaLM-convention MFU vs chip bf16 peak",
+            },
+            "configs": results,
+            **extra,
+        }
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+        return out
     # Dense batches are capped by the materialized f32 score tensor
     # (B·H·L² · 4B: 4.3 GB at L=1024 b=4 — b=16 would want 17 GB).
-    for L, batch, attn, remat in (
-        (1024, 4, "dense", False),
-        (1024, 4, "flash", False),
-        (2048, 1, "dense", False),
-        (2048, 8, "flash", False),
-        (4096, 4, "flash", False),
-        (4096, 4, "flash", True),
-        (8192, 2, "flash", True),
+    for L, batch, attn, remat, fused_ce in (
+        (1024, 4, "dense", False, 0),
+        (1024, 4, "flash", False, 0),
+        # fused tied-head+CE (ops/fused_ce.py): the round-5 MFU lever —
+        # same step, logits tensor never in HBM; chunks sized so each
+        # block's [rows, V] f32 scratch stays O(100 MB).
+        (1024, 4, "flash", False, 8),
+        (2048, 1, "dense", False, 0),
+        (2048, 8, "flash", False, 0),
+        (2048, 8, "flash", False, 16),
+        (4096, 4, "flash", False, 0),
+        (4096, 4, "flash", False, 16),
+        # fused CE frees the logits HBM — retry the batch the unfused
+        # step could not fit (dense-note above: b16 at L1024 wants 17 GB
+        # of score tensor; flash+fused-CE removes both big tensors).
+        (1024, 16, "flash", False, 16),
+        (4096, 4, "flash", True, 0),
+        (8192, 2, "flash", True, 0),
+        (8192, 2, "flash", True, 16),
     ):
-        tag = f"L{L}_b{batch}_{attn}{'_remat' if remat else ''}"
+        tag = (f"L{L}_b{batch}_{attn}{'_remat' if remat else ''}"
+               + (f"_fusedce{fused_ce}" if fused_ce else ""))
+        if tag in results:
+            print(f"{tag}: cached", flush=True)
+            continue
         try:
-            row = bench(L, batch, attn, remat)
+            row = bench(L, batch, attn, remat, fused_ce)
         except Exception as e:
             print(f"{tag}: FAILED {repr(e)[:200]}", flush=True)
             continue
         results[tag] = row
+        write()
         print(f"{tag}: {row['ms_per_step']} ms  "
               f"{row['tokens_per_sec']:,.0f} tok/s  MFU {row['mfu_pct']}%",
               flush=True)
 
-    out = {
-        "meta": {
-            "d_model": D_MODEL, "n_layers": N_LAYERS, "n_heads": N_HEADS,
-            "vocab": VOCAB, "peak_tflops": PEAK_TFLOPS,
-            "platform": jax.default_backend(),
-            "what": "full LM train step (fwd+bwd+SGD), bf16, PaLM-convention "
-                    "MFU vs chip bf16 peak",
-        },
-        "configs": results,
-    }
-    here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "..", "RESULTS_lm.json"), "w") as f:
-        json.dump(out, f, indent=1)
-    print(json.dumps(out))
+    print(json.dumps(write()))
     return 0
 
 

@@ -246,16 +246,23 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     stem: str = "conv7"  # "conv7" (torchvision) | "space_to_depth" (same math)
     fused_convbn: bool = False  # fold BN-backward dx into the 1x1 dgrad/wgrad
+    # SyncBN under shard_map: psum BN moments over this mesh axis (torch
+    # nn.SyncBatchNorm ≙).  None = per-shard statistics (torch DDP default).
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = functools.partial(nn.Conv, dtype=self.dtype)
-        norm = functools.partial(
-            FusedBatchNormAct,
+        norm_kw = dict(
             use_running_average=not train,
             momentum=0.9,           # torch BatchNorm2d momentum=0.1 ⇒ ema decay 0.9
             epsilon=1e-5,
         )
+        if self.bn_axis_name is not None:
+            # Only set when active: the keyword disables the conv+BN fold
+            # gate (_fuse_ok), which has no synced-stats kernel.
+            norm_kw["axis_name"] = self.bn_axis_name
+        norm = functools.partial(FusedBatchNormAct, **norm_kw)
         x = x.astype(self.dtype)
         if self.stem == "space_to_depth":
             x = _SpaceToDepthStem(self.num_filters, self.dtype,

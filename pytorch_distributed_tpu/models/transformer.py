@@ -236,7 +236,8 @@ class TransformerLM(nn.Module):
     #                              (single-block prompts only — generate())
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True,
+                 return_hidden: bool = False):
         embed = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
                          name="embed")
         x = embed(tokens)
@@ -249,6 +250,12 @@ class TransformerLM(nn.Module):
                           flash_prefill=self.flash_prefill,
                           name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            # Pre-head hidden states for the fused tied-head+CE loss
+            # (ops/fused_ce.py) — the [B, L, vocab] logits tensor never
+            # materializes; the caller projects per row chunk against
+            # params["embed"]["embedding"].
+            return x
         # Tied output head (embed.attend) keeps params lean at long context.
         return embed.attend(x.astype(jnp.float32)).astype(jnp.float32)
 

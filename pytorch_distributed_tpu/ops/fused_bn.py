@@ -37,28 +37,40 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
-def _stats(y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """One-pass batch mean/variance over all-but-channel axes, f32 accum."""
+def _stats(y: jnp.ndarray,
+           axis_name: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One-pass batch mean/variance over all-but-channel axes, f32 accum.
+
+    ``axis_name``: SyncBN — additionally reduce the moments over that mesh
+    axis (equal-size shards under shard_map), so the statistics cover the
+    *global* batch.  The torch capability analogue is ``nn.SyncBatchNorm``
+    wrapping DDP at small per-device batch."""
     axes = tuple(range(y.ndim - 1))
     yf = y.astype(jnp.float32)
     mu = yf.mean(axes)
+    ms = (yf * yf).mean(axes)
+    if axis_name is not None:
+        mu = jax.lax.pmean(mu, axis_name)
+        ms = jax.lax.pmean(ms, axis_name)
     # One-pass E[y²]−μ² can go (numerically) negative under cancellation for
     # large-mean/small-spread channels; clamp like flax's _compute_stats or
     # rsqrt(var+eps) NaNs mid-training.
-    var = jnp.maximum((yf * yf).mean(axes) - mu * mu, 0.0)
+    var = jnp.maximum(ms - mu * mu, 0.0)
     return mu, var
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _bn_act(y, gamma, beta, eps: float, relu: bool):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_act(y, gamma, beta, eps: float, relu: bool,
+            axis_name: Optional[str] = None):
     """Returns ``(o, mean, var)`` — stats are exposed for the EMA update
     (stop-gradiented by the caller, so their cotangents are zero)."""
-    (o, mu, var), _ = _bn_act_fwd(y, gamma, beta, eps, relu)
+    (o, mu, var), _ = _bn_act_fwd(y, gamma, beta, eps, relu, axis_name)
     return o, mu, var
 
 
-def _bn_act_fwd(y, gamma, beta, eps: float, relu: bool):
-    mu, var = _stats(y)
+def _bn_act_fwd(y, gamma, beta, eps: float, relu: bool,
+                axis_name: Optional[str] = None):
+    mu, var = _stats(y, axis_name)
     inv = jax.lax.rsqrt(var + eps)
     scale = gamma * inv
     shift = beta - mu * scale
@@ -71,7 +83,7 @@ def _bn_act_fwd(y, gamma, beta, eps: float, relu: bool):
     return (o, mu, var), (y, mu, inv, gamma, beta)
 
 
-def _bn_act_bwd(eps: float, relu: bool, res, cts):
+def _bn_act_bwd(eps: float, relu: bool, axis_name: Optional[str], res, cts):
     y, mu, inv, gamma, beta = res
     do = cts[0]  # cotangents for (mu, var) outputs are zero (EMA is stop-grad)
     axes = tuple(range(y.ndim - 1))
@@ -85,8 +97,19 @@ def _bn_act_bwd(eps: float, relu: bool, res, cts):
         dof = jnp.where(gamma * xhat + beta > 0, dof, 0.0)
     dbeta = dof.sum(axes)
     dgamma = (dof * xhat).sum(axes)
-    # Standard BN backward through the batch statistics.
-    dx = (gamma * inv) * (dof - dbeta / n - xhat * (dgamma / n))
+    # Standard BN backward through the batch statistics.  SyncBN: the
+    # statistics covered the global batch, so the through-stats terms use
+    # the axis-summed reductions over the global element count — while the
+    # RETURNED dgamma/dbeta stay local (sum-form), because the outer
+    # explicit-collectives step psums parameter gradients itself
+    # (train/steps.py sync_grads); same split as torch SyncBatchNorm
+    # (all-reduced sum_dy inside, DDP-reduced grad_weight outside).
+    dbeta_g, dgamma_g, n_g = dbeta, dgamma, n
+    if axis_name is not None:
+        dbeta_g = jax.lax.psum(dbeta, axis_name)
+        dgamma_g = jax.lax.psum(dgamma, axis_name)
+        n_g = n * jax.lax.psum(1, axis_name)
+    dx = (gamma * inv) * (dof - dbeta_g / n_g - xhat * (dgamma_g / n_g))
     return dx.astype(y.dtype), dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype)
 
 
@@ -109,6 +132,10 @@ class FusedBatchNormAct(nn.Module):
     relu: bool = False
     scale_init: Any = nn.initializers.ones
     bias_init: Any = nn.initializers.zeros
+    # SyncBN: reduce batch moments over this mesh axis (only meaningful
+    # under shard_map/explicit collectives — GSPMD's global-semantics BN
+    # is already synced by construction).  ≙ torch nn.SyncBatchNorm.
+    axis_name: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -137,7 +164,8 @@ class FusedBatchNormAct(nn.Module):
             o = (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
             return jax.nn.relu(o) if self.relu else o
 
-        o, mu, var = _bn_act(x, gamma, beta, self.epsilon, self.relu)
+        o, mu, var = _bn_act(x, gamma, beta, self.epsilon, self.relu,
+                             self.axis_name)
         if not self.is_initializing():
             m = self.momentum
             ra_mean.value = m * ra_mean.value + (1 - m) * jax.lax.stop_gradient(mu)

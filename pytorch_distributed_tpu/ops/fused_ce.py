@@ -1,0 +1,130 @@
+"""Fused tied-head + cross-entropy: the LM loss without the [N, V] logits
+tensor.
+
+The LM step's last matmul projects hidden states onto the 32k-vocab tied
+embedding and feeds softmax cross-entropy (models/transformer.py:251-253 →
+ops/loss.py).  Materializing those logits costs N·V f32 in HBM *twice over*
+(forward write + backward read) plus the softmax intermediates — at
+b8·L1024·V32k that is >2 GB of pure loss-head traffic per step, charged
+against an HBM-bound budget (ROADMAP roofline).  This op computes the SAME
+loss in row chunks with a custom VJP:
+
+- **forward**: ``lax.scan`` over N/num_chunks row blocks — each block's
+  logits ([chunk, V], f32-accumulated MXU matmul) live only in VMEM-scale
+  scratch; only the scalar loss/correct sums survive.
+- **backward**: recomputes each block's logits (one extra matmul pass —
+  FLOPs are free here, bytes are not), forms ``softmax − onehot`` locally,
+  and accumulates ``dh`` and ``dE`` per block.  Residuals are just the
+  inputs; nothing O(N·V) is ever saved.
+
+Numerics: logits accumulate in f32 (``preferred_element_type``) from
+bf16/f32 operands — at least as accurate as the unfused head (which casts
+the f32 hidden back through the embed dtype).  Equality to the unfused
+``cross_entropy(model(tokens))`` path is pinned in tests/test_fused_ce.py.
+
+Reference anchor: the loss of every reference recipe is
+``nn.CrossEntropyLoss`` on the model head (reference distributed.py:151);
+this is that capability, restructured for the TPU memory hierarchy.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_sums(h_blk, e, t_blk, w_blk):
+    """One row block: (loss_sum, correct_sum) in f32."""
+    logits = jax.lax.dot_general(
+        h_blk, e, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [chunk, V] f32
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, t_blk[:, None], axis=-1)[:, 0]
+    loss = jnp.sum((logz - true_logit) * w_blk)
+    correct = jnp.sum(
+        (jnp.argmax(logits, axis=-1) == t_blk).astype(jnp.float32) * w_blk)
+    return loss, correct
+
+
+def fused_ce_sums(h, e, targets, weights, num_chunks: int):
+    """``h [N, D]`` hidden rows, ``e [V, D]`` tied embedding, ``targets
+    [N]`` int32, ``weights [N]`` f32 → ``(loss_sum, correct_sum)`` f32
+    scalars (weighted sums; divide by ``weights.sum()`` for means).
+
+    N is padded up to a multiple of ``num_chunks`` with weight-0 rows
+    (zero loss and zero gradient contribution — the same masking the
+    image eval path uses for partial batches).  ``correct_sum`` is
+    non-differentiable (its cotangent is ignored)."""
+    n = h.shape[0]
+    pad = (-n) % num_chunks
+    if pad:
+        h = jnp.concatenate(
+            [h, jnp.zeros((pad, h.shape[1]), h.dtype)], axis=0)
+        targets = jnp.concatenate(
+            [targets, jnp.zeros((pad,), targets.dtype)], axis=0)
+        weights = jnp.concatenate(
+            [weights, jnp.zeros((pad,), weights.dtype)], axis=0)
+    out = _fused_ce_sums(h, e, targets, weights, num_chunks)
+    return out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused_ce_sums(h, e, targets, weights, num_chunks: int):
+    (out, _) = _fwd(h, e, targets, weights, num_chunks)
+    return out
+
+
+def _split(x, c):
+    return x.reshape((c, x.shape[0] // c) + x.shape[1:])
+
+
+def _fwd(h, e, targets, weights, num_chunks: int):
+    def body(carry, blk):
+        loss, correct = carry
+        hb, tb, wb = blk
+        dl, dc = _block_sums(hb, e, tb, wb)
+        return (loss + dl, correct + dc), None
+
+    (sums, _) = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)),
+        (_split(h, num_chunks), _split(targets, num_chunks),
+         _split(weights, num_chunks)),
+    )
+    return sums, (h, e, targets, weights)
+
+
+def _bwd(num_chunks: int, res, cts):
+    h, e, targets, weights = res
+    g_loss = cts[0]  # cotangent for correct_sum (cts[1]) is ignored
+
+    def body(de_acc, blk):
+        hb, tb, wb = blk
+        logits = jax.lax.dot_general(
+            hb, e, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        p = jax.nn.softmax(logits, axis=-1)
+        onehot = jax.nn.one_hot(tb, e.shape[0], dtype=jnp.float32)
+        dlogit = (p - onehot) * (wb * g_loss)[:, None]  # [chunk, V] f32
+        dh_b = jax.lax.dot_general(
+            dlogit, e, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(h.dtype)
+        de_acc = de_acc + jax.lax.dot_general(
+            dlogit, hb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return de_acc, dh_b
+
+    de, dh = jax.lax.scan(
+        body, jnp.zeros(e.shape, jnp.float32),
+        (_split(h, num_chunks), _split(targets, num_chunks),
+         _split(weights, num_chunks)),
+    )
+    return (dh.reshape(h.shape), de.astype(e.dtype), None, None)
+
+
+_fused_ce_sums.defvjp(_fwd, _bwd)

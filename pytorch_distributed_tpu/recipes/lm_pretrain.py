@@ -63,6 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--lr over --steps (fixed lr otherwise)")
     p.add_argument("--clip-grad-norm", type=float, default=0.0,
                    help=">0: in-graph global-norm gradient clipping")
+    p.add_argument("--fused-ce", type=int, default=0, metavar="CHUNKS",
+                   help="fused tied-head+CE loss in CHUNKS row blocks "
+                        "(ops/fused_ce.py): the [B,L,vocab] logits tensor "
+                        "never materializes — big-vocab HBM/memory lever; "
+                        "0 = unfused (exact parity tested either way)")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation microbatches inside the "
                         "compiled step (long-context memory relief; "
@@ -158,6 +163,10 @@ def main(argv=None) -> float:
     if args.remat and args.pp <= 1:
         raise SystemExit("--remat applies to the pipeline stages "
                          "(requires --pp > 1)")
+    if args.fused_ce and args.pp > 1:
+        raise SystemExit("--fused-ce applies to the non-pipelined loss "
+                         "path (the pipeline schedules own their loss "
+                         "head); drop --pp or --fused-ce")
     if args.accum_steps > 1 and args.pp > 1:
         raise SystemExit("--accum-steps with --pp is redundant: the pipeline "
                          "schedule already microbatches; raise "
@@ -329,7 +338,7 @@ def main(argv=None) -> float:
             eval_dataset=eval_dataset, eval_every=args.eval_every,
             eval_batches=args.eval_batches,
             lr_schedule=schedule, clip_grad_norm=args.clip_grad_norm,
-            accum_steps=args.accum_steps,
+            accum_steps=args.accum_steps, fused_ce_chunks=args.fused_ce,
         )
         final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
         if args.generate > 0:  # plain-dp only, validated with the args above

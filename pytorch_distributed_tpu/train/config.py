@@ -63,6 +63,12 @@ class Config:
     # Fold BN-backward dx into the 1x1 dgrad/wgrad via the Pallas fused
     # kernel (ops/fused_conv_bn.py); ResNet bottleneck family only.
     fused_convbn: bool = False
+    # Cross-replica SyncBN for the explicit-collectives (shard_map) step:
+    # psum the BN moments over the data axis so statistics cover the
+    # global batch, matching GSPMD's implicit semantics.  ≙ torch
+    # nn.SyncBatchNorm — the capability torch users reach for at small
+    # per-device batch.  No effect under GSPMD (already synced).
+    sync_bn: bool = False
     resume: Optional[str] = None
     # Default under runs/ so checkpoints never land in the repo root
     # (workspace-hygiene; save_checkpoint creates the directory).
@@ -169,6 +175,11 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "dgrad/wgrad (Pallas, 1x1 + stride-1 3x3; dy never hits "
                    "HBM); checkpoints stay interchangeable with the "
                    "unfused model")
+    p.add_argument("--sync-bn", action="store_true", dest="sync_bn",
+                   help="cross-replica BatchNorm for the explicit-"
+                   "collectives step: psum the batch moments over the data "
+                   "axis (global-batch statistics, = torch SyncBatchNorm); "
+                   "GSPMD runs already have this semantics implicitly")
     return p
 
 

@@ -147,6 +147,7 @@ def make_lm_train_step(
     data_axis: str = "data",
     clip_grad_norm: float = 0.0,
     accum_steps: int = 1,
+    fused_ce_chunks: int = 0,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
@@ -168,9 +169,39 @@ def make_lm_train_step(
             "schedule already splits the batch into pipeline microbatches; "
             "raise n_microbatches instead"
         )
+    if fused_ce_chunks and manual:
+        raise ValueError(
+            "fused_ce_chunks composes with autodiff loss_fn models only, "
+            "not the 1F1B pipeline's manual-gradient schedule")
 
     def step(state: TrainState, tokens: jnp.ndarray, lr: jnp.ndarray):
         def loss_fn(params, toks):
+            if fused_ce_chunks:
+                # Fused tied-head + CE (ops/fused_ce.py): the [B, L, V]
+                # logits tensor never materializes — hidden rows project
+                # against the tied embedding per chunk inside a custom VJP.
+                from pytorch_distributed_tpu.ops.fused_ce import (
+                    fused_ce_sums,
+                )
+
+                hidden, sown = model.apply(
+                    {"params": params}, toks, mutable=["losses"],
+                    return_hidden=True,
+                )
+                d = hidden.shape[-1]
+                cdt = getattr(model, "dtype", jnp.float32)
+                h = hidden[:, :-1].reshape(-1, d).astype(cdt)
+                t = toks[:, 1:].reshape(-1)
+                w = jnp.ones(t.shape, jnp.float32)
+                e = params["embed"]["embedding"].astype(cdt)
+                loss_sum, correct = fused_ce_sums(
+                    h, e, t, w, fused_ce_chunks)
+                ntok = h.shape[0]
+                loss = loss_sum / ntok
+                for leaf in jax.tree_util.tree_leaves(
+                        sown.get("losses", {})):
+                    loss = loss + leaf
+                return loss, correct / ntok
             # mutable=["losses"] collects sown auxiliary objectives (the MoE
             # router's load-balancing loss); {} for dense models.
             logits, sown = model.apply(
@@ -323,6 +354,7 @@ class LMTrainer:
         preempt=None,
         prefetch: int = 2,
         accum_steps: int = 1,
+        fused_ce_chunks: int = 0,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -362,7 +394,8 @@ class LMTrainer:
         self.lr_schedule = lr_schedule
         self.step_fn = make_lm_train_step(model, mesh, self.param_specs,
                                           clip_grad_norm=clip_grad_norm,
-                                          accum_steps=accum_steps)
+                                          accum_steps=accum_steps,
+                                          fused_ce_chunks=fused_ce_chunks)
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every

@@ -84,12 +84,25 @@ class Trainer:
         extra = {} if cfg.stem == "conv7" else {"stem": cfg.stem}
         if cfg.fused_convbn:
             extra["fused_convbn"] = True
+        if getattr(cfg, "sync_bn", False) and explicit_collectives:
+            if cfg.fused_convbn:
+                # The fold gate (models/resnet.py _fuse_ok) has no
+                # synced-stats kernel and would silently drop the fold —
+                # make the conflict loud instead.
+                raise ValueError(
+                    "--sync-bn and --fused-convbn are mutually exclusive: "
+                    "the fused conv+BN backward has no cross-replica "
+                    "statistics variant; drop one of the flags")
+            # Cross-replica BN moments inside the shard_map step (torch
+            # SyncBatchNorm ≙); GSPMD already has global-batch semantics,
+            # so the flag is a documented no-op there.
+            extra["bn_axis_name"] = data_axis
         if extra and getattr(
             models._REGISTRY.get(cfg.arch), "func", None
         ) is not models.ResNet:
             raise ValueError(
-                f"--stem/--fused-convbn only apply to the ResNet family; "
-                f"arch {cfg.arch!r} has no such variant"
+                f"--stem/--fused-convbn/--sync-bn only apply to the ResNet "
+                f"family; arch {cfg.arch!r} has no such variant"
             )
         self.model = models.create_model(
             cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra

@@ -1,8 +1,13 @@
 #!/bin/bash
 # Probe the axon tunnel in fresh subprocesses (a wedged jax.devices()
 # poisons its interpreter — only a clean process can retry); whenever the
-# tunnel answers and the host is not running the test suite, (re)run the
-# resumable arch sweep until RESULTS_archs.json holds every arch.
+# tunnel answers and the host is not running the test suite, run the
+# on-chip capture queue in priority order until every target artifact is
+# complete.  Round-5 queue (VERDICT r4 "Next round" #2/#4/#5):
+#   1. arch_bench      -> RESULTS_archs.json       (13-arch fig1 table)
+#   2. decode_bench    -> int8 + speculative + b32-breakdown + long-prefill
+#   3. bench.py        -> fresh BENCH_LKG (non-stale BENCH_r05 source)
+#   4. lm_bench        -> fused-CE MFU rows (the declared perf axis)
 cd /root/repo || exit 1
 mkdir -p runs
 LOG=runs/tunnel_watch.log
@@ -10,7 +15,7 @@ want=${ARCH_WATCH_WANT:-13}
 # Fresh retry budget per watcher launch: the cap separates deterministic
 # failures within ONE session from transient tunnel deaths; it must not
 # outlive the session that observed them.
-rm -f runs/decode_bench.tries
+rm -f runs/decode_bench.tries runs/lm_bench.tries runs/bench_lkg.tries
 for i in $(seq 1 300); do
   # Count every recorded row, error rows included: a deterministically
   # failing arch is a final answer, not a reason to re-run forever.
@@ -22,38 +27,61 @@ except Exception:
     print(0)
 PY
 )
-  quant_done=$(python - <<'PY' 2>/dev/null
+  decode_done=$(python - <<'PY' 2>/dev/null
 import json
 try:
     d = json.load(open("RESULTS_decode.json"))["configs"]
-    # BOTH promised int8 rows (a partial capture is not done).
-    keys = {k for k in d if k.endswith("_int8w")}
-    print(1 if {"b1_p512_greedy_int8w", "b8_p512_greedy_int8w"} <= keys
-          else 0)
+    need = {"b1_p512_greedy_int8w", "b8_p512_greedy_int8w",
+            "b1_spec_t1.0", "b32_breakdown", "b1_p4096_prefill_flash"}
+    print(1 if need <= set(d) else 0)
 except Exception:
     print(0)
 PY
 )
-  [ "${quant_done:-0}" = "1" ] && rm -f runs/decode_bench.tries
-  tries_now=$(cat runs/decode_bench.tries 2>/dev/null || echo 0)
-  if [ "${have:-0}" -ge "$want" ] && { [ "${quant_done:-0}" = "1" ] || [ "$tries_now" -ge 3 ]; }; then
-    echo "$(date -u +%H:%M:%S) captures finished (int8 ok=$quant_done tries=$tries_now)" >> "$LOG"
+  lm_done=$(python - <<'PY' 2>/dev/null
+import json
+try:
+    d = json.load(open("RESULTS_lm.json"))["configs"]
+    print(1 if "L1024_b4_flash_fusedce8" in d else 0)
+except Exception:
+    print(0)
+PY
+)
+  [ "${decode_done:-0}" = "1" ] && rm -f runs/decode_bench.tries
+  [ "${lm_done:-0}" = "1" ] && rm -f runs/lm_bench.tries
+  d_tries=$(cat runs/decode_bench.tries 2>/dev/null || echo 0)
+  l_tries=$(cat runs/lm_bench.tries 2>/dev/null || echo 0)
+  b_tries=$(cat runs/bench_lkg.tries 2>/dev/null || echo 0)
+  if [ "${have:-0}" -ge "$want" ] \
+     && { [ "${decode_done:-0}" = "1" ] || [ "$d_tries" -ge 3 ]; } \
+     && { [ "${lm_done:-0}" = "1" ] || [ "$l_tries" -ge 3 ]; } \
+     && [ "$b_tries" -ge 1 ]; then
+    echo "$(date -u +%H:%M:%S) captures finished (decode=$decode_done lm=$lm_done)" >> "$LOG"
     exit 0
   fi
   if ! pgrep -f "pytest tests/" >/dev/null 2>&1; then
     if timeout 60 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-      echo "$(date -u +%H:%M:%S) tunnel up ($have/$want archs, int8 $quant_done) -> captures" >> "$LOG"
+      echo "$(date -u +%H:%M:%S) tunnel up (archs $have/$want decode $decode_done lm $lm_done bench $b_tries) -> captures" >> "$LOG"
       if [ "${have:-0}" -lt "$want" ]; then
         timeout 2700 env PYTHONPATH=/root/repo:/root/.axon_site \
           python -u experiments/arch_bench.py >> "$LOG" 2>&1
       fi
-      # Cap decode-bench retries: a deterministic failure is a final
-      # answer here too, not a reason to re-run a 20-min bench forever.
-      tries=$(cat runs/decode_bench.tries 2>/dev/null || echo 0)
-      if [ "${quant_done:-0}" != "1" ] && [ "$tries" -lt 3 ]; then
-        echo $((tries + 1)) > runs/decode_bench.tries
-        timeout 1200 env PYTHONPATH=/root/repo:/root/.axon_site \
+      # Cap per-bench retries: a deterministic failure is a final answer,
+      # not a reason to re-run a 20-min bench forever.
+      if [ "${decode_done:-0}" != "1" ] && [ "$d_tries" -lt 3 ]; then
+        echo $((d_tries + 1)) > runs/decode_bench.tries
+        timeout 1800 env PYTHONPATH=/root/repo:/root/.axon_site \
           python -u experiments/decode_bench.py >> "$LOG" 2>&1
+      fi
+      if [ "$b_tries" -lt 1 ]; then
+        echo $((b_tries + 1)) > runs/bench_lkg.tries
+        timeout 1200 env PYTHONPATH=/root/repo:/root/.axon_site \
+          python -u bench.py >> "$LOG" 2>&1
+      fi
+      if [ "${lm_done:-0}" != "1" ] && [ "$l_tries" -lt 3 ]; then
+        echo $((l_tries + 1)) > runs/lm_bench.tries
+        timeout 2400 env PYTHONPATH=/root/repo:/root/.axon_site \
+          python -u experiments/lm_bench.py >> "$LOG" 2>&1
       fi
       echo "$(date -u +%H:%M:%S) capture attempt ended" >> "$LOG"
     fi
