@@ -249,7 +249,7 @@ def main() -> int:
         # reuse the recorded value instead of re-decoding every val JPEG
         # each invocation (children inherit it via the merged file).
         for k in ("oracle_estimator_top1", "achievable_pct",
-                  "achievable_note"):
+                  "achievable_note", "achievable_conclusion"):
             if k in prior_meta:
                 meta[k] = prior_meta[k]
         if "oracle_estimator_top1" not in meta and not is_child:
