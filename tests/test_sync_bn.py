@@ -141,6 +141,28 @@ def test_pershard_bn_differs_from_syncbn():
     assert abs(float(m_no["loss"]) - float(m_gs["loss"])) > 1e-4
 
 
+def test_sync_bn_trainer_gates():
+    """--sync-bn config gates: conflicts with --fused-convbn (no synced
+    fold kernel), rejected for non-ResNet archs; accepted quietly under
+    GSPMD (documented no-op)."""
+    import pytest
+
+    from pytorch_distributed_tpu.train.config import Config
+    from pytorch_distributed_tpu.train.trainer import Trainer
+
+    def cfg(**kw):
+        kw.setdefault("arch", "resnet18")
+        return Config(synthetic=True, synthetic_length=16, batch_size=16,
+                      image_size=32, num_classes=4, epochs=1, **kw)
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Trainer(cfg(sync_bn=True, fused_convbn=True, arch="resnet50"),
+                explicit_collectives=True)
+    with pytest.raises(ValueError, match="ResNet"):
+        Trainer(cfg(sync_bn=True, arch="mobilenet_v2"),
+                explicit_collectives=True)
+
+
 def test_sync_bn_axis_name_disables_convbn_fold():
     """fused_convbn + sync BN: the fold gate must reject (no synced-stats
     Pallas kernel) and fall back to the unfused composition — same
