@@ -13,12 +13,13 @@ import jax.numpy as jnp
 class _DenseLayer(nn.Module):
     growth: int
     dtype: Any
+    bn_axis_name: Any = None  # SyncBN mesh axis (torch SyncBatchNorm ≙)
 
     @nn.compact
     def __call__(self, x, train: bool):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name,
         )
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         h = nn.relu(norm()(x))
@@ -34,12 +35,15 @@ class DenseNet(nn.Module):
     init_features: int = 64
     num_classes: int = 1000
     dtype: Any = jnp.float32
+    # SyncBN under shard_map (--sync-bn): flax BatchNorm pmeans the batch
+    # moments over this mesh axis.  None = per-shard statistics.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name,
         )
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         x = x.astype(self.dtype)
@@ -49,6 +53,7 @@ class DenseNet(nn.Module):
         for bi, layers in enumerate(self.block_config):
             for li in range(layers):
                 x = _DenseLayer(self.growth, self.dtype,
+                                bn_axis_name=self.bn_axis_name,
                                 name=f"block{bi}_layer{li}")(x, train)
             if bi != len(self.block_config) - 1:
                 # Transition: 1x1 conv halving channels + 2x2 avg pool.

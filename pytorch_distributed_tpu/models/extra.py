@@ -90,12 +90,13 @@ class _ShuffleUnit(nn.Module):
     out_ch: int
     stride: int
     dtype: Any
+    bn_axis_name: Any = None  # SyncBN mesh axis (torch SyncBatchNorm ≙)
 
     @nn.compact
     def __call__(self, x, train: bool):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype)
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name)
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         branch = self.out_ch // 2
 
@@ -122,21 +123,26 @@ class ShuffleNetV2(nn.Module):
     stage_out: Sequence[int]  # (c2, c3, c4, c_final)
     num_classes: int = 1000
     dtype: Any = jnp.float32
+    # SyncBN under shard_map (--sync-bn): flax BatchNorm pmeans the batch
+    # moments over this mesh axis.  None = per-shard statistics.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype)
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name)
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         x = x.astype(self.dtype)
         x = nn.relu(norm()(conv(24, (3, 3), (2, 2),
                                 padding=[(1, 1), (1, 1)])(x)))
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for stage, repeats in zip(self.stage_out[:3], (4, 8, 4)):
-            x = _ShuffleUnit(stage, 2, self.dtype)(x, train)
+            x = _ShuffleUnit(stage, 2, self.dtype,
+                             bn_axis_name=self.bn_axis_name)(x, train)
             for _ in range(repeats - 1):
-                x = _ShuffleUnit(stage, 1, self.dtype)(x, train)
+                x = _ShuffleUnit(stage, 1, self.dtype,
+                                 bn_axis_name=self.bn_axis_name)(x, train)
         x = nn.relu(norm()(conv(self.stage_out[3], (1, 1))(x)))
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
@@ -150,12 +156,13 @@ class _MBConv(nn.Module):
     expand: int
     kernel: int
     dtype: Any
+    bn_axis_name: Any = None  # SyncBN mesh axis (torch SyncBatchNorm ≙)
 
     @nn.compact
     def __call__(self, x, train: bool):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype)
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name)
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         in_ch = x.shape[-1]
         hidden = in_ch * self.expand
@@ -195,12 +202,15 @@ class MNASNet(nn.Module):
     alpha: float = 1.0
     num_classes: int = 1000
     dtype: Any = jnp.float32
+    # SyncBN under shard_map (--sync-bn): flax BatchNorm pmeans the batch
+    # moments over this mesh axis.  None = per-shard statistics.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype)
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name)
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         x = x.astype(self.dtype)
         c32 = _round_to_8(32 * self.alpha)
@@ -214,9 +224,11 @@ class MNASNet(nn.Module):
         x = norm()(conv(c16, (1, 1))(x))
         for expand, ch, repeats, stride, kernel in _MNAS_SETTINGS:
             out = _round_to_8(ch * self.alpha)
-            x = _MBConv(out, stride, expand, kernel, self.dtype)(x, train)
+            x = _MBConv(out, stride, expand, kernel, self.dtype,
+                        bn_axis_name=self.bn_axis_name)(x, train)
             for _ in range(repeats - 1):
-                x = _MBConv(out, 1, expand, kernel, self.dtype)(x, train)
+                x = _MBConv(out, 1, expand, kernel, self.dtype,
+                            bn_axis_name=self.bn_axis_name)(x, train)
         x = nn.relu(norm()(conv(1280, (1, 1))(x)))
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.2, deterministic=not train)(x)

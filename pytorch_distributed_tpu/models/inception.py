@@ -45,6 +45,7 @@ class BasicConv2d(nn.Module):
     strides: Tuple[int, int] = (1, 1)
     padding: Any = ((0, 0), (0, 0))
     dtype: Any = jnp.float32
+    bn_axis_name: Any = None  # SyncBN mesh axis (torch SyncBatchNorm ≙)
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -54,7 +55,7 @@ class BasicConv2d(nn.Module):
         )(x)
         x = nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-3,
-            dtype=self.dtype, name="bn",
+            dtype=self.dtype, name="bn", axis_name=self.bn_axis_name,
         )(x)
         return nn.relu(x)
 
@@ -87,10 +88,12 @@ class _Inception(nn.Module):
     c5: int
     cp: int
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
         b1 = conv(self.c1, (1, 1), name="branch1")(x, train)
         b2 = conv(self.c3r, (1, 1), name="branch2_0")(x, train)
         b2 = conv(self.c3, (3, 3), padding=((1, 1), (1, 1)),
@@ -110,11 +113,14 @@ class _GoogLeNetAux(nn.Module):
 
     num_classes: int
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
         x = _adaptive_avg_pool(x, 4)
-        x = BasicConv2d(128, (1, 1), dtype=self.dtype, name="conv")(x, train)
+        x = BasicConv2d(128, (1, 1), dtype=self.dtype,
+                        bn_axis_name=self.bn_axis_name,
+                        name="conv")(x, train)
         x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
         x = nn.relu(nn.Dense(1024, name="fc1")(x))
         x = nn.Dropout(0.7, deterministic=not train)(x)
@@ -127,11 +133,16 @@ class GoogLeNet(nn.Module):
     num_classes: int = 1000
     aux_logits: bool = False
     dtype: Any = jnp.float32
+    # SyncBN under shard_map (--sync-bn): flax BatchNorm pmeans the batch
+    # moments over this mesh axis.  None = per-shard statistics.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True, capture_aux: bool = False):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
-        inc = functools.partial(_Inception, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
+        inc = functools.partial(_Inception, dtype=self.dtype,
+                                bn_axis_name=self.bn_axis_name)
         x = x.astype(self.dtype)
         x = conv(64, (7, 7), (2, 2), ((3, 3), (3, 3)), name="conv1")(x, train)
         x = _ceil_max_pool(x)
@@ -148,12 +159,14 @@ class GoogLeNet(nn.Module):
         want_aux = self.aux_logits and (capture_aux or self.is_initializing())
         if want_aux:
             aux1 = _GoogLeNetAux(self.num_classes, self.dtype,
+                                 bn_axis_name=self.bn_axis_name,
                                  name="aux1")(x, train)
         x = inc(160, 112, 224, 24, 64, 64, name="inception4b")(x, train)
         x = inc(128, 128, 256, 24, 64, 64, name="inception4c")(x, train)
         x = inc(112, 144, 288, 32, 64, 64, name="inception4d")(x, train)
         if want_aux:
             aux2 = _GoogLeNetAux(self.num_classes, self.dtype,
+                                 bn_axis_name=self.bn_axis_name,
                                  name="aux2")(x, train)
         x = inc(256, 160, 320, 32, 128, 128, name="inception4e")(x, train)
         x = _ceil_max_pool2(x)
@@ -173,10 +186,12 @@ class GoogLeNet(nn.Module):
 class _InceptionA(nn.Module):
     pool_features: int
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
         b1 = conv(64, (1, 1), name="branch1x1")(x, train)
         b5 = conv(48, (1, 1), name="branch5x5_1")(x, train)
         b5 = conv(64, (5, 5), padding=((2, 2), (2, 2)),
@@ -194,10 +209,12 @@ class _InceptionA(nn.Module):
 
 class _InceptionB(nn.Module):
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
         b3 = conv(384, (3, 3), (2, 2), name="branch3x3")(x, train)
         bd = conv(64, (1, 1), name="branch3x3dbl_1")(x, train)
         bd = conv(96, (3, 3), padding=((1, 1), (1, 1)),
@@ -210,10 +227,12 @@ class _InceptionB(nn.Module):
 class _InceptionC(nn.Module):
     c7: int
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
         c7 = self.c7
         p71 = ((0, 0), (3, 3))  # 1x7
         p17 = ((3, 3), (0, 0))  # 7x1
@@ -234,10 +253,12 @@ class _InceptionC(nn.Module):
 
 class _InceptionD(nn.Module):
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
         b3 = conv(192, (1, 1), name="branch3x3_1")(x, train)
         b3 = conv(320, (3, 3), (2, 2), name="branch3x3_2")(b3, train)
         b7 = conv(192, (1, 1), name="branch7x7x3_1")(x, train)
@@ -252,10 +273,12 @@ class _InceptionD(nn.Module):
 
 class _InceptionE(nn.Module):
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
         b1 = conv(320, (1, 1), name="branch1x1")(x, train)
         b3 = conv(384, (1, 1), name="branch3x3_1")(x, train)
         b3 = jnp.concatenate([
@@ -291,14 +314,18 @@ class _InceptionAux(nn.Module):
 
     num_classes: int
     dtype: Any
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool):
         H, W = x.shape[1:3]
         x = nn.avg_pool(x, (min(5, H), min(5, W)), strides=(3, 3))
-        x = BasicConv2d(128, (1, 1), dtype=self.dtype, name="conv0")(x, train)
+        x = BasicConv2d(128, (1, 1), dtype=self.dtype,
+                        bn_axis_name=self.bn_axis_name,
+                        name="conv0")(x, train)
         pad = "VALID" if min(x.shape[1:3]) >= 5 else "SAME"
         x = BasicConv2d(768, (5, 5), padding=pad, dtype=self.dtype,
+                        bn_axis_name=self.bn_axis_name,
                         name="conv1")(x, train)
         x = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
         return nn.Dense(self.num_classes, name="fc")(x)
@@ -312,10 +339,14 @@ class InceptionV3(nn.Module):
     num_classes: int = 1000
     aux_logits: bool = False
     dtype: Any = jnp.float32
+    # SyncBN under shard_map (--sync-bn): flax BatchNorm pmeans the batch
+    # moments over this mesh axis.  None = per-shard statistics.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True, capture_aux: bool = False):
-        conv = functools.partial(BasicConv2d, dtype=self.dtype)
+        conv = functools.partial(BasicConv2d, dtype=self.dtype,
+                                 bn_axis_name=self.bn_axis_name)
         x = x.astype(self.dtype)
         x = conv(32, (3, 3), (2, 2), name="Conv2d_1a_3x3")(x, train)
         x = conv(32, (3, 3), name="Conv2d_2a_3x3")(x, train)
@@ -325,21 +356,22 @@ class InceptionV3(nn.Module):
         x = conv(80, (1, 1), name="Conv2d_3b_1x1")(x, train)
         x = conv(192, (3, 3), name="Conv2d_4a_3x3")(x, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = _InceptionA(32, self.dtype, name="Mixed_5b")(x, train)
-        x = _InceptionA(64, self.dtype, name="Mixed_5c")(x, train)
-        x = _InceptionA(64, self.dtype, name="Mixed_5d")(x, train)
-        x = _InceptionB(self.dtype, name="Mixed_6a")(x, train)
-        x = _InceptionC(128, self.dtype, name="Mixed_6b")(x, train)
-        x = _InceptionC(160, self.dtype, name="Mixed_6c")(x, train)
-        x = _InceptionC(160, self.dtype, name="Mixed_6d")(x, train)
-        x = _InceptionC(192, self.dtype, name="Mixed_6e")(x, train)
+        x = _InceptionA(32, self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_5b")(x, train)
+        x = _InceptionA(64, self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_5c")(x, train)
+        x = _InceptionA(64, self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_5d")(x, train)
+        x = _InceptionB(self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_6a")(x, train)
+        x = _InceptionC(128, self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_6b")(x, train)
+        x = _InceptionC(160, self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_6c")(x, train)
+        x = _InceptionC(160, self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_6d")(x, train)
+        x = _InceptionC(192, self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_6e")(x, train)
         aux = None
         if self.aux_logits and (capture_aux or self.is_initializing()):
             aux = _InceptionAux(self.num_classes, self.dtype,
+                                bn_axis_name=self.bn_axis_name,
                                 name="AuxLogits")(x, train)
-        x = _InceptionD(self.dtype, name="Mixed_7a")(x, train)
-        x = _InceptionE(self.dtype, name="Mixed_7b")(x, train)
-        x = _InceptionE(self.dtype, name="Mixed_7c")(x, train)
+        x = _InceptionD(self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_7a")(x, train)
+        x = _InceptionE(self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_7b")(x, train)
+        x = _InceptionE(self.dtype, bn_axis_name=self.bn_axis_name, name="Mixed_7c")(x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dropout(0.5, deterministic=not train)(x)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(

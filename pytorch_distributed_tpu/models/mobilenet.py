@@ -22,12 +22,13 @@ class _InvertedResidual(nn.Module):
     stride: int
     expand: int
     dtype: Any
+    bn_axis_name: Any = None  # SyncBN mesh axis (torch SyncBatchNorm ≙)
 
     @nn.compact
     def __call__(self, x, train: bool):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name,
         )
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         in_ch = x.shape[-1]
@@ -60,12 +61,15 @@ class MobileNetV2(nn.Module):
     num_classes: int = 1000
     width_mult: float = 1.0
     dtype: Any = jnp.float32
+    # SyncBN under shard_map (--sync-bn): flax BatchNorm pmeans the batch
+    # moments over this mesh axis.  None = per-shard statistics.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis_name,
         )
         conv = functools.partial(nn.Conv, dtype=self.dtype, use_bias=False)
         x = x.astype(self.dtype)
@@ -75,7 +79,8 @@ class MobileNetV2(nn.Module):
             out_ch = _make_divisible(c * self.width_mult)
             for i in range(reps):
                 x = _InvertedResidual(
-                    out_ch, s if i == 0 else 1, expand, self.dtype
+                    out_ch, s if i == 0 else 1, expand, self.dtype,
+                    bn_axis_name=self.bn_axis_name,
                 )(x, train)
         last = _make_divisible(1280 * max(1.0, self.width_mult))
         x = nn.relu6(norm()(conv(last, (1, 1))(x)))

@@ -76,9 +76,17 @@ class VGG(nn.Module):
     batch_norm: bool = False
     num_classes: int = 1000
     dtype: Any = jnp.float32
+    # SyncBN under shard_map (--sync-bn): flax BatchNorm pmeans the batch
+    # moments over this mesh axis.  None = per-shard statistics.
+    bn_axis_name: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.bn_axis_name is not None and not self.batch_norm:
+            raise ValueError(
+                "bn_axis_name (--sync-bn) on a plain VGG: this variant "
+                "has no BatchNorm layers to synchronize — use the "
+                "*_bn arch or drop the flag")
         conv = functools.partial(nn.Conv, dtype=self.dtype)
         x = x.astype(self.dtype)
         for v in self.cfg:
@@ -90,6 +98,7 @@ class VGG(nn.Module):
                     x = nn.BatchNorm(
                         use_running_average=not train, momentum=0.9,
                         epsilon=1e-5, dtype=self.dtype,
+                        axis_name=self.bn_axis_name,
                     )(x)
                 x = nn.relu(x)
         x = _adaptive_avg_pool(x, 7)

@@ -84,6 +84,13 @@ class Trainer:
         extra = {} if cfg.stem == "conv7" else {"stem": cfg.stem}
         if cfg.fused_convbn:
             extra["fused_convbn"] = True
+        if extra and getattr(
+            models._REGISTRY.get(cfg.arch), "func", None
+        ) is not models.ResNet:
+            raise ValueError(
+                f"--stem/--fused-convbn only apply to the ResNet family; "
+                f"arch {cfg.arch!r} has no such variant"
+            )
         if getattr(cfg, "sync_bn", False) and explicit_collectives:
             if cfg.fused_convbn:
                 # The fold gate (models/resnet.py _fuse_ok) has no
@@ -94,19 +101,24 @@ class Trainer:
                     "the fused conv+BN backward has no cross-replica "
                     "statistics variant; drop one of the flags")
             # Cross-replica BN moments inside the shard_map step (torch
-            # SyncBatchNorm ≙); GSPMD already has global-batch semantics,
-            # so the flag is a documented no-op there.
+            # SyncBatchNorm ≙, model-agnostic like torch's): every BN
+            # model family threads bn_axis_name into its norm layers.
+            # GSPMD already has global-batch semantics, so the flag is a
+            # documented no-op there.
             extra["bn_axis_name"] = data_axis
-        if extra and getattr(
-            models._REGISTRY.get(cfg.arch), "func", None
-        ) is not models.ResNet:
-            raise ValueError(
-                f"--stem/--fused-convbn/--sync-bn only apply to the ResNet "
-                f"family; arch {cfg.arch!r} has no such variant"
+        try:
+            self.model = models.create_model(
+                cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra
             )
-        self.model = models.create_model(
-            cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra
-        )
+        except TypeError as e:
+            # The canonical CPython rejected-kwarg message, not a loose
+            # substring: only a constructor that genuinely lacks the
+            # bn_axis_name knob (BN-free arch) lands here.
+            if "unexpected keyword argument 'bn_axis_name'" in str(e):
+                raise ValueError(
+                    f"--sync-bn: arch {cfg.arch!r} has no BatchNorm layers "
+                    f"to synchronize (no bn_axis_name knob)") from e
+            raise
 
         seed = cfg.seed if cfg.seed is not None else 0
         rng = jax.random.PRNGKey(seed)

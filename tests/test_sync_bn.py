@@ -143,7 +143,7 @@ def test_pershard_bn_differs_from_syncbn():
 
 def test_sync_bn_trainer_gates():
     """--sync-bn config gates: conflicts with --fused-convbn (no synced
-    fold kernel), rejected for non-ResNet archs; accepted quietly under
+    fold kernel), rejected for BN-free archs; accepted quietly under
     GSPMD (documented no-op)."""
     import pytest
 
@@ -158,9 +158,53 @@ def test_sync_bn_trainer_gates():
     with pytest.raises(ValueError, match="mutually exclusive"):
         Trainer(cfg(sync_bn=True, fused_convbn=True, arch="resnet50"),
                 explicit_collectives=True)
-    with pytest.raises(ValueError, match="ResNet"):
-        Trainer(cfg(sync_bn=True, arch="mobilenet_v2"),
+    with pytest.raises(ValueError, match="no BatchNorm"):
+        Trainer(cfg(sync_bn=True, arch="alexnet"),
                 explicit_collectives=True)
+    # plain VGG carries the field (the *_bn variants share the class) but
+    # has no BN layers — must refuse rather than silently no-op
+    with pytest.raises(ValueError, match="no BatchNorm"):
+        Trainer(cfg(sync_bn=True, arch="vgg11"),
+                explicit_collectives=True)
+
+
+def test_explicit_syncbn_step_matches_gspmd_flax_bn_model():
+    """The flax-BatchNorm(axis_name) path (zoo-wide --sync-bn, torch
+    SyncBatchNorm is model-agnostic): one explicit+sync step on
+    shufflenet_v2 (dropout-free, so the two formulations' rng streams
+    cannot diverge the comparison) == one GSPMD step (global-batch BN)."""
+    mesh = _mesh()
+    kw = dict(num_classes=10, dtype=jnp.float32)
+    model_sync = create_model("shufflenet_v2_x0_5", bn_axis_name="data",
+                              **kw)
+    model_plain = create_model("shufflenet_v2_x0_5", **kw)
+
+    sample = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = model_plain.init(jax.random.PRNGKey(0), sample, train=False)
+    mk_state = lambda: TrainState.create(  # noqa: E731
+        jax.tree_util.tree_map(jnp.copy, variables),
+        sgd_init(variables["params"]))
+
+    rng = np.random.default_rng(4)
+    batch = {
+        "images": jnp.asarray(
+            rng.normal(0, 1, size=(16, 32, 32, 3)), jnp.float32),
+        "labels": jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32),
+        "weights": jnp.ones((16,), jnp.float32),
+    }
+    lr = jnp.float32(0.1)
+    s1, m1 = make_train_step(model_sync, mesh, explicit_collectives=True)(
+        mk_state(), batch, lr)
+    s2, m2 = make_train_step(model_plain, mesh)(mk_state(), batch, lr)
+    for k in m1:
+        np.testing.assert_allclose(
+            float(m1[k]), float(m2[k]), rtol=1e-4, atol=1e-4)
+    got = jax.tree_util.tree_leaves_with_path(s1.params)
+    want = dict(jax.tree_util.tree_leaves_with_path(s2.params))
+    for path, v in got:
+        np.testing.assert_allclose(
+            np.asarray(v), np.asarray(want[path]), rtol=5e-3, atol=5e-3,
+            err_msg=jax.tree_util.keystr(path))
 
 
 def test_sync_bn_axis_name_disables_convbn_fold():
