@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Compiled-peak memory of the LM train step: fused vs unfused loss head.
+
+The fused tied-head+CE (ops/fused_ce.py) exists to keep the [B·L, vocab]
+logits tensor out of HBM.  The throughput half of that claim needs the
+chip (lm_bench fused rows, armed in tunnel_watch); the MEMORY half is a
+compile-time fact XLA will state on any backend: lower + compile the full
+train step (fwd+bwd+SGD) both ways and read ``memory_analysis()`` peak
+temp bytes — the same compiled-peak methodology as experiments/pp_memory.py
+(RESULTS_pp_memory.json).
+
+Writes ``RESULTS_fused_ce_memory.json``.  CPU-safe (compile only):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    PYTHONPATH=/root/repo python experiments/fused_ce_memory.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+D_MODEL = int(os.environ.get("FCM_D", "1024"))
+N_LAYERS = int(os.environ.get("FCM_LAYERS", "12"))
+N_HEADS = int(os.environ.get("FCM_HEADS", "16"))
+VOCAB = int(os.environ.get("FCM_VOCAB", "32000"))
+SEQ = int(os.environ.get("FCM_SEQ", "1024"))
+# Must divide the data-axis device count (8 on the simulated CPU mesh).
+BATCH = int(os.environ.get("FCM_BATCH", "8"))
+CHUNKS = int(os.environ.get("FCM_CHUNKS", "8"))
+
+
+def peak_bytes(fused_ce: int) -> dict:
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.parallel.tp import replicated_like
+    from pytorch_distributed_tpu.train.lm import make_lm_train_step
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+
+    mesh = data_parallel_mesh()
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=D_MODEL, n_heads=N_HEADS,
+        n_layers=N_LAYERS, dtype=jnp.bfloat16, attn_impl="dense",
+    )
+    toks = jnp.zeros((BATCH, SEQ), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks[:1, :8])["params"]
+    state = TrainState.create({"params": params}, sgd_init(params))
+    step = make_lm_train_step(model, mesh, replicated_like(params),
+                              fused_ce_chunks=fused_ce)
+    compiled = step.lower(state, toks, jnp.float32(1e-3)).compile()
+    m = compiled.memory_analysis()
+    return {
+        "temp_bytes_mib": round(m.temp_size_in_bytes / 2**20, 1),
+        "peak_mib": round(
+            (m.temp_size_in_bytes + m.argument_size_in_bytes
+             + m.output_size_in_bytes) / 2**20, 1),
+    }
+
+
+def main() -> int:
+    logits_mib = BATCH * (SEQ - 1) * VOCAB * 4 / 2**20
+    rows = {}
+    for tag, chunks in (("unfused", 0), (f"fused_c{CHUNKS}", CHUNKS)):
+        rows[tag] = peak_bytes(chunks)
+        print(f"{tag}: temp {rows[tag]['temp_bytes_mib']} MiB "
+              f"(peak {rows[tag]['peak_mib']} MiB)", flush=True)
+    saved = (rows["unfused"]["temp_bytes_mib"]
+             - rows[f"fused_c{CHUNKS}"]["temp_bytes_mib"])
+    out = {
+        "meta": {
+            "d_model": D_MODEL, "n_layers": N_LAYERS, "n_heads": N_HEADS,
+            "vocab": VOCAB, "seq": SEQ, "batch": BATCH, "chunks": CHUNKS,
+            "platform": jax.default_backend(),
+            "analytic_logits_f32_mib": round(logits_mib, 1),
+            "what": "XLA compiled-peak temp buffers of the full LM train "
+                    "step (fwd+bwd+SGD, bf16, dense attn), unfused logits "
+                    "head vs fused tied-head+CE (ops/fused_ce.py) — the "
+                    "pp_memory.py compiled-peak methodology",
+        },
+        "rows": rows,
+        "temp_saved_mib": round(saved, 1),
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "..", "RESULTS_fused_ce_memory.json"),
+              "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out), flush=True)
+    # The claim must be falsifiable: the fused step should save at least
+    # half the analytic f32 logits footprint.
+    assert saved > 0.5 * logits_mib, (saved, logits_mib)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
