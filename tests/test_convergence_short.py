@@ -47,6 +47,30 @@ def test_hard_oracle_miniature(tmp_path):
     assert abs(curves["fp32"][-1] - curves["bf16"][-1]) <= 8.0, curves
 
 
+def test_hue_oracle_estimator(tmp_path):
+    """The achievable-ceiling measurement tool itself
+    (experiments/convergence_hard.py oracle_estimator_top1): the
+    known-generator hue reader must score far above chance on a fresh
+    jittered dataset and near the analytic ceiling — if it ever reads
+    ~chance, the generator or the inversion broke, and achievable_pct in
+    RESULTS_convergence_hard.json would be meaningless."""
+    sys.path.insert(0, os.path.join(REPO, "experiments"))
+    try:
+        import convergence_hard as ch
+    finally:
+        sys.path.pop(0)
+
+    ch.CLASSES = 20
+    ch.PER_CLASS_TRAIN, ch.PER_CLASS_VAL = 1, 12
+    root = str(tmp_path / "data")
+    ch.make_dataset(root)
+    top1 = ch.oracle_estimator_top1(root)
+    chance = 100.0 / ch.CLASSES
+    assert top1 > 5 * chance, (top1, chance)
+    # within noise of the analytic ceiling (binomial on 240 samples)
+    assert abs(top1 - ch.CEILING) < 15.0, (top1, ch.CEILING)
+
+
 def test_lm_text_miniature(tmp_path):
     out_path = str(tmp_path / "lm_text.json")
     env = dict(os.environ)
