@@ -17,6 +17,7 @@ python -m pytorch_distributed_tpu.recipes.apex_distributed --data "$DATA"
 
 # 4. explicit collectives + compressed wire grads (ref start.sh:4: horovodrun -np 4 horovod_distributed.py)
 python -m pytorch_distributed_tpu.recipes.horovod_distributed --data "$DATA"
+# python -m pytorch_distributed_tpu.recipes.horovod_distributed --data "$DATA" --sync-bn   # cross-replica BN moments (torch SyncBatchNorm; round 5)
 
 # 5. multi-node SLURM / multi-slice pod (ref start.sh:5: srun -N2 --gres gpu:4 distributed_slurm_main.py)
 # srun -N2 --ntasks-per-node=1 python -m pytorch_distributed_tpu.recipes.distributed_slurm_main --data "$DATA"
@@ -38,6 +39,11 @@ python -m pytorch_distributed_tpu.recipes.lm_pretrain --tp 4 --seq-len 2048 -b 3
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --ep 4 --moe-top-k 2 -b 32 --steps 1000          # MoE top-2
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --pp 2 --sp 2 --tp 2 -b 16 --steps 1000          # quad mesh
 # python -m pytorch_distributed_tpu.recipes.lm_pretrain --fsdp --tp 2 -b 32 --steps 1000                 # ZeRO-3 + TP
+# python -m pytorch_distributed_tpu.recipes.lm_pretrain --vocab 32000 --fused-ce 8 -b 16 --steps 1000     # fused tied-head+CE (big-vocab memory lever, round 5)
+
+# 8b. LM serving (KV-cached decode; see also --tp N and --quant int8)
+# python -m pytorch_distributed_tpu.recipes.lm_generate --resume runs/lm/checkpoint.msgpack --vocab 256 --prompt 'def main(' -n 64 --temperature 0.8 --top-p 0.9
+# python -m pytorch_distributed_tpu.recipes.lm_generate --resume target.msgpack --spec-draft draft.msgpack --spec-gamma 4 --vocab 256 --prompt 'def main(' -n 64   # speculative decoding
 
 # 9. full native input path on real data (C++ JPEG decode + u8 wire)
 # python -m pytorch_distributed_tpu.recipes.tpu_native --data "$DATA" -a resnet50 --wire native
