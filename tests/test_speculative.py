@@ -114,6 +114,25 @@ def test_sampled_mode_multiseed(seed):
     assert sa["tokens"] == 8
 
 
+def test_speculative_int8_target():
+    """lm_generate --quant int8 --spec-draft composition: speculative
+    greedy with a quantized target equals the int8 target's own greedy
+    stream (quantization-consistent oracle)."""
+    from pytorch_distributed_tpu.models.quant import quantize_lm_params
+
+    tp, dp = _init(TARGET, 4), _init(DRAFT, 5)
+    qtp = quantize_lm_params(tp)
+    prompt = jnp.zeros((1, 5), jnp.int32)
+    n_new = 9
+    want = np.asarray(greedy_generate(
+        qtp, prompt, n_new, **TARGET, quant="int8"))
+    got, stats = speculative_generate(
+        qtp, dp, prompt, n_new, target_cfg=TARGET, draft_cfg=DRAFT,
+        gamma=3, quant="int8")
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["tokens"] == n_new
+
+
 def test_acceptance_math_preserves_target_distribution():
     """The Leviathan identity, verified empirically on crafted p/q:
     accept-or-resample must produce samples distributed as p."""
