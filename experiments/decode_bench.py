@@ -258,10 +258,18 @@ def main() -> int:
             # run recompiling all four block programs.
             speculative_generate(
                 params, draft_params, spec_prompt, spec_new, **kw)
-            t0 = time.perf_counter()
-            _, stats = speculative_generate(
-                params, draft_params, spec_prompt, spec_new, **kw)
-            dt = time.perf_counter() - t0
+            # Best-of-REPS like every other row (_time discipline — a
+            # single post-warmup sample is noise-prone on the tunneled
+            # backend); the seeded host RNG makes each repeat replay the
+            # identical draft/accept trace, so stats are rep-invariant
+            # and the min is a valid latency estimator.
+            dt = float("inf")
+            for _ in range(max(REPS, 1)):
+                t0 = time.perf_counter()
+                toks, stats = speculative_generate(
+                    params, draft_params, spec_prompt, spec_new, **kw)
+                int(toks.sum())  # value fetch = reliable queue barrier
+                dt = min(dt, time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001
             print(f"{tag}: FAILED {repr(e)[:200]}", flush=True)
             continue

@@ -14,13 +14,41 @@ loss in row chunks with a custom VJP:
   scratch; only the scalar loss/correct sums survive.
 - **backward**: recomputes each block's logits (one extra matmul pass —
   FLOPs are free here, bytes are not), forms ``softmax − onehot`` locally,
-  and accumulates ``dh`` and ``dE`` per block.  Residuals are just the
-  inputs; nothing O(N·V) is ever saved.
+  and accumulates ``dh``, ``dE``, and the per-row ``weights`` cotangent
+  (``(logz − true_logit)·ḡ`` — the loss path only; ``correct_sum`` stays
+  non-differentiable) per block.  Residuals are just the inputs; nothing
+  O(N·V) is ever saved.
+
+**Sharded composition** — three variants, selected by the sharding context
+(train/lm.py ``fused_ce_mode``):
+
+- ``fused_ce_sums`` (replicated): the GSPMD baseline.  Under pure data
+  sharding its backward carries a fully *replicated* ``[V, D]`` f32 ``dE``
+  accumulator (125 MiB/device at V32k·D1024) while the logits it eliminates
+  were already batch-sharded — measured net-neutral at 8-way
+  (RESULTS_fused_ce_memory.json round 5).
+- ``fused_ce_sums_dp`` (DP mode): explicit ``shard_map`` over the data
+  axis.  The scan's ``dE`` carry is a *vocab-row shard* ``[V/k, D]`` f32
+  per device; each block's ``dlogit`` is exchanged with one
+  ``all_to_all`` (batch-sharded → vocab-sharded — the cross-replica
+  partial-sum reduction of arXiv 2004.13336, the traffic EQuARX/2506.17615
+  compresses) and the cotangent is returned still vocab-sharded, so the
+  one gather back to the replicated parameter rides the existing GSPMD
+  gradient reduction outside the scan.  Restores the full fused-head
+  memory win on data-sharded meshes.
+- ``fused_ce_sums_tp`` (TP mode): accepts the *vocab-sharded* tied
+  embedding from parallel/tp.py (``P('model', None)``) directly inside
+  ``shard_map`` — block-local logsumexp / true-logit partials are combined
+  with ``psum``/``pmax`` over the model axis, ``dE`` accumulates as the
+  local ``[V/tp, D]`` shard (one deferred psum over data at scan end), and
+  the cotangent comes back ``P(model, None)``: neither ``e`` nor ``dE`` is
+  ever replicated.
 
 Numerics: logits accumulate in f32 (``preferred_element_type``) from
 bf16/f32 operands — at least as accurate as the unfused head (which casts
 the f32 hidden back through the embed dtype).  Equality to the unfused
-``cross_entropy(model(tokens))`` path is pinned in tests/test_fused_ce.py.
+``cross_entropy(model(tokens))`` path is pinned in tests/test_fused_ce.py
+for all three variants.
 
 Reference anchor: the loss of every reference recipe is
 ``nn.CrossEntropyLoss`` on the model head (reference distributed.py:151);
@@ -29,6 +57,7 @@ this is that capability, restructured for the TPU memory hierarchy.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -49,17 +78,11 @@ def _block_sums(h_blk, e, t_blk, w_blk):
     return loss, correct
 
 
-def fused_ce_sums(h, e, targets, weights, num_chunks: int):
-    """``h [N, D]`` hidden rows, ``e [V, D]`` tied embedding, ``targets
-    [N]`` int32, ``weights [N]`` f32 → ``(loss_sum, correct_sum)`` f32
-    scalars (weighted sums; divide by ``weights.sum()`` for means).
-
-    N is padded up to a multiple of ``num_chunks`` with weight-0 rows
-    (zero loss and zero gradient contribution — the same masking the
-    image eval path uses for partial batches).  ``correct_sum`` is
-    non-differentiable (its cotangent is ignored)."""
-    n = h.shape[0]
-    pad = (-n) % num_chunks
+def _pad_rows(h, targets, weights, multiple: int):
+    """Pad N up to a multiple with weight-0 rows (zero loss and zero
+    gradient contribution — the same masking the image eval path uses for
+    partial batches)."""
+    pad = (-h.shape[0]) % multiple
     if pad:
         h = jnp.concatenate(
             [h, jnp.zeros((pad, h.shape[1]), h.dtype)], axis=0)
@@ -67,8 +90,20 @@ def fused_ce_sums(h, e, targets, weights, num_chunks: int):
             [targets, jnp.zeros((pad,), targets.dtype)], axis=0)
         weights = jnp.concatenate(
             [weights, jnp.zeros((pad,), weights.dtype)], axis=0)
-    out = _fused_ce_sums(h, e, targets, weights, num_chunks)
-    return out
+    return h, targets, weights
+
+
+def fused_ce_sums(h, e, targets, weights, num_chunks: int):
+    """``h [N, D]`` hidden rows, ``e [V, D]`` tied embedding, ``targets
+    [N]`` int32, ``weights [N]`` f32 → ``(loss_sum, correct_sum)`` f32
+    scalars (weighted sums; divide by ``weights.sum()`` for means).
+
+    N is padded up to a multiple of ``num_chunks`` (see ``_pad_rows``).
+    ``correct_sum`` is non-differentiable (its cotangent is ignored);
+    ``weights`` carries the true loss-path cotangent
+    ``(logz − true_logit)·ḡ`` per row."""
+    h, targets, weights = _pad_rows(h, targets, weights, num_chunks)
+    return _fused_ce_sums(h, e, targets, weights, num_chunks)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -106,7 +141,9 @@ def _bwd(num_chunks: int, res, cts):
             hb, e, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        p = jax.nn.softmax(logits, axis=-1)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        true_logit = jnp.take_along_axis(logits, tb[:, None], axis=-1)[:, 0]
+        p = jnp.exp(logits - logz[:, None])
         onehot = jax.nn.one_hot(tb, e.shape[0], dtype=jnp.float32)
         dlogit = (p - onehot) * (wb * g_loss)[:, None]  # [chunk, V] f32
         dh_b = jax.lax.dot_general(
@@ -117,14 +154,315 @@ def _bwd(num_chunks: int, res, cts):
             dlogit, hb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return de_acc, dh_b
+        # d loss_sum / d w_i = (logz_i - true_logit_i): the per-row CE
+        # itself (loss path only; the correct_sum path is non-diff).
+        dw_b = (logz - true_logit) * g_loss
+        return de_acc, (dh_b, dw_b)
 
-    de, dh = jax.lax.scan(
+    de, (dh, dw) = jax.lax.scan(
         body, jnp.zeros(e.shape, jnp.float32),
         (_split(h, num_chunks), _split(targets, num_chunks),
          _split(weights, num_chunks)),
     )
-    return (dh.reshape(h.shape), de.astype(e.dtype), None, None)
+    return (dh.reshape(h.shape), de.astype(e.dtype), None,
+            dw.reshape(weights.shape).astype(weights.dtype))
 
 
 _fused_ce_sums.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# DP mode: vocab-row-sharded dE accumulator over the data axis.
+# ---------------------------------------------------------------------------
+
+
+def fused_ce_sums_dp(h, e, targets, weights, num_chunks: int, mesh,
+                     data_axis: str = "data"):
+    """Data-sharded fused CE: same contract as ``fused_ce_sums`` but the
+    backward's ``dE`` scan carry is a vocab-row shard ``[V/k, D]`` f32 per
+    device instead of the replicated ``[V, D]``.
+
+    Rows (``h``/``targets``/``weights``) enter batch-sharded over
+    ``data_axis``; ``e`` is the replicated tied embedding.  Each backward
+    block exchanges its ``[chunk/k, V]`` dlogit with one ``all_to_all``
+    (batch-sharded → vocab-sharded) so every device accumulates only its
+    vocab slice; the cotangent is returned still ``P(data, None)``-sharded
+    and the single gather back to the replicated parameter is left to the
+    existing GSPMD gradient reduction, outside the scan.
+
+    Requires ``V % k == 0`` for the vocab all_to_all split (k = data-axis
+    size).  ``train/lm.py`` ``fused_ce_mode='auto'`` falls back to the
+    replicated variant otherwise."""
+    k = dict(mesh.shape).get(data_axis, 1)
+    if k <= 1:
+        return fused_ce_sums(h, e, targets, weights, num_chunks)
+    if e.shape[0] % k:
+        raise ValueError(
+            f"fused_ce_sums_dp: vocab {e.shape[0]} not divisible by the "
+            f"'{data_axis}' axis size {k} (needed for the vocab-sharded "
+            f"dE accumulator); use the replicated variant")
+    h, targets, weights = _pad_rows(h, targets, weights, num_chunks * k)
+    fn = _make_dp_fn(num_chunks, mesh, data_axis)
+    return fn(h, e, targets, weights)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_dp_fn(num_chunks: int, mesh, data_axis: str):
+    from jax.sharding import PartitionSpec as P
+
+    row = P(data_axis)
+    rows2d = P(data_axis, None)
+    rep = P()
+
+    def fwd_local(h, e, t, w):
+        def body(carry, blk):
+            loss, correct = carry
+            hb, tb, wb = blk
+            dl, dc = _block_sums(hb, e, tb, wb)
+            return (loss + dl, correct + dc), None
+
+        sums, _ = jax.lax.scan(
+            body, (jnp.float32(0.0), jnp.float32(0.0)),
+            (_split(h, num_chunks), _split(t, num_chunks),
+             _split(w, num_chunks)),
+        )
+        return jax.lax.psum(sums[0], data_axis), jax.lax.psum(
+            sums[1], data_axis)
+
+    k_dp = dict(mesh.shape)[data_axis]
+
+    def bwd_local(h, e, t, w, g_loss):
+        vshard = e.shape[0] // k_dp
+
+        def body(de_acc, blk):
+            hb, tb, wb = blk  # this shard's rows of the block
+            logits = jax.lax.dot_general(
+                hb, e, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [chunk/k, V] f32
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            true_logit = jnp.take_along_axis(
+                logits, tb[:, None], axis=-1)[:, 0]
+            p = jnp.exp(logits - logz[:, None])
+            onehot = jax.nn.one_hot(tb, e.shape[0], dtype=jnp.float32)
+            dlogit = (p - onehot) * (wb * g_loss)[:, None]
+            dh_b = jax.lax.dot_general(
+                dlogit, e, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(h.dtype)
+            dw_b = (logz - true_logit) * g_loss
+            # Batch-sharded → vocab-sharded: this device receives ALL the
+            # block's rows restricted to its vocab slice — the per-block
+            # cross-replica partial-sum exchange (arXiv 2004.13336).
+            dl_v = jax.lax.all_to_all(
+                dlogit, data_axis, split_axis=1, concat_axis=0, tiled=True
+            )  # [chunk, V/k]
+            h_full = jax.lax.all_gather(
+                hb, data_axis, axis=0, tiled=True)  # [chunk, D]
+            de_acc = de_acc + jax.lax.dot_general(
+                dl_v, h_full, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [V/k, D] — complete sum for this vocab slice
+            return de_acc, (dh_b, dw_b)
+
+        de, (dh, dw) = jax.lax.scan(
+            body, jnp.zeros((vshard, e.shape[1]), jnp.float32),
+            (_split(h, num_chunks), _split(t, num_chunks),
+             _split(w, num_chunks)),
+        )
+        return (dh.reshape((-1,) + h.shape[1:]), de.astype(e.dtype),
+                dw.reshape(-1).astype(w.dtype))
+
+    fwd_sm = jax.shard_map(
+        fwd_local, mesh=mesh, in_specs=(rows2d, rep, row, row),
+        out_specs=(rep, rep), check_vma=False,
+    )
+    bwd_sm = jax.shard_map(
+        bwd_local, mesh=mesh, in_specs=(rows2d, rep, row, row, rep),
+        out_specs=(rows2d, rows2d, row), check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def f(h, e, t, w):
+        return fwd_sm(h, e, t, w)
+
+    def f_fwd(h, e, t, w):
+        return fwd_sm(h, e, t, w), (h, e, t, w)
+
+    def f_bwd(res, cts):
+        h, e, t, w = res
+        dh, de, dw = bwd_sm(h, e, t, w, cts[0])  # correct_sum ct ignored
+        return dh, de, None, dw
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# TP mode: vocab-sharded tied embedding (parallel/tp.py P('model', None)).
+# ---------------------------------------------------------------------------
+
+
+def fused_ce_sums_tp(h, e, targets, weights, num_chunks: int, mesh,
+                     data_axis: str = "data", model_axis: str = "model"):
+    """Tensor-parallel fused CE: ``e`` enters *vocab-sharded* over
+    ``model_axis`` (the parallel/tp.py ``P('model', None)`` layout) and is
+    never replicated — each device's scan sees only its ``[V/tp, D]``
+    shard.
+
+    Per block, each model shard computes its local ``[chunk, V/tp]``
+    logits and the global softmax statistics are combined with one
+    ``pmax`` + two ``psum`` over the model axis (logsumexp / true logit;
+    argmax for ``correct_sum`` keeps jnp.argmax's first-occurrence
+    tie-break via a pmin over candidate indices).  The backward ``dE``
+    accumulates as the local ``[V/tp, D]`` shard with the cross-replica
+    (data-axis) sum deferred to one psum at scan end, and the cotangent
+    returns ``P(model, None)``-sharded.  Per-row ``logz``/``true_logit``
+    are saved as O(N) residuals so the backward re-runs no model-axis
+    collectives for the softmax.
+
+    Requires ``V % tp == 0`` (the tp.py layout already does) and
+    ``model_axis != data_axis``."""
+    tp = dict(mesh.shape).get(model_axis, 1)
+    if tp <= 1:
+        return fused_ce_sums(h, e, targets, weights, num_chunks)
+    if model_axis == data_axis:
+        raise ValueError(
+            "fused_ce_sums_tp: model_axis must differ from data_axis "
+            f"(both {model_axis!r}); a same-axis vocab shard would mix "
+            "row shards into the softmax reductions")
+    if e.shape[0] % tp:
+        raise ValueError(
+            f"fused_ce_sums_tp: vocab {e.shape[0]} not divisible by the "
+            f"'{model_axis}' axis size {tp}")
+    dp = dict(mesh.shape).get(data_axis, 1)
+    h, targets, weights = _pad_rows(h, targets, weights, num_chunks * dp)
+    fn = _make_tp_fn(num_chunks, mesh, data_axis, model_axis)
+    return fn(h, e, targets, weights)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_tp_fn(num_chunks: int, mesh, data_axis: str, model_axis: str):
+    from jax.sharding import PartitionSpec as P
+
+    has_dp = dict(mesh.shape).get(data_axis, 1) > 1
+    row_axis = data_axis if has_dp else None
+    row = P(row_axis)
+    rows2d = P(row_axis, None)
+    vocab2d = P(model_axis, None)
+    rep = P()
+
+    def _psum_dp(x):
+        return jax.lax.psum(x, data_axis) if has_dp else x
+
+    tp_size = dict(mesh.shape)[model_axis]
+
+    def fwd_local(h, e, t, w):
+        vloc = e.shape[0]
+        lo = jax.lax.axis_index(model_axis) * vloc
+        v_total = vloc * tp_size
+
+        def body(carry, blk):
+            loss, correct = carry
+            hb, tb, wb = blk
+            logits = jax.lax.dot_general(
+                hb, e, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [chunk, V/tp] f32 — this shard's vocab columns only
+            lmax_loc = jnp.max(logits, axis=-1)
+            lmax = jax.lax.pmax(lmax_loc, model_axis)
+            ssum = jax.lax.psum(
+                jnp.sum(jnp.exp(logits - lmax[:, None]), axis=-1),
+                model_axis)
+            logz = lmax + jnp.log(ssum)
+            tloc = tb - lo
+            in_shard = (tloc >= 0) & (tloc < vloc)
+            tl_part = jnp.where(
+                in_shard,
+                jnp.take_along_axis(
+                    logits, jnp.clip(tloc, 0, vloc - 1)[:, None],
+                    axis=-1)[:, 0],
+                0.0)
+            true_logit = jax.lax.psum(tl_part, model_axis)
+            # global argmax with jnp.argmax's first-occurrence tie-break:
+            # among shards achieving the global max, take the lowest
+            # global index.
+            amax_loc = lo + jnp.argmax(logits, axis=-1)
+            cand = jnp.where(lmax_loc >= lmax, amax_loc, v_total)
+            gidx = jax.lax.pmin(cand, model_axis)
+            loss = loss + jnp.sum((logz - true_logit) * wb)
+            correct = correct + jnp.sum(
+                (gidx == tb).astype(jnp.float32) * wb)
+            return (loss, correct), (logz, true_logit)
+
+        (loss, correct), (logz, tl) = jax.lax.scan(
+            body, (jnp.float32(0.0), jnp.float32(0.0)),
+            (_split(h, num_chunks), _split(t, num_chunks),
+             _split(w, num_chunks)),
+        )
+        return (_psum_dp(loss), _psum_dp(correct),
+                logz.reshape(-1), tl.reshape(-1))
+
+    def bwd_local(h, e, t, w, logz, tl, g_loss):
+        vloc = e.shape[0]
+        lo = jax.lax.axis_index(model_axis) * vloc
+
+        def body(de_acc, blk):
+            hb, tb, wb, lzb, tlb = blk
+            logits = jax.lax.dot_general(
+                hb, e, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [chunk, V/tp]
+            p = jnp.exp(logits - lzb[:, None])
+            # one_hot of an out-of-shard (negative / >= vloc) index is the
+            # zero row — exactly the wanted restriction to local columns.
+            onehot = jax.nn.one_hot(tb - lo, vloc, dtype=jnp.float32)
+            dlogit = (p - onehot) * (wb * g_loss)[:, None]
+            dh_b = jax.lax.psum(
+                jax.lax.dot_general(
+                    dlogit, e, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ), model_axis).astype(h.dtype)
+            de_acc = de_acc + jax.lax.dot_general(
+                dlogit, hb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [V/tp, D] — this data shard's rows only
+            dw_b = (lzb - tlb) * g_loss
+            return de_acc, (dh_b, dw_b)
+
+        de, (dh, dw) = jax.lax.scan(
+            body, jnp.zeros((vloc, e.shape[1]), jnp.float32),
+            (_split(h, num_chunks), _split(t, num_chunks),
+             _split(w, num_chunks), _split(logz, num_chunks),
+             _split(tl, num_chunks)),
+        )
+        de = _psum_dp(de)  # deferred cross-replica sum: ONE collective
+        return (dh.reshape((-1,) + h.shape[1:]), de.astype(e.dtype),
+                dw.reshape(-1).astype(w.dtype))
+
+    fwd_sm = jax.shard_map(
+        fwd_local, mesh=mesh, in_specs=(rows2d, vocab2d, row, row),
+        out_specs=(rep, rep, row, row), check_vma=False,
+    )
+    bwd_sm = jax.shard_map(
+        bwd_local, mesh=mesh,
+        in_specs=(rows2d, vocab2d, row, row, row, row, rep),
+        out_specs=(rows2d, vocab2d, row), check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def f(h, e, t, w):
+        loss, correct, _, _ = fwd_sm(h, e, t, w)
+        return loss, correct
+
+    def f_fwd(h, e, t, w):
+        loss, correct, logz, tl = fwd_sm(h, e, t, w)
+        return (loss, correct), (h, e, t, w, logz, tl)
+
+    def f_bwd(res, cts):
+        h, e, t, w, logz, tl = res
+        dh, de, dw = bwd_sm(h, e, t, w, logz, tl, cts[0])
+        return dh, de, None, dw
+
+    f.defvjp(f_fwd, f_bwd)
+    return f

@@ -108,6 +108,18 @@ def initialize(
             process_id = slurm["process_id"]
 
     if coordinator_address is not None and (num_processes or 1) > 1:
+        platforms = jax.config.jax_platforms or ""
+        if "cpu" in platforms.split(","):
+            # Multi-process CPU meshes (the test/e2e simulation path) need
+            # the gloo collectives implementation — the default XLA CPU
+            # client refuses cross-process computations outright.  No-op
+            # on TPU pods, and tolerated where the option is gone (newer
+            # jax enables CPU collectives by default).
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # noqa: BLE001
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -68,6 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(ops/fused_ce.py): the [B,L,vocab] logits tensor "
                         "never materializes — big-vocab HBM/memory lever; "
                         "0 = unfused (exact parity tested either way)")
+    p.add_argument("--fused-ce-mode", default="auto",
+                   choices=("auto", "replicated", "dp", "tp"),
+                   dest="fused_ce_mode",
+                   help="fused-CE sharding variant: dp keeps the backward's "
+                        "dE accumulator as a [V/k, D] vocab-row shard per "
+                        "device (data-sharded meshes); tp consumes the "
+                        "--tp vocab-sharded embedding directly inside "
+                        "shard_map (no replication of e or dE); auto picks "
+                        "from the mesh + param specs; replicated = the "
+                        "original GSPMD path")
     p.add_argument("--accum-steps", type=int, default=1,
                    help="gradient accumulation microbatches inside the "
                         "compiled step (long-context memory relief; "
@@ -339,6 +349,7 @@ def main(argv=None) -> float:
             eval_batches=args.eval_batches,
             lr_schedule=schedule, clip_grad_norm=args.clip_grad_norm,
             accum_steps=args.accum_steps, fused_ce_chunks=args.fused_ce,
+            fused_ce_mode=args.fused_ce_mode,
         )
         final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
         if args.generate > 0:  # plain-dp only, validated with the args above

@@ -69,6 +69,11 @@ class Config:
     # nn.SyncBatchNorm — the capability torch users reach for at small
     # per-device batch.  No effect under GSPMD (already synced).
     sync_bn: bool = False
+    # LM-family loss head (recipes/lm_pretrain.py forwards these): chunked
+    # fused tied-head+CE (ops/fused_ce.py) and its sharding variant —
+    # auto picks dp/tp from the mesh + param specs (resolve_fused_ce_mode).
+    fused_ce_chunks: int = 0
+    fused_ce_mode: str = "auto"
     resume: Optional[str] = None
     # Default under runs/ so checkpoints never land in the repo root
     # (workspace-hygiene; save_checkpoint creates the directory).
@@ -175,6 +180,17 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "dgrad/wgrad (Pallas, 1x1 + stride-1 3x3; dy never hits "
                    "HBM); checkpoints stay interchangeable with the "
                    "unfused model")
+    p.add_argument("--fused-ce", default=d.fused_ce_chunks, type=int,
+                   metavar="CHUNKS", dest="fused_ce_chunks",
+                   help="LM family: fused tied-head+CE loss in CHUNKS row "
+                   "blocks (ops/fused_ce.py); 0 = unfused logits head")
+    p.add_argument("--fused-ce-mode", default=d.fused_ce_mode,
+                   choices=("auto", "replicated", "dp", "tp"),
+                   dest="fused_ce_mode",
+                   help="fused-CE sharding variant: dp keeps the backward's "
+                   "dE accumulator vocab-row-sharded over the data axis; tp "
+                   "consumes the Megatron vocab-sharded embedding directly; "
+                   "auto picks from the mesh + param specs")
     p.add_argument("--sync-bn", action="store_true", dest="sync_bn",
                    help="cross-replica BatchNorm for the explicit-"
                    "collectives step: psum the batch moments over the data "

@@ -138,6 +138,60 @@ def warmup_cosine_lr(base_lr: float, warmup_steps: int, total_steps: int,
     return schedule
 
 
+def resolve_fused_ce_mode(
+    mode: str,
+    param_specs,
+    mesh: Mesh,
+    vocab_size: Optional[int],
+    data_axis: str = "data",
+) -> Tuple[str, Optional[str]]:
+    """Pick the fused-CE sharding variant (ops/fused_ce.py) for this
+    mesh/spec combination → ``(mode, model_axis)``.
+
+    - ``'tp'`` when the tied embedding's PartitionSpec shards the vocab dim
+      over a live mesh axis other than ``data_axis`` (the parallel/tp.py
+      ``P('model', None)`` layout): the shard_map variant consumes the
+      shard directly — no replication of ``e`` or ``dE``.
+    - ``'dp'`` when the embedding is effectively replicated but the mesh
+      data axis is >1 and divides the vocab: the dE accumulator is kept as
+      a vocab-row shard per device.
+    - ``'replicated'`` otherwise (single device, or an indivisible vocab) —
+      the original GSPMD path.
+
+    Explicit ``mode`` values are validated against the same constraints so
+    a mis-paired flag fails loudly at step-build time, not at trace time.
+    """
+    if mode not in ("auto", "replicated", "dp", "tp"):
+        raise ValueError(
+            f"fused_ce_mode must be auto|replicated|dp|tp, got {mode!r}")
+    try:
+        embed_spec = param_specs["embed"]["embedding"]
+    except (KeyError, TypeError):
+        embed_spec = P()
+    mesh_shape = dict(mesh.shape)
+    vocab_axis = embed_spec[0] if len(embed_spec) >= 1 else None
+    tp_ok = (vocab_axis is not None and vocab_axis != data_axis
+             and mesh_shape.get(vocab_axis, 1) > 1
+             and vocab_size is not None
+             and vocab_size % mesh_shape[vocab_axis] == 0)
+    dp = mesh_shape.get(data_axis, 1)
+    dp_ok = (dp > 1 and vocab_size is not None and vocab_size % dp == 0
+             and (vocab_axis is None or mesh_shape.get(vocab_axis, 1) == 1))
+    if mode == "auto":
+        mode = "tp" if tp_ok else ("dp" if dp_ok else "replicated")
+    elif mode == "tp" and not tp_ok:
+        raise ValueError(
+            "fused_ce_mode='tp' needs the tied embedding vocab-sharded "
+            f"over a non-data mesh axis dividing the vocab; got spec "
+            f"{embed_spec} on mesh {mesh_shape} (vocab {vocab_size})")
+    elif mode == "dp" and not dp_ok:
+        raise ValueError(
+            "fused_ce_mode='dp' needs a replicated embedding, a data axis "
+            f"> 1, and vocab divisible by it; got spec {embed_spec} on "
+            f"mesh {mesh_shape} (vocab {vocab_size})")
+    return mode, (vocab_axis if mode == "tp" else None)
+
+
 def make_lm_train_step(
     model,
     mesh: Mesh,
@@ -148,6 +202,7 @@ def make_lm_train_step(
     clip_grad_norm: float = 0.0,
     accum_steps: int = 1,
     fused_ce_chunks: int = 0,
+    fused_ce_mode: str = "auto",
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
@@ -159,7 +214,12 @@ def make_lm_train_step(
     unaccumulated step up to fp reassociation (tested); for MoE models the
     router's load-balancing aux loss is computed from *microbatch-local*
     routing fractions, so accumulated and unaccumulated runs differ
-    slightly — the standard per-microbatch aux-loss semantics, not a bug."""
+    slightly — the standard per-microbatch aux-loss semantics, not a bug.
+
+    ``fused_ce_mode`` selects the sharded fused-CE variant (see
+    ``resolve_fused_ce_mode``); the default ``'auto'`` picks from the
+    mesh + param specs, so ``fused_ce_chunks=N`` alone does the right
+    thing on DP, TP, and single-device meshes alike."""
     manual = getattr(model, "has_manual_grads", lambda: False)()
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -173,6 +233,11 @@ def make_lm_train_step(
         raise ValueError(
             "fused_ce_chunks composes with autodiff loss_fn models only, "
             "not the 1F1B pipeline's manual-gradient schedule")
+    ce_mode, ce_model_axis = ("replicated", None)
+    if fused_ce_chunks:
+        ce_mode, ce_model_axis = resolve_fused_ce_mode(
+            fused_ce_mode, param_specs, mesh,
+            getattr(model, "vocab_size", None), data_axis)
 
     def step(state: TrainState, tokens: jnp.ndarray, lr: jnp.ndarray):
         def loss_fn(params, toks):
@@ -180,8 +245,14 @@ def make_lm_train_step(
                 # Fused tied-head + CE (ops/fused_ce.py): the [B, L, V]
                 # logits tensor never materializes — hidden rows project
                 # against the tied embedding per chunk inside a custom VJP.
+                # The sharded variants keep the backward's dE accumulator
+                # sharded too (vocab rows over data, or the tp.py vocab
+                # shard), instead of the replicated [V, D] f32 carry that
+                # erased the memory win on data-sharded meshes.
                 from pytorch_distributed_tpu.ops.fused_ce import (
                     fused_ce_sums,
+                    fused_ce_sums_dp,
+                    fused_ce_sums_tp,
                 )
 
                 hidden, sown = model.apply(
@@ -194,8 +265,17 @@ def make_lm_train_step(
                 t = toks[:, 1:].reshape(-1)
                 w = jnp.ones(t.shape, jnp.float32)
                 e = params["embed"]["embedding"].astype(cdt)
-                loss_sum, correct = fused_ce_sums(
-                    h, e, t, w, fused_ce_chunks)
+                if ce_mode == "tp":
+                    loss_sum, correct = fused_ce_sums_tp(
+                        h, e, t, w, fused_ce_chunks, mesh,
+                        data_axis=data_axis, model_axis=ce_model_axis)
+                elif ce_mode == "dp":
+                    loss_sum, correct = fused_ce_sums_dp(
+                        h, e, t, w, fused_ce_chunks, mesh,
+                        data_axis=data_axis)
+                else:
+                    loss_sum, correct = fused_ce_sums(
+                        h, e, t, w, fused_ce_chunks)
                 ntok = h.shape[0]
                 loss = loss_sum / ntok
                 for leaf in jax.tree_util.tree_leaves(
@@ -355,6 +435,7 @@ class LMTrainer:
         prefetch: int = 2,
         accum_steps: int = 1,
         fused_ce_chunks: int = 0,
+        fused_ce_mode: str = "auto",
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -365,7 +446,9 @@ class LMTrainer:
         end-of-fit checkpoint captures the state.
         ``prefetch``: token batches kept in flight by the background feeder
         (0 = synchronous host assembly + transfer in the step loop — the
-        before/after axis measured in experiments/lm_feeder_bench.py)."""
+        before/after axis measured in experiments/lm_feeder_bench.py);
+        ``fused_ce_mode``: sharding variant of the fused loss head
+        (auto | replicated | dp | tp — see ``resolve_fused_ce_mode``)."""
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -395,7 +478,8 @@ class LMTrainer:
         self.step_fn = make_lm_train_step(model, mesh, self.param_specs,
                                           clip_grad_norm=clip_grad_norm,
                                           accum_steps=accum_steps,
-                                          fused_ce_chunks=fused_ce_chunks)
+                                          fused_ce_chunks=fused_ce_chunks,
+                                          fused_ce_mode=fused_ce_mode)
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every

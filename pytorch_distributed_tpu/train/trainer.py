@@ -106,19 +106,28 @@ class Trainer:
             # GSPMD already has global-batch semantics, so the flag is a
             # documented no-op there.
             extra["bn_axis_name"] = data_axis
-        try:
-            self.model = models.create_model(
-                cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra
-            )
-        except TypeError as e:
-            # The canonical CPython rejected-kwarg message, not a loose
-            # substring: only a constructor that genuinely lacks the
-            # bn_axis_name knob (BN-free arch) lands here.
-            if "unexpected keyword argument 'bn_axis_name'" in str(e):
+            # Explicit capability check instead of catching the
+            # CPython-wording-dependent rejected-kwarg TypeError: a
+            # BN-carrying model class declares bn_axis_name as a dataclass
+            # field (flax modules are dataclasses), so its absence IS the
+            # "no BatchNorm" signal — robust to constructor wrappers and
+            # message-wording changes.  (Plain VGG keeps its own in-class
+            # check: the class carries the field for the *_bn variants but
+            # a BN-free cfg must still refuse at init.)
+            import dataclasses as _dc
+
+            ctor = (models._REGISTRY.get(cfg.arch)
+                    or models._LM_REGISTRY.get(cfg.arch))
+            cls = getattr(ctor, "func", ctor)
+            fields = ({f.name for f in _dc.fields(cls)}
+                      if _dc.is_dataclass(cls) else set())
+            if "bn_axis_name" not in fields:
                 raise ValueError(
                     f"--sync-bn: arch {cfg.arch!r} has no BatchNorm layers "
-                    f"to synchronize (no bn_axis_name knob)") from e
-            raise
+                    f"to synchronize (no bn_axis_name knob)")
+        self.model = models.create_model(
+            cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra
+        )
 
         seed = cfg.seed if cfg.seed is not None else 0
         rng = jax.random.PRNGKey(seed)

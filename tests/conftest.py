@@ -24,3 +24,7 @@ import jax  # noqa: E402  (may already be imported by sitecustomize)
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# Installs the jax API compat shims (jax.shard_map / lax.axis_size on
+# 0.4.x) before any test module does ``from jax import shard_map``.
+import pytorch_distributed_tpu  # noqa: E402,F401

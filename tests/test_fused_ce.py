@@ -28,6 +28,20 @@ def _naive_sums(h, e, t, w):
     return loss, correct
 
 
+def _op_inputs(seed=0, n=N, d=D, v=V, hit_frac=0.25):
+    """Random op-level inputs; a fraction of targets is set to the argmax
+    row so correct_sum is exercised nonzero."""
+    rng = np.random.default_rng(seed)
+    h = jnp.asarray(rng.normal(0, 1, size=(n, d)), jnp.float32)
+    e = jnp.asarray(rng.normal(0, 1, size=(v, d)), jnp.float32)
+    t = np.asarray(rng.integers(0, v, size=(n,)), np.int32)
+    am = np.asarray(jnp.argmax(h @ e.T, axis=-1))
+    hits = rng.random(n) < hit_frac
+    t[hits] = am[hits]
+    w = jnp.asarray(rng.uniform(0.5, 1.5, size=(n,)), jnp.float32)
+    return h, e, jnp.asarray(t), w
+
+
 @pytest.mark.parametrize("chunks", [1, 2, 4, 8])
 def test_fused_ce_matches_naive(chunks):
     rng = np.random.default_rng(0)
@@ -107,6 +121,200 @@ def test_tp_step_with_fused_ce_matches_replicated():
                     jax.tree_util.tree_leaves(s_dp.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_fused_ce_weights_grad_matches_naive():
+    """The loss-path ``weights`` cotangent (ADVICE r5: _bwd used to return
+    None): grad w.r.t. the per-row weights must match the naive
+    logits-materializing autodiff — (logz − true_logit) per row."""
+    h, e, t, w = _op_inputs(seed=7)
+    gw_f = jax.grad(lambda w: fused_ce_sums(h, e, t, w, 4)[0])(w)
+    gw_n = jax.grad(lambda w: _naive_sums(h, e, t, w)[0])(w)
+    assert float(jnp.max(jnp.abs(gw_f))) > 0.0
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_n),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dp_mode_matches_naive_op_level():
+    """fused_ce_sums_dp on an 8-way data mesh: values, correct_sum and all
+    three grads (h, e, w) ≡ the naive path; the backward's dE accumulator
+    is a [V/8, D] vocab-row shard per device (the replicated-[V,D] fix)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.ops.fused_ce import fused_ce_sums_dp
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(("data",), (8,)), jax.devices()[:8])
+    h, e, t, w = _op_inputs(seed=11, v=64)
+    hs = jax.device_put(h, NamedSharding(mesh, P("data", None)))
+    ts = jax.device_put(t, NamedSharding(mesh, P("data")))
+    ws = jax.device_put(w, NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def vals_and_grads(h, e, w):
+        def f(h, e, w):
+            return fused_ce_sums_dp(h, e, ts, w, 3, mesh)[0]
+
+        return jax.value_and_grad(f, argnums=(0, 1, 2))(h, e, w)
+
+    lv, grads = vals_and_grads(hs, e, ws)
+    ln, cn = _naive_sums(h, e, t, w)
+    gn = jax.grad(lambda h, e, w: _naive_sums(h, e, t, w)[0],
+                  argnums=(0, 1, 2))(h, e, w)
+    np.testing.assert_allclose(float(lv), float(ln), rtol=1e-6)
+    for got, want, name in zip(grads, gn, ("h", "e", "w")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+    cd = fused_ce_sums_dp(hs, e, ts, ws, 3, mesh)[1]
+    assert float(cn) > 0.0  # the hit fraction keeps this exercised
+    np.testing.assert_allclose(float(cd), float(cn), rtol=1e-6)
+
+
+def test_tp_mode_matches_replicated_with_vocab_sharded_embedding():
+    """fused_ce_sums_tp under shard_map with the parallel/tp.py
+    vocab-sharded embedding (P('model', None)) ≡ the replicated
+    fused_ce_sums: values, correct_sum, and all grads — with e entering
+    (and its cotangent leaving) vocab-sharded, never replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.ops.fused_ce import fused_ce_sums_tp
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec(("data", "model"), (2, 4)),
+                      jax.devices()[:8])
+    h, e, t, w = _op_inputs(seed=13, v=64)
+    es = jax.device_put(e, NamedSharding(mesh, P("model", None)))
+    hs = jax.device_put(h, NamedSharding(mesh, P("data", None)))
+
+    @jax.jit
+    def vals_and_grads(h, e, w):
+        def f(h, e, w):
+            return fused_ce_sums_tp(h, e, t, w, 3, mesh)[0]
+
+        return jax.value_and_grad(f, argnums=(0, 1, 2))(h, e, w)
+
+    lv, grads = vals_and_grads(hs, es, w)
+    lr_, cr = fused_ce_sums(h, e, t, w, 3)
+    gr = jax.grad(lambda h, e, w: fused_ce_sums(h, e, t, w, 3)[0],
+                  argnums=(0, 1, 2))(h, e, w)
+    np.testing.assert_allclose(float(lv), float(lr_), rtol=1e-6)
+    for got, want, name in zip(grads, gr, ("h", "e", "w")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+    # e's cotangent must come back vocab-sharded (no dE replication)
+    ge_spec = grads[1].sharding.spec
+    assert ge_spec[0] == "model", ge_spec
+    ct = fused_ce_sums_tp(hs, es, t, w, 3, mesh)[1]
+    assert float(cr) > 0.0
+    np.testing.assert_allclose(float(ct), float(cr), rtol=1e-6)
+
+
+def test_dp_mode_step_matches_replicated_and_unfused():
+    """Step-level DP parity on the 8-way data mesh: fused_ce_mode='dp' ≡
+    'replicated' ≡ unfused — loss/acc and the updated params (i.e. the
+    gradients) agree to fp-reassociation tolerance."""
+    cfg = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2)
+    model = TransformerLM(**cfg)
+    mesh = data_parallel_mesh()
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, size=(8, 17)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1, :8])["params"]
+
+    def one_step(chunks, mode):
+        state = TrainState.create(
+            {"params": jax.tree_util.tree_map(jnp.copy, params)},
+            sgd_init(params))
+        step = make_lm_train_step(
+            model, mesh, replicated_like(params), fused_ce_chunks=chunks,
+            fused_ce_mode=mode)
+        return step(state, tokens, jnp.float32(0.1))
+
+    s_dp, m_dp = one_step(4, "dp")
+    s_rep, m_rep = one_step(4, "replicated")
+    s_un, m_un = one_step(0, "auto")
+    for (s, m), tag in (((s_rep, m_rep), "dp-vs-replicated"),
+                        ((s_un, m_un), "dp-vs-unfused")):
+        np.testing.assert_allclose(float(m_dp["loss"]), float(m["loss"]),
+                                   rtol=1e-5, err_msg=tag)
+        np.testing.assert_allclose(float(m_dp["acc"]), float(m["acc"]),
+                                   rtol=1e-5, atol=1e-5, err_msg=tag)
+        want = dict(jax.tree_util.tree_leaves_with_path(s.params))
+        for path, v in jax.tree_util.tree_leaves_with_path(s_dp.params):
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(want[path]), rtol=1e-4,
+                atol=1e-5, err_msg=f"{tag}:{jax.tree_util.keystr(path)}")
+
+
+def test_fused_ce_mode_validation():
+    """Explicit mis-paired modes fail loudly at step-build time."""
+    from pytorch_distributed_tpu.train.lm import resolve_fused_ce_mode
+
+    from jax.sharding import PartitionSpec as P
+
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.parallel.tp import tp_specs
+
+    cfg = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2)
+    model = TransformerLM(**cfg)
+    tokens0 = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
+    mesh_dp = build_mesh(MeshSpec(("data",), (8,)), jax.devices()[:8])
+    mesh_tp = build_mesh(MeshSpec(("data", "model"), (2, 4)),
+                         jax.devices()[:8])
+    rep = replicated_like(params)
+    # tp on a replicated spec → loud error
+    with pytest.raises(ValueError, match="fused_ce_mode='tp'"):
+        resolve_fused_ce_mode("tp", rep, mesh_dp, 64)
+    # dp with a vocab the data axis doesn't divide → loud error
+    with pytest.raises(ValueError, match="fused_ce_mode='dp'"):
+        resolve_fused_ce_mode("dp", rep, mesh_dp, 65)
+    # auto: indivisible vocab falls back to replicated, never crashes
+    assert resolve_fused_ce_mode("auto", rep, mesh_dp, 65)[0] == "replicated"
+    assert resolve_fused_ce_mode("auto", rep, mesh_dp, 64)[0] == "dp"
+    mode, axis = resolve_fused_ce_mode(
+        "auto", tp_specs(params), mesh_tp, 64)
+    assert (mode, axis) == ("tp", "model")
+    with pytest.raises(ValueError, match="auto|replicated|dp|tp"):
+        resolve_fused_ce_mode("bogus", rep, mesh_dp, 64)
+
+
+@pytest.mark.parametrize("mode", ["replicated", "dp"])
+def test_lm_step_fused_equals_unfused_bf16(mode):
+    """bf16 variant of the fused-vs-unfused step parity (ADVICE r5): the
+    fused path casts ln_f hidden + embedding to bf16 before the chunked
+    matmul, exactly like the unfused head's embed-dtype cast — pinned here
+    at loose bf16 tolerance rather than asserted by docstring alone."""
+    cfg = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+               dtype=jnp.bfloat16)
+    model = TransformerLM(**cfg)
+    mesh = data_parallel_mesh()
+    tokens = jnp.asarray(
+        np.random.default_rng(9).integers(0, 64, size=(8, 17)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1, :8])["params"]
+
+    def one_step(chunks, mode):
+        state = TrainState.create(
+            {"params": jax.tree_util.tree_map(jnp.copy, params)},
+            sgd_init(params))
+        step = make_lm_train_step(
+            model, mesh, replicated_like(params), fused_ce_chunks=chunks,
+            fused_ce_mode=mode)
+        return step(state, tokens, jnp.float32(0.1))
+
+    s_f, m_f = one_step(4, mode)
+    s_n, m_n = one_step(0, "auto")
+    # bf16 has ~3 decimal digits: fused and unfused heads round the same
+    # operands through different summation orders.
+    np.testing.assert_allclose(float(m_f["loss"]), float(m_n["loss"]),
+                               rtol=2e-2)
+    # acc is percent over 128 tokens: allow a single bf16 argmax tie-flip
+    np.testing.assert_allclose(float(m_f["acc"]), float(m_n["acc"]),
+                               rtol=2e-2, atol=1.0)
+    want = dict(jax.tree_util.tree_leaves_with_path(s_n.params))
+    for path, v in jax.tree_util.tree_leaves_with_path(s_f.params):
+        np.testing.assert_allclose(
+            np.asarray(v, jnp.float32), np.asarray(want[path], jnp.float32),
+            rtol=2e-2, atol=2e-3, err_msg=jax.tree_util.keystr(path))
 
 
 def test_lm_step_fused_equals_unfused():

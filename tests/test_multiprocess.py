@@ -277,6 +277,9 @@ _DCN_WORKER = textwrap.dedent(
     sys.path.insert(0, %(repo)r)
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # match conftest: the in-process reference below runs with the
+    # partitionable threefry (dropout/init key semantics follow it)
+    jax.config.update("jax_threefry_partitionable", True)
     from pytorch_distributed_tpu.parallel import initialize
     from pytorch_distributed_tpu.parallel.mesh import (
         MeshSpec, build_hybrid_mesh,
