@@ -1,0 +1,45 @@
+"""Unified observability layer (SURVEY.md §0: the reference's entire story
+is three ``.item()`` calls per batch plus a 500 ms nvidia-smi CSV).
+
+- ``metrics``   — ``MetricsLogger``: one structured JSONL record per step
+  (step-time EMA/percentiles, throughput, loss/lr, in-graph grad/param
+  norms), with lazy device-scalar conversion and sink registration so the
+  epoch CSV and telemetry sampler hang off one entry point.
+- ``trace``     — ``scope()``/``ProfileWindow``: TraceAnnotation +
+  named_scope under one idiom, and epoch/step-windowed profiler capture.
+- ``heartbeat`` — per-process ``{pid, step, t}`` beats to a shared run
+  directory + cross-process straggler detection (stdlib-only monitor).
+
+``scripts/obs_report.py`` folds a run's JSONL + heartbeats + telemetry CSV
+into one human-readable summary.
+"""
+
+from pytorch_distributed_tpu.obs.heartbeat import (
+    HeartbeatWriter,
+    find_stragglers,
+    read_heartbeats,
+)
+from pytorch_distributed_tpu.obs.metrics import (
+    REQUIRED_FIELDS,
+    MetricsLogger,
+    read_metrics,
+)
+from pytorch_distributed_tpu.obs.trace import (
+    ProfileWindow,
+    annotate,
+    parse_span,
+    scope,
+)
+
+__all__ = [
+    "REQUIRED_FIELDS",
+    "MetricsLogger",
+    "read_metrics",
+    "HeartbeatWriter",
+    "read_heartbeats",
+    "find_stragglers",
+    "scope",
+    "annotate",
+    "parse_span",
+    "ProfileWindow",
+]

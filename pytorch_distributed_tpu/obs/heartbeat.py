@@ -1,0 +1,121 @@
+"""Cross-process heartbeats + straggler detection.
+
+The reference's multi-node observability is one nvidia-smi CSV per node
+(statistics.sh), eyeballed after the fact.  Here every mesh process appends
+periodic ``{pid, step, t}`` beats to a shared run directory, and a monitor
+(``find_stragglers`` / ``scripts/obs_report.py``) flags processes whose
+latest step lags the front-runner or whose newest beat has gone stale —
+the signals that distinguish "one slow host" from "everyone is slow"
+before a hung collective turns into a silent pod-wide stall.
+
+Deliberately stdlib-only (no jax import): the monitor side runs anywhere —
+a login node, a cron job, a test harness — without touching the TPU
+runtime, and the writer adds no device work to the hot loop (one small
+append per ``interval_s``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+_PREFIX = "heartbeat-"
+
+
+class HeartbeatWriter:
+    """Appends ``{pid, step, t}`` beats for one process to
+    ``<hb_dir>/heartbeat-<pid>.jsonl``.
+
+    ``beat(step)`` is safe to call every step: writes are rate-limited to
+    one per ``interval_s`` (0 = every call, for tests).  ``close(step)``
+    forces a final beat so the monitor sees the true last step even when
+    the run ends mid-interval.
+    """
+
+    def __init__(self, hb_dir: str, process_index: int = 0,
+                 interval_s: float = 5.0):
+        self.dir = hb_dir
+        self.process_index = int(process_index)
+        self.interval_s = float(interval_s)
+        self.path = os.path.join(hb_dir, f"{_PREFIX}{self.process_index:05d}.jsonl")
+        os.makedirs(hb_dir, exist_ok=True)
+        self._last = float("-inf")
+
+    def beat(self, step: int, force: bool = False) -> bool:
+        """Record a beat at ``step``; returns True when a line was written."""
+        now = time.time()
+        if not force and now - self._last < self.interval_s:
+            return False
+        self._last = now
+        rec = {"pid": self.process_index, "step": int(step), "t": now}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return True
+
+    def close(self, step: Optional[int] = None) -> None:
+        if step is not None:
+            self.beat(step, force=True)
+
+
+def read_heartbeats(hb_dir: str) -> Dict[int, dict]:
+    """Latest beat per process: ``{pid: {"pid", "step", "t"}}``.
+
+    Tolerates a torn final line (a writer killed mid-append) by walking
+    back to the newest parseable record.
+    """
+    beats: Dict[int, dict] = {}
+    if not os.path.isdir(hb_dir):
+        return beats
+    for name in sorted(os.listdir(hb_dir)):
+        if not (name.startswith(_PREFIX) and name.endswith(".jsonl")):
+            continue
+        with open(os.path.join(hb_dir, name)) as f:
+            lines = f.read().splitlines()
+        for line in reversed(lines):
+            try:
+                rec = json.loads(line)
+                beats[int(rec["pid"])] = rec
+                break
+            except (ValueError, KeyError, TypeError):
+                continue
+    return beats
+
+
+def find_stragglers(
+    beats: Dict[int, dict],
+    now: Optional[float] = None,
+    max_step_lag: int = 3,
+    max_age_s: float = 60.0,
+) -> Dict[int, str]:
+    """Flag straggling processes → ``{pid: human-readable reason}``.
+
+    Two independent signals:
+    - **step lag**: the process's latest step trails the front-runner by
+      more than ``max_step_lag`` (slow host; collectives will rate-limit
+      everyone to it);
+    - **beat age**: the newest beat is older than ``max_age_s`` (hung or
+      dead process — the one the lock-stepped mesh cannot see from step
+      counters alone, since a stuck rank stalls every rank's step).
+    """
+    if not beats:
+        return {}
+    if now is None:
+        now = time.time()
+    lead = max(b["step"] for b in beats.values())
+    flagged: Dict[int, str] = {}
+    for pid in sorted(beats):
+        b = beats[pid]
+        reasons = []
+        lag = lead - b["step"]
+        if lag > max_step_lag:
+            reasons.append(
+                f"step lag {lag} > {max_step_lag} "
+                f"(at step {b['step']}, lead {lead})")
+        age = now - b["t"]
+        if age > max_age_s:
+            reasons.append(f"beat age {age:.1f}s > {max_age_s:.0f}s")
+        if reasons:
+            flagged[pid] = "; ".join(reasons)
+    return flagged

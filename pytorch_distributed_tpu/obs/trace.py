@@ -1,0 +1,122 @@
+"""Trace annotation helpers: one idiom for host spans and in-graph names.
+
+``scope(name)`` composes ``jax.profiler.TraceAnnotation`` (a host-side
+XPlane span around whatever runs inside the ``with``) with
+``jax.named_scope`` (HLO op-name metadata attached to every op *traced*
+inside it).  Used around the driver's step call it marks the host
+timeline; used inside a jitted function (train/steps.py forward/optimizer
+phases, the pipeline schedules' per-stage tick regions) it makes XPlane
+self-time attribute to named regions — ``pp_stage_fwd`` instead of
+``fusion.1234`` — which is what turns ``scripts/profile_trace.py`` output
+into per-stage evidence.
+
+``ProfileWindow`` drives ``jax.profiler.start_trace``/``stop_trace`` from
+epoch/step windows so a trace can capture steady state, not just the
+warm-up epoch the seed hard-coded.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Tuple
+
+import jax
+
+
+@contextlib.contextmanager
+def scope(name: str):
+    """Host TraceAnnotation + in-graph named_scope under one name."""
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
+
+
+def annotate(name: str):
+    """Decorator form of ``scope`` for whole functions."""
+
+    def wrap(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def inner(*a, **kw):
+            with scope(name):
+                return fn(*a, **kw)
+
+        return inner
+
+    return wrap
+
+
+def parse_span(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``"5"`` → (5, 6); ``"10:20"`` → (10, 20) — python half-open ranges."""
+    if spec is None or spec == "":
+        return None
+    parts = str(spec).split(":")
+    try:
+        if len(parts) == 1:
+            lo = int(parts[0])
+            return (lo, lo + 1)
+        if len(parts) == 2:
+            lo, hi = int(parts[0]), int(parts[1])
+            if hi <= lo:
+                raise ValueError
+            return (lo, hi)
+    except ValueError:
+        pass
+    raise ValueError(
+        f"bad span {spec!r}: expected 'N' or 'LO:HI' with HI > LO")
+
+
+class ProfileWindow:
+    """Epoch/step-windowed profiler control.
+
+    - no windows: trace the first trained epoch (the seed behavior);
+    - ``epochs='A'`` / ``'A:B'``: trace those epochs (one trace segment per
+      epoch — ``stop_trace`` runs at each epoch end);
+    - ``steps='I'`` / ``'I:J'``: within an active epoch, trace only that
+      in-epoch step range (steady-state capture past compilation and
+      cache warm-up).
+    """
+
+    def __init__(self, profile_dir: Optional[str], epochs: Optional[str] = None,
+                 steps: Optional[str] = None, start_epoch: int = 0):
+        self.dir = profile_dir
+        self.epochs = parse_span(epochs)
+        self.steps = parse_span(steps)
+        self.start_epoch = start_epoch
+        self._tracing = False
+
+    def _epoch_active(self, epoch: int) -> bool:
+        if not self.dir:
+            return False
+        if self.epochs is None:
+            return epoch == self.start_epoch
+        return self.epochs[0] <= epoch < self.epochs[1]
+
+    def epoch_begin(self, epoch: int) -> None:
+        if self.steps is None and self._epoch_active(epoch):
+            self._start()
+
+    def step_begin(self, epoch: int, step: int) -> None:
+        """Call at the top of every train step (cheap when inactive)."""
+        if self.steps is None:
+            return
+        if self._epoch_active(epoch) and self.steps[0] <= step < self.steps[1]:
+            self._start()
+        else:
+            self._stop()
+
+    def epoch_end(self) -> bool:
+        """Stop an open trace segment; True when one was written."""
+        return self._stop()
+
+    def _start(self) -> None:
+        if not self._tracing:
+            jax.profiler.start_trace(self.dir)
+            self._tracing = True
+
+    def _stop(self) -> bool:
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            return True
+        return False

@@ -84,12 +84,16 @@ def pipeline_apply(
             buf = carry  # activations arriving at this stage this tick
             feed = micro_local[jnp.minimum(t, n_microbatches - 1)]
             cur = jnp.where(idx == 0, feed, buf)
-            y = stage_fn(params_local, cur)
+            # named_scope: XPlane self-time attributes to the stage compute
+            # vs the ring hop instead of anonymous fusions (obs/trace.py).
+            with jax.named_scope("pp_stage_fwd"):
+                y = stage_fn(params_local, cur)
             # Last stage's finished microbatch index at tick t is t-(P-1).
             out_idx = t - (n_stages - 1)
             is_out = jnp.logical_and(idx == n_stages - 1, out_idx >= 0)
             out_contrib = jnp.where(is_out, y, jnp.zeros_like(y))
-            buf_next = jax.lax.ppermute(y, pipe_axis, perm)
+            with jax.named_scope("pp_hop"):
+                buf_next = jax.lax.ppermute(y, pipe_axis, perm)
             return buf_next, (out_contrib, out_idx)
 
         buf0 = jnp.zeros_like(micro_local[0])

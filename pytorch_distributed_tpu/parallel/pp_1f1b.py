@@ -125,7 +125,10 @@ def pipeline_1f1b_loss_and_grads(
             cur = jnp.where(idx == 0, feed, fbuf)
             slot_f = jnp.mod(fwd_m, S)
             stash = jnp.where(active_f, stash.at[slot_f].set(cur), stash)
-            y = stage_fn(params_local, cur)
+            # named_scope on each schedule phase: XPlane traces attribute
+            # per-tick self-time to fwd/head/bwd/hop (obs/trace.py).
+            with jax.named_scope("pp1f1b_fwd"):
+                y = stage_fn(params_local, cur)
 
             # ---- loss head: last stage, same tick its forward retires ----
             # lax.cond so only the last stage pays the head (vocab-matmul
@@ -141,8 +144,9 @@ def pipeline_1f1b_loss_and_grads(
                 return ((jnp.float32(0.0), jnp.float32(0.0)),
                         (zh, jnp.zeros_like(yy)))
 
-            (loss_m, correct_m), (dhead_m, dy_head) = jax.lax.cond(
-                idx == last, run_head, skip_head, head_p, y, tok_m)
+            with jax.named_scope("pp1f1b_head"):
+                (loss_m, correct_m), (dhead_m, dy_head) = jax.lax.cond(
+                    idx == last, run_head, skip_head, head_p, y, tok_m)
             active_h = jnp.logical_and(active_f, idx == last)
             g_head = masked_add(g_head, dhead_m, active_h)
             loss_sum = loss_sum + jnp.where(active_h, loss_m, 0.0)
@@ -155,8 +159,9 @@ def pipeline_1f1b_loss_and_grads(
             x_in = stash[jnp.mod(bwd_m, S)]
             # vjp re-runs the stage forward from the stashed input: in-stage
             # remat by construction; residuals live only within this tick.
-            _, svjp = jax.vjp(stage_fn, params_local, x_in)
-            dp_m, dx_m = svjp(dy_in)
+            with jax.named_scope("pp1f1b_bwd"):
+                _, svjp = jax.vjp(stage_fn, params_local, x_in)
+                dp_m, dx_m = svjp(dy_in)
             g_stage = masked_add(g_stage, dp_m, active_b)
             write0 = jnp.logical_and(active_b, idx == 0)
             d_micro = jnp.where(
@@ -166,8 +171,9 @@ def pipeline_1f1b_loss_and_grads(
                 d_micro,
             )
 
-            fbuf_next = jax.lax.ppermute(y, pipe_axis, perm_fwd)
-            bbuf_next = jax.lax.ppermute(dx_m, pipe_axis, perm_bwd)
+            with jax.named_scope("pp_hop"):
+                fbuf_next = jax.lax.ppermute(y, pipe_axis, perm_fwd)
+                bbuf_next = jax.lax.ppermute(dx_m, pipe_axis, perm_bwd)
             return (fbuf_next, bbuf_next, stash, g_stage, g_head, d_micro,
                     loss_sum, correct_sum), None
 

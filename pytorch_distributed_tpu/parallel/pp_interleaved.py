@@ -350,7 +350,10 @@ def interleaved_pipeline_loss_and_grads(
             feed = micro_local[jnp.clip(fm, 0, M - 1)]
             is_feed = jnp.logical_and(idx == 0, fk == 0)  # chunk 0
             x_in = jnp.where(is_feed, feed, inbox_f[fk])
-            y = stage_fn(chunk_of(params_local, fk), x_in)
+            # named_scope per schedule phase: XPlane traces attribute
+            # per-tick self-time to fwd/head/bwd/hop (obs/trace.py).
+            with jax.named_scope("ppint_fwd"):
+                y = stage_fn(chunk_of(params_local, fk), x_in)
             stash = jnp.where(fa == 1, stash.at[fsl].set(x_in), stash)
             # head: producing global chunk C-1 = (V-1)*P + (P-1)
             is_last = jnp.logical_and(idx == last_dev, fk == V - 1)
@@ -364,9 +367,10 @@ def interleaved_pipeline_loss_and_grads(
                 return ((jnp.float32(0.0), jnp.float32(0.0)),
                         (zh, jnp.zeros_like(yy)))
 
-            (loss_m, correct_m), (dhead_m, dy_head) = jax.lax.cond(
-                jnp.logical_and(is_last, fa == 1), run_head, skip_head,
-                head_p, y, tok_m)
+            with jax.named_scope("ppint_head"):
+                (loss_m, correct_m), (dhead_m, dy_head) = jax.lax.cond(
+                    jnp.logical_and(is_last, fa == 1), run_head, skip_head,
+                    head_p, y, tok_m)
             active_h = jnp.logical_and(fa == 1, is_last)
             g_head = masked_add(g_head, dhead_m, active_h)
             loss_sum = loss_sum + jnp.where(active_h, loss_m, 0.0)
@@ -379,9 +383,10 @@ def interleaved_pipeline_loss_and_grads(
             # ---- backward -----------------------------------------------
             x_bwd = stash[bsl]
             dy_in = inbox_b[bk].astype(x_bwd.dtype)
-            _, svjp = jax.vjp(
-                stage_fn, chunk_of(params_local, bk), x_bwd)
-            dp_m, dx_m = svjp(dy_in)
+            with jax.named_scope("ppint_bwd"):
+                _, svjp = jax.vjp(
+                    stage_fn, chunk_of(params_local, bk), x_bwd)
+                dp_m, dx_m = svjp(dy_in)
             g_chunks = jax.tree_util.tree_map(
                 lambda acc, u: acc.at[bk].add(
                     jnp.where(ba == 1, u, 0).astype(acc.dtype)),
@@ -394,8 +399,9 @@ def interleaved_pipeline_loss_and_grads(
                     dx_m.astype(d_micro.dtype)),
                 d_micro,
             )
-            vin_f_next = jax.lax.ppermute(y, pipe_axis, ring_fwd)
-            vin_b_next = jax.lax.ppermute(dx_m, pipe_axis, ring_bwd)
+            with jax.named_scope("pp_hop"):
+                vin_f_next = jax.lax.ppermute(y, pipe_axis, ring_fwd)
+                vin_b_next = jax.lax.ppermute(dx_m, pipe_axis, ring_bwd)
             return (vin_f_next, vin_b_next, inbox_f, inbox_b, stash,
                     g_chunks, g_head, d_micro, loss_sum, correct_sum), None
 

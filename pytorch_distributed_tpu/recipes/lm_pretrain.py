@@ -131,6 +131,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="train on real files: byte-level LM over this glob "
                         "(e.g. 'src/**/*.py'); forces --vocab 256 and "
                         "replaces the synthetic dataset")
+    p.add_argument("--metrics-jsonl", type=str, default=None,
+                   dest="metrics_jsonl", metavar="PATH",
+                   help="append one structured JSON record per train step "
+                        "(step-time EMA/p50/p95, tokens/s, loss, lr, "
+                        "in-graph grad/param norms) to this file; "
+                        "summarize with scripts/obs_report.py")
+    p.add_argument("--hb-dir", type=str, default=None, dest="hb_dir",
+                   metavar="DIR",
+                   help="shared heartbeat directory: each mesh process "
+                        "appends {pid, step, t} beats; obs_report.py flags "
+                        "stragglers by step lag / beat age")
+    p.add_argument("--hb-interval", type=float, default=5.0,
+                   dest="hb_interval_s", metavar="SEC",
+                   help="minimum seconds between heartbeats (default 5)")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -350,6 +364,8 @@ def main(argv=None) -> float:
             lr_schedule=schedule, clip_grad_norm=args.clip_grad_norm,
             accum_steps=args.accum_steps, fused_ce_chunks=args.fused_ce,
             fused_ce_mode=args.fused_ce_mode,
+            metrics_jsonl=args.metrics_jsonl, hb_dir=args.hb_dir,
+            hb_interval_s=args.hb_interval_s,
         )
         final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
         if args.generate > 0:  # plain-dp only, validated with the args above

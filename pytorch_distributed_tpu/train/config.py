@@ -81,7 +81,18 @@ class Config:
     ckpt_backend: str = "msgpack"
     epoch_csv: Optional[str] = None
     profile_dir: Optional[str] = None
+    # Profiler capture windows (obs/trace.py ProfileWindow): 'E' or 'A:B'
+    # epochs, optionally narrowed to an in-epoch 'I' or 'I:J' step range —
+    # steady-state traces instead of the warm-up-only epoch-0 capture.
+    profile_epochs: Optional[str] = None
+    profile_steps: Optional[str] = None
     telemetry_csv: Optional[str] = None
+    # Unified observability (obs/): one structured JSON record per train
+    # step, and per-process heartbeats for cross-process straggler
+    # detection (scripts/obs_report.py folds all of it into one summary).
+    metrics_jsonl: Optional[str] = None
+    hb_dir: Optional[str] = None
+    hb_interval_s: float = 5.0
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -167,7 +178,30 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="append [timestamp, epoch_seconds] rows to this CSV")
     p.add_argument("--profile-dir", default=d.profile_dir, type=str,
                    help="write an XPlane/TensorBoard profiler trace of the "
-                   "first trained epoch of this run to this directory")
+                   "first trained epoch of this run to this directory "
+                   "(narrow the window with --profile-epochs/--profile-steps)")
+    p.add_argument("--profile-epochs", default=d.profile_epochs, type=str,
+                   dest="profile_epochs", metavar="E[:F]",
+                   help="epoch window to trace under --profile-dir "
+                   "('2' or '2:4'); default: the first trained epoch")
+    p.add_argument("--profile-steps", default=d.profile_steps, type=str,
+                   dest="profile_steps", metavar="I[:J]",
+                   help="in-epoch step window narrowing the trace to steady "
+                   "state ('10' or '10:20'); default: whole epoch")
+    p.add_argument("--metrics-jsonl", default=d.metrics_jsonl, type=str,
+                   dest="metrics_jsonl", metavar="PATH",
+                   help="append one structured JSON record per train step "
+                   "(wall time, step-time EMA/p50/p95/max, throughput, "
+                   "loss, lr, in-graph grad/param norms) to this file; "
+                   "summarize with scripts/obs_report.py")
+    p.add_argument("--hb-dir", default=d.hb_dir, type=str, dest="hb_dir",
+                   metavar="DIR",
+                   help="shared heartbeat directory: each mesh process "
+                   "appends {pid, step, t} beats; scripts/obs_report.py "
+                   "flags stragglers by step lag / beat age")
+    p.add_argument("--hb-interval", default=d.hb_interval_s, type=float,
+                   dest="hb_interval_s", metavar="SEC",
+                   help="minimum seconds between heartbeats (default 5)")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

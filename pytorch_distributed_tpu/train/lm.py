@@ -16,7 +16,6 @@ meters, msgpack checkpoints) and adds:
 
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -25,9 +24,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_tpu.ops import cross_entropy
-from pytorch_distributed_tpu.train.meters import AverageMeter, ProgressMeter
+from pytorch_distributed_tpu.train.meters import StepMeters
 from pytorch_distributed_tpu.train.optim import sgd_init, sgd_update
 from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.steps import tree_l2_norm
 
 
 class SyntheticTokenDataset:
@@ -203,6 +203,7 @@ def make_lm_train_step(
     accum_steps: int = 1,
     fused_ce_chunks: int = 0,
     fused_ce_mode: str = "auto",
+    log_norms: bool = False,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
@@ -219,7 +220,13 @@ def make_lm_train_step(
     ``fused_ce_mode`` selects the sharded fused-CE variant (see
     ``resolve_fused_ce_mode``); the default ``'auto'`` picks from the
     mesh + param specs, so ``fused_ce_chunks=N`` alone does the right
-    thing on DP, TP, and single-device meshes alike."""
+    thing on DP, TP, and single-device meshes alike.
+
+    ``log_norms`` adds in-graph global ``grad_norm``/``param_norm`` metrics
+    (per-leaf reductions stay sharding-local; the scalars replicate).  Off
+    by default — the extra reduce ops lengthen compiles, so the cost is
+    only paid when a metrics sink is on (``LMTrainer`` enables it with
+    ``metrics_jsonl``)."""
     manual = getattr(model, "has_manual_grads", lambda: False)()
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -241,6 +248,13 @@ def make_lm_train_step(
 
     def step(state: TrainState, tokens: jnp.ndarray, lr: jnp.ndarray):
         def loss_fn(params, toks):
+            # named_scope: forward ops carry the phase name into XPlane
+            # traces (autodiff derives the backward names from it) —
+            # per-phase self-time instead of anonymous fusions.
+            with jax.named_scope("lm_forward"):
+                return loss_impl(params, toks)
+
+        def loss_impl(params, toks):
             if fused_ce_chunks:
                 # Fused tied-head + CE (ops/fused_ce.py): the [B, L, V]
                 # logits tensor never materializes — hidden rows project
@@ -341,23 +355,31 @@ def make_lm_train_step(
             grads = jax.tree_util.tree_map(
                 lambda g, p: (g * inv).astype(p.dtype), grads, state.params)
             loss, acc = loss * inv, acc * inv
+        # Pre-clip global grad norm: computed in-graph when clipping needs
+        # it or when the obs layer asked for it (an on-device scalar —
+        # converted lazily, never a host sync).
+        gnorm = (tree_l2_norm(grads)
+                 if (log_norms or clip_grad_norm > 0.0) else None)
         if clip_grad_norm > 0.0:
-            gnorm = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree_util.tree_leaves(grads)
-            ))
-            scale = jnp.minimum(1.0, clip_grad_norm / jnp.maximum(gnorm, 1e-12))
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
-                grads,
+            with jax.named_scope("grad_clip"):
+                scale = jnp.minimum(
+                    1.0, clip_grad_norm / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                    grads,
+                )
+        with jax.named_scope("optimizer"):
+            new_params, new_momentum = sgd_update(
+                grads, state.momentum, state.params, lr,
+                momentum=momentum, weight_decay=weight_decay,
             )
-        new_params, new_momentum = sgd_update(
-            grads, state.momentum, state.params, lr,
-            momentum=momentum, weight_decay=weight_decay,
-        )
         new_state = TrainState(state.step + 1, new_params, state.batch_stats,
                                new_momentum)
-        return new_state, {"loss": loss, "acc": acc * 100.0}
+        metrics = {"loss": loss, "acc": acc * 100.0}
+        if log_norms:
+            metrics["grad_norm"] = gnorm
+            metrics["param_norm"] = tree_l2_norm(new_params)
+        return new_state, metrics
 
     from pytorch_distributed_tpu.parallel.tp import state_specs
 
@@ -436,6 +458,9 @@ class LMTrainer:
         accum_steps: int = 1,
         fused_ce_chunks: int = 0,
         fused_ce_mode: str = "auto",
+        metrics_jsonl: Optional[str] = None,
+        hb_dir: Optional[str] = None,
+        hb_interval_s: float = 5.0,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -448,7 +473,10 @@ class LMTrainer:
         (0 = synchronous host assembly + transfer in the step loop — the
         before/after axis measured in experiments/lm_feeder_bench.py);
         ``fused_ce_mode``: sharding variant of the fused loss head
-        (auto | replicated | dp | tp — see ``resolve_fused_ce_mode``)."""
+        (auto | replicated | dp | tp — see ``resolve_fused_ce_mode``);
+        ``metrics_jsonl``/``hb_dir``: unified observability (obs/) — one
+        structured record per step, and per-process heartbeats for the
+        cross-process straggler monitor."""
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -479,7 +507,10 @@ class LMTrainer:
                                           clip_grad_norm=clip_grad_norm,
                                           accum_steps=accum_steps,
                                           fused_ce_chunks=fused_ce_chunks,
-                                          fused_ce_mode=fused_ce_mode)
+                                          fused_ce_mode=fused_ce_mode,
+                                          # in-graph norms only when a
+                                          # metrics sink will consume them
+                                          log_norms=bool(metrics_jsonl))
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every
@@ -494,6 +525,13 @@ class LMTrainer:
             if eval_dataset is not None
             else None
         )
+        from pytorch_distributed_tpu.obs import HeartbeatWriter, MetricsLogger
+
+        self.obs = MetricsLogger(metrics_jsonl,
+                                 process_index=jax.process_index())
+        self.hb = (HeartbeatWriter(hb_dir, jax.process_index(),
+                                   interval_s=hb_interval_s)
+                   if hb_dir else None)
 
     def _row_span(self) -> Tuple[int, int]:
         """This process's row range of the global batch under the token
@@ -586,13 +624,16 @@ class LMTrainer:
         return loss, ppl, acc
 
     def fit(self, steps: int, print_freq: int = 10) -> float:
-        losses = AverageMeter("Loss", ":.4e")
-        accs = AverageMeter("Acc@1", ":6.2f")
-        batch_time = AverageMeter("Time", ":6.3f")
-        progress = ProgressMeter(steps, [batch_time, losses, accs],
-                                 prefix="Step: ")
+        from pytorch_distributed_tpu.obs import scope
+
+        meters = StepMeters(
+            steps,
+            [("loss", "Loss", ":.4e"), ("acc", "Acc@1", ":6.2f")],
+            prefix="Step: ",
+        )
         lr = jnp.float32(self.lr)
-        end = time.time()
+        # Tokens per optimizer step — the LM throughput unit (tokens/s).
+        tokens_per_step = self.batch_size * self.dataset.seq_len
         final_ppl = None  # ppl from an interval eval on the very last step
         preempted = False
         # Prefetch ≥2: batch assembly (real host work for TextFileDataset
@@ -612,6 +653,7 @@ class LMTrainer:
         else:  # synchronous baseline (measured in lm_feeder_bench)
             token_iter = (self._put_tokens(b) for b in host_iter)
         try:
+            meters.restart_clock()
             for i in range(steps):
                 # print_freq cadence: the cross-process agreement collective
                 # (see utils/preempt.py) must run at the same step on every
@@ -625,13 +667,16 @@ class LMTrainer:
                 tokens = next(token_iter)
                 if self.lr_schedule is not None:
                     lr = jnp.float32(self.lr_schedule(i))
-                self.state, metrics = self.step_fn(self.state, tokens, lr)
-                losses.update(metrics["loss"], self.batch_size)
-                accs.update(metrics["acc"], self.batch_size)
-                batch_time.update(time.time() - end)
-                end = time.time()
-                if i % print_freq == 0:
-                    progress.display(i)
+                with scope("lm_step"):
+                    self.state, metrics = self.step_fn(self.state, tokens, lr)
+                dt = meters.update(metrics, self.batch_size)
+                self.obs.log_step(
+                    i, step_time=dt, n_items=tokens_per_step, lr=lr,
+                    scalars=dict(metrics),  # incl. norms when log_norms on
+                )
+                if self.hb is not None:
+                    self.hb.beat(i)
+                meters.maybe_display(i, print_freq)
                 if (
                     self._eval_fn is not None
                     and self.eval_every > 0
@@ -639,11 +684,14 @@ class LMTrainer:
                 ):
                     _, final_ppl, _ = self.evaluate()
                     self.best_ppl = min(self.best_ppl, final_ppl)
-                    end = time.time()  # eval must not pollute the step meter
+                    meters.restart_clock()  # eval must not pollute the meter
                 else:
                     final_ppl = None
         finally:
             token_iter.close()  # unblocks the producer on early exit
+            if self.hb is not None:
+                self.hb.close(int(self.state.step) - 1)
+            self.obs.close()
         is_best = False
         if self._eval_fn is not None and not preempted:
             # Preempted runs skip the final eval: the SIGTERM grace window
@@ -655,7 +703,7 @@ class LMTrainer:
             # (the common case: the just-run interval eval set best_ppl).
             is_best = final_ppl <= self.best_ppl
             self.best_ppl = min(self.best_ppl, final_ppl)
-        last_loss = losses.val  # end-of-training loss, not the run average
+        last_loss = meters["loss"].val  # end-of-training loss, not run avg
         if self.checkpoint_dir:
             from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
 

@@ -12,7 +12,8 @@ distributed.py:262-264) never happen in the hot loop.
 
 from __future__ import annotations
 
-from typing import Iterable, List
+import time
+from typing import Iterable, List, Sequence, Tuple
 
 
 def _to_float(v) -> float:
@@ -92,3 +93,47 @@ class ProgressMeter:
         num_digits = len(str(num_batches // 1))
         fmt = "{:" + str(num_digits) + "d}"
         return "[" + fmt + "/" + fmt.format(num_batches) + "]"
+
+
+class StepMeters:
+    """The step-loop instrumentation bundle: a batch-time meter, named
+    metric meters fed from the step's metrics dict, and the reference-format
+    progress row — the single copy of the loop previously duplicated between
+    ``train/trainer.py`` and ``train/lm.py``.
+
+    ``fields`` is an ordered sequence of ``(metrics_key, display_name,
+    fmt)`` triples; ``update`` accepts the (possibly unready device) metrics
+    dict and returns the host-measured step seconds so callers can feed the
+    same number to ``obs.MetricsLogger``.
+    """
+
+    def __init__(self, num_batches: int,
+                 fields: Sequence[Tuple[str, str, str]], prefix: str = ""):
+        self.batch_time = AverageMeter("Time", ":6.3f")
+        self._keys = [k for k, _, _ in fields]
+        self.meters = {k: AverageMeter(name, fmt) for k, name, fmt in fields}
+        self.progress = ProgressMeter(
+            num_batches, [self.batch_time, *self.meters.values()], prefix
+        )
+        self._end = time.time()
+
+    def __getitem__(self, key: str) -> AverageMeter:
+        return self.meters[key]
+
+    def update(self, metrics, n: int = 1) -> float:
+        """Record one step; values stay lazy (drained at display/read time)."""
+        for k in self._keys:
+            self.meters[k].update(metrics[k], n)
+        now = time.time()
+        dt = now - self._end
+        self.batch_time.update(dt)
+        self._end = now
+        return dt
+
+    def restart_clock(self) -> None:
+        """Exclude out-of-band work (eval, checkpoint) from the step timer."""
+        self._end = time.time()
+
+    def maybe_display(self, batch: int, print_freq: int) -> None:
+        if print_freq > 0 and batch % print_freq == 0:
+            self.progress.display(batch)

@@ -37,25 +37,39 @@ Batch = Dict[str, jnp.ndarray]
 Metrics = Dict[str, jnp.ndarray]
 
 
+def tree_l2_norm(tree) -> jnp.ndarray:
+    """Global L2 norm of a pytree, f32 accumulation — computed in-graph so
+    the host never syncs for it (meters / MetricsLogger convert lazily)."""
+    return jnp.sqrt(sum(
+        jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ))
+
+
 def _forward_and_sums(model, params, batch_stats, batch: Batch, train: bool,
                       dropout_rng=None):
     """Weighted-sum loss/metric numerators + weight count (exact over padding)."""
     variables = {"params": params, "batch_stats": batch_stats}
-    if train:
-        rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
-        logits, mutated = model.apply(
-            variables, batch["images"], train=True, mutable=["batch_stats"],
-            rngs=rngs,
-        )
-        new_stats = mutated.get("batch_stats", batch_stats)
-    else:
-        logits = model.apply(variables, batch["images"], train=False)
-        new_stats = batch_stats
-    w = batch["weights"].astype(jnp.float32)
-    count = jnp.sum(w)
-    loss_sum = cross_entropy(logits, batch["labels"], weights=w) * count
-    c1 = jnp.sum(topk_correct(logits, batch["labels"], 1) * w)
-    c5 = jnp.sum(topk_correct(logits, batch["labels"], 5) * w)
+    # named_scope: forward ops carry this name into XPlane traces (autodiff
+    # derives the backward op names from it), so profiler self-time
+    # attributes to phases instead of anonymous fusions.
+    with jax.named_scope("forward"):
+        if train:
+            rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+            logits, mutated = model.apply(
+                variables, batch["images"], train=True,
+                mutable=["batch_stats"], rngs=rngs,
+            )
+            new_stats = mutated.get("batch_stats", batch_stats)
+        else:
+            logits = model.apply(variables, batch["images"], train=False)
+            new_stats = batch_stats
+    with jax.named_scope("loss_and_metrics"):
+        w = batch["weights"].astype(jnp.float32)
+        count = jnp.sum(w)
+        loss_sum = cross_entropy(logits, batch["labels"], weights=w) * count
+        c1 = jnp.sum(topk_correct(logits, batch["labels"], 1) * w)
+        c5 = jnp.sum(topk_correct(logits, batch["labels"], 5) * w)
     return loss_sum, (logits, new_stats, c1, c5, count)
 
 
@@ -70,6 +84,7 @@ def make_train_step(
     seed: int = 0,
     tx=None,
     accum_steps: int = 1,
+    log_norms: bool = False,
 ) -> Callable[[TrainState, Batch, jnp.ndarray], Tuple[TrainState, Metrics]]:
     """Build the jitted train step for ``mesh``.
 
@@ -100,6 +115,12 @@ def make_train_step(
     operand; with optax the schedule lives inside ``tx`` and the ``lr``
     argument is ignored (state.momentum carries the optax opt_state).
 
+    ``log_norms``: add in-graph global ``grad_norm``/``param_norm`` scalars
+    to the metrics dict (the obs-layer observables, converted lazily by the
+    MetricsLogger).  Off by default: the per-leaf reductions measurably
+    lengthen XLA compiles, so the cost is only paid when a metrics sink is
+    actually attached (Trainer enables it with ``--metrics-jsonl``).
+
     BatchNorm semantics differ deliberately, matching each formulation's GPU
     ancestor: GSPMD BN normalizes over the *global* batch (SyncBN — XLA
     inserts the cross-replica mean), while the shard_map variant normalizes
@@ -109,13 +130,15 @@ def make_train_step(
 
     def sync_grads(grads, count):
         # grads arrive as *local weighted sums*; psum then normalize.
-        if wire_dtype is not None:
-            grads = jax.tree_util.tree_map(lambda g: g.astype(wire_dtype), grads)
-        grads = jax.lax.psum(grads, data_axis)
-        gcount = jax.lax.psum(count, data_axis)
-        return jax.tree_util.tree_map(
-            lambda g: g.astype(jnp.float32) / gcount, grads
-        ), gcount
+        with jax.named_scope("grad_sync"):
+            if wire_dtype is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(wire_dtype), grads)
+            grads = jax.lax.psum(grads, data_axis)
+            gcount = jax.lax.psum(count, data_axis)
+            return jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / gcount, grads
+            ), gcount
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -143,15 +166,16 @@ def make_train_step(
         )
 
     def apply_updates(state: TrainState, grads, lr):
-        if tx is None:
-            return sgd_update(
-                grads, state.momentum, state.params, lr,
-                momentum=momentum, weight_decay=weight_decay,
-            )
-        import optax
+        with jax.named_scope("optimizer"):
+            if tx is None:
+                return sgd_update(
+                    grads, state.momentum, state.params, lr,
+                    momentum=momentum, weight_decay=weight_decay,
+                )
+            import optax
 
-        updates, new_opt = tx.update(grads, state.momentum, state.params)
-        return optax.apply_updates(state.params, updates), new_opt
+            updates, new_opt = tx.update(grads, state.momentum, state.params)
+            return optax.apply_updates(state.params, updates), new_opt
 
     def micro_grads(params, stats, mbatch, mrng):
         """Unnormalized (sum-form) grads + metric sums for one microbatch."""
@@ -233,6 +257,11 @@ def make_train_step(
             "acc1": jax.lax.psum(c1, data_axis) * 100.0 / gcount,
             "acc5": jax.lax.psum(c5, data_axis) * 100.0 / gcount,
         }
+        if log_norms:
+            # Synced grads are identical on every shard, so the per-shard
+            # norm IS the global norm — no extra collective.
+            metrics["grad_norm"] = tree_l2_norm(grads)
+            metrics["param_norm"] = tree_l2_norm(new_params)
         return (
             TrainState(state.step + 1, new_params, new_stats, new_momentum),
             metrics,
@@ -256,6 +285,9 @@ def make_train_step(
             "acc1": c1 * 100.0 / count,
             "acc5": c5 * 100.0 / count,
         }
+        if log_norms:
+            metrics["grad_norm"] = tree_l2_norm(grads)
+            metrics["param_norm"] = tree_l2_norm(new_params)
         return (
             TrainState(state.step + 1, new_params, new_stats, new_momentum),
             metrics,

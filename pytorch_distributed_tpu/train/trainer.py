@@ -26,11 +26,17 @@ from pytorch_distributed_tpu.data import (
     SyntheticImageDataset,
 )
 from pytorch_distributed_tpu.data.transforms import eval_transform, train_transform
+from pytorch_distributed_tpu.obs import (
+    HeartbeatWriter,
+    MetricsLogger,
+    ProfileWindow,
+    scope,
+)
 from pytorch_distributed_tpu.parallel import DistContext, data_parallel_mesh
 from pytorch_distributed_tpu.train.checkpoint import load_checkpoint, save_checkpoint
 from pytorch_distributed_tpu.train.config import Config
 from pytorch_distributed_tpu.train.lr import cosine_lr, step_decay_lr
-from pytorch_distributed_tpu.train.meters import AverageMeter, ProgressMeter
+from pytorch_distributed_tpu.train.meters import AverageMeter, ProgressMeter, StepMeters
 from pytorch_distributed_tpu.train.optim import sgd_init
 from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.steps import make_eval_step, make_train_step
@@ -180,10 +186,24 @@ class Trainer:
             seed=seed,
             tx=tx,
             accum_steps=cfg.accum_steps,
+            # In-graph grad/param norms only when a metrics sink consumes
+            # them — the reductions lengthen compiles, so observability
+            # costs nothing when off.
+            log_norms=bool(cfg.metrics_jsonl),
         )
         self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
+        # One observability entry point (obs/): the epoch CSV registers as
+        # an epoch sink, a --telemetry-csv sampler registers in fit(), and
+        # per-step structured records land in --metrics-jsonl.
         self.csv = EpochCSVLogger(cfg.epoch_csv)
+        self.obs = MetricsLogger(cfg.metrics_jsonl,
+                                 process_index=self.ctx.process_index)
+        self.obs.register(self.csv)
+        self.hb = (HeartbeatWriter(cfg.hb_dir, self.ctx.process_index,
+                                   interval_s=cfg.hb_interval_s)
+                   if cfg.hb_dir else None)
+        self._global_step = 0  # monotonically counts logged train steps
 
     def _load_pretrained(self) -> None:
         """``--pretrained`` parity (reference distributed.py:134-136 loads zoo
@@ -298,7 +318,7 @@ class Trainer:
         )
 
     # ----------------------------------------------------------------- train
-    def train_epoch(self, epoch: int) -> None:
+    def train_epoch(self, epoch: int, profiler: Optional[ProfileWindow] = None) -> None:
         cfg = self.cfg
         if cfg.lr_schedule == "cosine":
             lr = cosine_lr(cfg.lr, epoch, cfg.epochs,
@@ -309,20 +329,19 @@ class Trainer:
             raise ValueError(
                 f"unknown lr_schedule {cfg.lr_schedule!r}: "
                 "expected 'step' or 'cosine'")
-        batch_time = AverageMeter("Time", ":6.3f")
-        losses = AverageMeter("Loss", ":.4e")
-        top1 = AverageMeter("Acc@1", ":6.2f")
-        top5 = AverageMeter("Acc@5", ":6.2f")
-        progress = ProgressMeter(
+        meters = StepMeters(
             len(self.train_loader),
-            [batch_time, losses, top1, top5],
+            [("loss", "Loss", ":.4e"), ("acc1", "Acc@1", ":6.2f"),
+             ("acc5", "Acc@5", ":6.2f")],
             prefix=f"Epoch: [{epoch}]",
         )
         self.train_loader.set_epoch(epoch)
         self.val_sampler.set_epoch(epoch)
         lr_arr = jnp.float32(lr)
-        end = time.time()
+        meters.restart_clock()
         for i, batch in enumerate(self.feeder(iter(self.train_loader))):
+            if profiler is not None:
+                profiler.step_begin(epoch, i)
             # Polled at print_freq cadence so the agreement collective (a
             # tiny any-rank-flagged all-reduce every rank runs at the same
             # step — signal skew across hosts must not break ranks at
@@ -331,16 +350,20 @@ class Trainer:
                     and self._preempt_agreed()):
                 break
             n = self.cfg.batch_size
-            self.state, metrics = self.train_step(self.state, batch, lr_arr)
-            # Unready device scalars: meters convert lazily at display time,
-            # so no per-step host sync (SURVEY.md §7.4 item 1).
-            losses.update(metrics["loss"], n)
-            top1.update(metrics["acc1"], n)
-            top5.update(metrics["acc5"], n)
-            batch_time.update(time.time() - end)
-            end = time.time()
-            if i % cfg.print_freq == 0:
-                progress.display(i)
+            with scope("train_step"):
+                self.state, metrics = self.train_step(self.state, batch, lr_arr)
+            # Unready device scalars: meters and the metrics logger convert
+            # lazily, so no per-step host sync (SURVEY.md §7.4 item 1).
+            dt = meters.update(metrics, n)
+            self.obs.log_step(
+                self._global_step, step_time=dt, n_items=n, lr=lr,
+                scalars=dict(metrics),  # incl. norms when --metrics-jsonl
+                extra={"epoch": epoch},
+            )
+            if self.hb is not None:
+                self.hb.beat(self._global_step)
+            self._global_step += 1
+            meters.maybe_display(i, cfg.print_freq)
 
     # ------------------------------------------------------------------ eval
     def validate(self) -> float:
@@ -376,19 +399,21 @@ class Trainer:
 
     # ------------------------------------------------------------------- fit
     def fit(self) -> float:
-        """Train/eval driver with the reference's observability surface
-        (SURVEY.md §5.1): per-step meters, per-epoch CSV, optional in-process
-        device telemetry, and an optional XPlane profiler trace of epoch 0
-        (the TPU-native upgrade of nvidia-smi sampling — open in
-        TensorBoard's profile plugin)."""
+        """Train/eval driver with the unified observability surface (obs/):
+        per-step meters + structured --metrics-jsonl records, per-epoch CSV,
+        optional in-process device telemetry, per-process heartbeats
+        (--hb-dir), and an optional XPlane profiler trace windowed by
+        --profile-epochs/--profile-steps (the TPU-native upgrade of
+        nvidia-smi sampling — open in TensorBoard's profile plugin)."""
         cfg = self.cfg
         if cfg.evaluate:
             return self.validate()
-        telemetry = None
-        if cfg.telemetry_csv:
+        if cfg.telemetry_csv and not getattr(self, "_telemetry_on", False):
             from pytorch_distributed_tpu.utils.telemetry import TelemetrySampler
 
-            telemetry = TelemetrySampler(cfg.telemetry_csv).start()
+            # Registered (not started ad hoc): obs.close() stops it.
+            self.obs.register(TelemetrySampler(cfg.telemetry_csv))
+            self._telemetry_on = True
         import threading
 
         from pytorch_distributed_tpu.utils.preempt import PreemptionGuard
@@ -408,8 +433,10 @@ class Trainer:
             if installed:
                 self.preempt.uninstall()
                 self.preempt = None
-            if telemetry is not None:
-                telemetry.stop()
+            if self.hb is not None:
+                self.hb.close(max(0, self._global_step - 1))
+            self.obs.close()  # flush JSONL, stop registered telemetry
+            self._telemetry_on = False
 
     def _preempt_agreed(self) -> bool:
         """Cross-process 'any rank flagged?' — see utils/preempt.py.  Every
@@ -425,15 +452,15 @@ class Trainer:
 
     def _fit_epochs(self) -> float:
         cfg = self.cfg
+        profiler = ProfileWindow(cfg.profile_dir, epochs=cfg.profile_epochs,
+                                 steps=cfg.profile_steps,
+                                 start_epoch=cfg.start_epoch)
         for epoch in range(cfg.start_epoch, cfg.epochs):
-            self.csv.epoch_start()
-            profiling = cfg.profile_dir and epoch == cfg.start_epoch
-            if profiling:
-                jax.profiler.start_trace(cfg.profile_dir)
-            self.train_epoch(epoch)
+            self.obs.epoch_start()
+            profiler.epoch_begin(epoch)
+            self.train_epoch(epoch, profiler)
             jax.block_until_ready(self.state.params)
-            if profiling:
-                jax.profiler.stop_trace()
+            if profiler.epoch_end():
                 print(f"=> wrote profiler trace to '{cfg.profile_dir}'")
             if self.preempt is not None and self._preempt_agreed():
                 # Preempted mid-epoch: the epoch is incomplete, so record the
@@ -448,7 +475,7 @@ class Trainer:
                 )
                 break
             acc1 = self.validate()
-            elapsed = self.csv.epoch_end()
+            elapsed = self.obs.epoch_end()  # drives the registered epoch CSV
             print(f"Epoch {epoch} took {elapsed:.1f}s", flush=True)
             is_best = acc1 > self.best_acc1
             self.best_acc1 = max(acc1, self.best_acc1)

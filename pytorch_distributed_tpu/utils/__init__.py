@@ -1,5 +1,10 @@
 """Out-of-band instrumentation: CSV timers and device telemetry
-(reference statistics.sh / per-epoch CSV parity, SURVEY.md §5.1)."""
+(reference statistics.sh / per-epoch CSV parity, SURVEY.md §5.1).
+
+Both register as sinks of ``obs.MetricsLogger`` — ``EpochCSVLogger`` via
+its ``epoch_start``/``epoch_end`` pair, ``TelemetrySampler`` via
+``start``/``stop`` — so the unified observability layer (``obs/``) is the
+single entry point; these modules stay importable standalone."""
 
 from pytorch_distributed_tpu.utils.csvlog import EpochCSVLogger
 

@@ -3,14 +3,24 @@
 Capability parity with the reference's in-loop CSV timer
 (reference dataparallel.py:188,205-213; distributed_slurm_main.py:209,227-235):
 appends ``[timestamp, epoch_seconds]`` rows to ``<recipe>.csv``, the repo's
-de-facto performance oracle (SURVEY.md §4 item 3).
+de-facto performance oracle (SURVEY.md §4 item 3).  A header row is written
+on first append so the files are self-describing; the file is only opened
+when ``path`` is set, and per write so concurrent runs can share a file via
+O_APPEND.
+
+Registers as an epoch sink of ``obs.MetricsLogger`` (it exposes the
+``epoch_start``/``epoch_end`` pair), so the trainer drives it through the
+one observability entry point.
 """
 
 from __future__ import annotations
 
 import csv
+import os
 import time
 from typing import Optional
+
+HEADER = ("timestamp", "epoch_seconds")
 
 
 class EpochCSVLogger:
@@ -22,10 +32,19 @@ class EpochCSVLogger:
         self._t0 = time.time()
 
     def epoch_end(self) -> float:
-        assert self._t0 is not None, "epoch_end without epoch_start"
+        if self._t0 is None:
+            raise RuntimeError(
+                "EpochCSVLogger.epoch_end() called without a matching "
+                "epoch_start()")
         elapsed = time.time() - self._t0
         if self.path:
+            write_header = (
+                not os.path.exists(self.path) or os.path.getsize(self.path) == 0
+            )
             with open(self.path, "a+", newline="") as f:
-                csv.writer(f).writerow([time.time(), elapsed])
+                w = csv.writer(f)
+                if write_header:
+                    w.writerow(HEADER)
+                w.writerow([time.time(), elapsed])
         self._t0 = None
         return elapsed

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Fold one run's observability artifacts into a human-readable summary.
+
+Inputs (any subset):
+- ``--metrics-jsonl``  per-step records from ``obs.MetricsLogger``
+  (``--metrics-jsonl`` on any recipe / ``LMTrainer``);
+- ``--hb-dir``         per-process heartbeats from ``obs.HeartbeatWriter``
+  (``--hb-dir``), with straggler flagging by step lag / beat age;
+- ``--telemetry-csv``  the 500 ms device-memory CSV from
+  ``utils.telemetry.TelemetrySampler`` (``--telemetry-csv``).
+
+Output: step-time percentiles + throughput + loss/grad-norm trajectory,
+per-device peak HBM, and a straggler table — the per-stage, per-device
+measurements the reference's per-node nvidia-smi CSVs never aggregated.
+
+``--selftest`` synthesizes all three artifacts in a temp dir, runs the
+report on them, and asserts the summary — the fast tier-1 CI hook.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _mib(n: float) -> str:
+    return f"{n / (1024 * 1024):.1f}"
+
+
+def load_metrics(path: str) -> List[dict]:
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line from a killed writer
+    return records
+
+
+def summarize_metrics(records: List[dict]) -> List[str]:
+    if not records:
+        return ["  (no records)"]
+    records = sorted(records, key=lambda r: (r.get("step", 0), r.get("t", 0)))
+    times = sorted(r["step_time"] for r in records if "step_time" in r)
+    lines = [
+        f"  steps logged      {len(records)} "
+        f"(step {records[0].get('step')}..{records[-1].get('step')})",
+        f"  wall span         {records[-1].get('t', 0) - records[0].get('t', 0):.1f}s",
+        f"  step time         p50 {_pct(times, .5) * 1e3:.1f}ms  "
+        f"p95 {_pct(times, .95) * 1e3:.1f}ms  "
+        f"max {(times[-1] if times else 0) * 1e3:.1f}ms",
+    ]
+    thr = [r["throughput"] for r in records if "throughput" in r]
+    if thr:
+        lines.append(f"  throughput        mean {sum(thr) / len(thr):.1f}/s  "
+                     f"last {thr[-1]:.1f}/s")
+    loss = [r["loss"] for r in records if "loss" in r]
+    if loss:
+        lines.append(f"  loss              first {loss[0]:.4f}  "
+                     f"last {loss[-1]:.4f}")
+    gn = [r["grad_norm"] for r in records if "grad_norm" in r]
+    if gn:
+        lines.append(f"  grad_norm         last {gn[-1]:.4f}  "
+                     f"max {max(gn):.4f}")
+    lr = [r["lr"] for r in records if "lr" in r]
+    if lr:
+        lines.append(f"  lr                last {lr[-1]:.6g}")
+    return lines
+
+
+def summarize_telemetry(path: str) -> List[str]:
+    """Per-device peak/limit from the ``timestamp,index,bytes_limit,
+    bytes_in_use,peak_bytes`` CSV (no header in the statistics.sh contract)."""
+    peak: Dict[int, float] = {}
+    limit: Dict[int, float] = {}
+    n_rows = 0
+    with open(path, newline="") as f:
+        for row in csv.reader(f):
+            if len(row) < 5:
+                continue
+            try:
+                idx = int(row[1])
+                lim, pk = float(row[2]), float(row[4])
+            except ValueError:
+                continue  # header or torn row
+            n_rows += 1
+            peak[idx] = max(peak.get(idx, 0.0), pk)
+            limit[idx] = max(limit.get(idx, 0.0), lim)
+    if not peak:
+        return ["  (no samples)"]
+    lines = [f"  samples           {n_rows}"]
+    for idx in sorted(peak):
+        cap = f" / {_mib(limit[idx])} MiB" if limit[idx] else ""
+        lines.append(f"  device {idx:<2}         peak {_mib(peak[idx])} MiB{cap}")
+    return lines
+
+
+def summarize_heartbeats(hb_dir: str, now: Optional[float],
+                         max_step_lag: int, max_age_s: float) -> List[str]:
+    from pytorch_distributed_tpu.obs.heartbeat import (
+        find_stragglers,
+        read_heartbeats,
+    )
+
+    beats = read_heartbeats(hb_dir)
+    if not beats:
+        return ["  (no heartbeats)"]
+    if now is None:
+        now = time.time()
+    flagged = find_stragglers(beats, now=now, max_step_lag=max_step_lag,
+                              max_age_s=max_age_s)
+    lines = []
+    for pid in sorted(beats):
+        b = beats[pid]
+        mark = f"  ** STRAGGLER: {flagged[pid]}" if pid in flagged else ""
+        lines.append(f"  process {pid:<3}       step {b['step']:<8} "
+                     f"beat age {now - b['t']:.1f}s{mark}")
+    if not flagged:
+        lines.append("  no stragglers")
+    return lines
+
+
+def report(args) -> str:
+    sections = []
+    if args.metrics_jsonl:
+        sections.append("== steps ==")
+        sections += summarize_metrics(load_metrics(args.metrics_jsonl))
+    if args.telemetry_csv:
+        sections.append("== devices ==")
+        sections += summarize_telemetry(args.telemetry_csv)
+    if args.hb_dir:
+        sections.append("== heartbeats ==")
+        sections += summarize_heartbeats(args.hb_dir, args.now,
+                                         args.max_step_lag, args.max_beat_age)
+    if not sections:
+        sections.append("nothing to report: pass --metrics-jsonl, "
+                        "--hb-dir, and/or --telemetry-csv")
+    return "\n".join(sections)
+
+
+def _selftest() -> int:
+    """Synthesize all three artifacts, run the report, assert the summary."""
+    import tempfile
+
+    from pytorch_distributed_tpu.obs import HeartbeatWriter, MetricsLogger
+
+    with tempfile.TemporaryDirectory() as d:
+        now = time.time()
+        # per-step metrics via the real logger
+        mpath = os.path.join(d, "metrics.jsonl")
+        with MetricsLogger(mpath, flush_every=7) as log:
+            for i in range(20):
+                log.log_step(i, step_time=0.01 + 0.001 * (i % 5),
+                             n_items=128, lr=0.1,
+                             scalars={"loss": 2.0 - 0.05 * i,
+                                      "grad_norm": 1.0 + 0.1 * i})
+        # heartbeats: pid 0 current, pid 1 lagging AND stale
+        hb_dir = os.path.join(d, "hb")
+        w0 = HeartbeatWriter(hb_dir, 0, interval_s=0.0)
+        w0.beat(19)
+        with open(os.path.join(hb_dir, "heartbeat-00001.jsonl"), "w") as f:
+            f.write(json.dumps({"pid": 1, "step": 3, "t": now - 120}) + "\n")
+        # telemetry CSV (statistics.sh contract)
+        tpath = os.path.join(d, "telemetry.csv")
+        with open(tpath, "w", newline="") as f:
+            wr = csv.writer(f)
+            for t in range(4):
+                for dev in range(2):
+                    wr.writerow([now + t, dev, 8 << 30,
+                                 (1 + t) << 20, (2 + t) << 20])
+
+        out = report(argparse.Namespace(
+            metrics_jsonl=mpath, hb_dir=hb_dir, telemetry_csv=tpath,
+            now=now, max_step_lag=3, max_beat_age=60.0))
+        for needle in ("== steps ==", "steps logged      20", "p95",
+                       "throughput", "loss", "grad_norm",
+                       "== devices ==", "device 0", "device 1",
+                       "== heartbeats ==", "STRAGGLER", "step lag",
+                       "beat age"):
+            assert needle in out, f"selftest: {needle!r} missing from:\n{out}"
+        # pid 0 must NOT be flagged
+        line0 = [ln for ln in out.splitlines() if "process 0" in ln]
+        assert line0 and "STRAGGLER" not in line0[0], out
+    print("obs_report selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a run's observability artifacts")
+    ap.add_argument("--metrics-jsonl", type=str, default=None,
+                    dest="metrics_jsonl")
+    ap.add_argument("--hb-dir", type=str, default=None, dest="hb_dir")
+    ap.add_argument("--telemetry-csv", type=str, default=None,
+                    dest="telemetry_csv")
+    ap.add_argument("--max-step-lag", type=int, default=3, dest="max_step_lag",
+                    help="flag processes more than N steps behind the lead")
+    ap.add_argument("--max-beat-age", type=float, default=60.0,
+                    dest="max_beat_age",
+                    help="flag processes whose newest beat is older (seconds)")
+    ap.add_argument("--now", type=float, default=None,
+                    help=argparse.SUPPRESS)  # fixed clock for tests
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthesize artifacts, run the report, verify it")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    print(report(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -187,7 +187,8 @@ _LM_WORKER = textwrap.dedent(
         t = LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
                       param_specs=specs, is_primary=ctx.is_primary,
                       checkpoint_dir=ckpt_dir, eval_dataset=eval_ds,
-                      eval_batches=2)
+                      eval_batches=2, hb_dir=ckpt_dir + "_hb",
+                      hb_interval_s=0.0)
         rows = t._local_rows(ds.batch(0, 8))
         print("ROWS", ctx.process_index, rows.shape[0],
               json.dumps(rows[:, 0].tolist()), flush=True)
@@ -418,6 +419,17 @@ def test_two_process_lm_pretrain(tmp_path, tp):
     # Exactly one rank wrote the checkpoint.
     files = sorted(p.name for p in ckpt_dir.iterdir())
     assert files.count("checkpoint.msgpack") == 1, files
+
+    # Cross-process heartbeats: both ranks beat into the shared dir, both
+    # finished at the same step, nobody flagged (obs/heartbeat.py on the
+    # LIVE multi-process mesh; straggler flagging itself is unit-tested in
+    # tests/test_obs.py).
+    from pytorch_distributed_tpu.obs import find_stragglers, read_heartbeats
+
+    beats = read_heartbeats(str(ckpt_dir) + "_hb")
+    assert set(beats) == {0, 1}
+    assert beats[0]["step"] == beats[1]["step"] == 7  # fit(8) → last step 7
+    assert find_stragglers(beats, max_step_lag=0, max_age_s=1e9) == {}
 
 
 _TP_GENERATE_WORKER = textwrap.dedent(

@@ -59,7 +59,9 @@ def test_epoch_csv_written_by_dataparallel(tmp_path, monkeypatch):
     dataparallel.main(_args(tmp_path))
     csv_path = tmp_path / "dataparallel.csv"
     assert csv_path.exists()
-    row = csv_path.read_text().strip().splitlines()[0].split(",")
+    lines = csv_path.read_text().strip().splitlines()
+    assert lines[0] == "timestamp,epoch_seconds"  # self-describing header
+    row = lines[1].split(",")
     assert len(row) == 2 and float(row[1]) > 0
 
 
