@@ -1,0 +1,19 @@
+"""shardlint: static HLO/jaxpr analysis for sharding/memory/collective
+hazards (see analysis/core.py for the detector catalogue and
+scripts/shardlint.py for the CLI).
+
+Import layering: ``hlo`` and ``report`` are pure text/dataclass modules
+(no jax import — unit-testable on string fixtures); ``jaxpr``, ``astlint``
+and ``core`` import jax lazily so that merely importing the package never
+initializes a backend."""
+
+from pytorch_distributed_tpu.analysis.report import (  # noqa: F401
+    Finding,
+    KINDS,
+    SEVERITIES,
+    StepReport,
+    diff_against_baseline,
+    load_baseline,
+    render_table,
+    save_baseline,
+)

@@ -1,0 +1,176 @@
+"""Host-sync lint: a Python-AST pass over training hot loops.
+
+The whole framework is built around lazy metric conversion — the jitted
+step returns *unready* device scalars and the meters / MetricsLogger
+convert them at display/flush cadence (train/meters.py, obs/metrics.py),
+so the hot loop never blocks on a device→host transfer.  One careless
+``float(metrics["loss"])`` inside the step loop silently reinstates the
+reference's 3-syncs-per-batch behavior (SURVEY.md §3.1a) and no test
+notices: throughput just quietly drops.
+
+This pass makes the discipline mechanical.  For each *hot* function
+(``core.HOT_LOOPS`` names the step-driving loops; planted sources can be
+linted directly), every ``for``/``while`` body is scanned for blocking
+device→host conversions:
+
+- ``float(...)`` / ``int(...)`` builtins (the ``.item()``-equivalent)
+- ``.item()`` / ``.block_until_ready()`` / ``.copy_to_host_async()`` wait
+  calls
+- ``np.asarray`` / ``np.array`` / ``numpy.asarray`` / ``numpy.array``
+- ``jax.device_get``
+
+Nested function definitions inside a loop are skipped (defining a closure
+is not a sync), and a line ending in ``# shardlint: allow-sync`` is
+exempt — the escape hatch for a loop that genuinely must sync (e.g. an
+eval loop doing exact host-side aggregation, which is a *documented*
+per-batch sync, not an accident).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from pytorch_distributed_tpu.analysis.report import Finding
+
+ALLOW_MARKER = "shardlint: allow-sync"
+
+# Builtin calls that force a device->host sync when fed a jax array.
+SYNC_BUILTINS = frozenset({"float", "int"})
+# Method calls that block on (or force) a transfer.
+SYNC_METHODS = frozenset({"item", "block_until_ready"})
+# module.attr calls: {module alias: {attr, ...}}
+SYNC_QUALIFIED: Dict[str, frozenset] = {
+    "np": frozenset({"asarray", "array"}),
+    "numpy": frozenset({"asarray", "array"}),
+    "jax": frozenset({"device_get"}),
+}
+
+
+def _sync_call_label(node: ast.Call) -> Optional[str]:
+    """A short label for a blocking call, or None if the call is benign."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in SYNC_BUILTINS:
+        return f"{fn.id}()"
+    if isinstance(fn, ast.Attribute):
+        if isinstance(fn.value, ast.Name) and fn.attr in SYNC_QUALIFIED.get(
+                fn.value.id, ()):
+            return f"{fn.value.id}.{fn.attr}()"
+        if fn.attr in SYNC_METHODS:
+            return f".{fn.attr}()"
+    return None
+
+
+class _LoopScanner(ast.NodeVisitor):
+    """Collects sync calls inside loop bodies, skipping nested defs."""
+
+    def __init__(self, lines: Sequence[str]):
+        self.lines = lines
+        self.hits: List[tuple] = []  # (ast.Call, label)
+        self._loop_depth = 0
+
+    def _allowed(self, node: ast.AST) -> bool:
+        i = getattr(node, "lineno", 0) - 1
+        return 0 <= i < len(self.lines) and ALLOW_MARKER in self.lines[i]
+
+    def visit_For(self, node):  # noqa: N802 (ast API)
+        self._loop_body(node)
+
+    visit_AsyncFor = visit_For  # noqa: N815
+
+    def visit_While(self, node):  # noqa: N802
+        self._loop_body(node)
+
+    def _loop_body(self, node) -> None:
+        self._loop_depth += 1
+        for child in node.body:
+            self.visit(child)
+        self._loop_depth -= 1
+        for child in node.orelse:
+            self.visit(child)
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        # A def inside a hot loop only *defines*; don't descend.
+        if self._loop_depth == 0:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # noqa: N815
+    visit_Lambda = visit_FunctionDef  # noqa: N815
+
+    def visit_Call(self, node):  # noqa: N802
+        if self._loop_depth > 0 and not self._allowed(node):
+            label = _sync_call_label(node)
+            if label is not None:
+                self.hits.append((node, label))
+        self.generic_visit(node)
+
+
+class _HotFunctionFinder(ast.NodeVisitor):
+    """Maps qualified names (``Class.method`` / ``fn``) to their defs."""
+
+    def __init__(self):
+        self.defs: Dict[str, ast.AST] = {}
+        self._stack: List[str] = []
+
+    def _enter(self, node):
+        self._stack.append(node.name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.defs[".".join(self._stack)] = node
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _enter  # noqa: N815
+    visit_AsyncFunctionDef = _enter  # noqa: N815
+    visit_ClassDef = _enter  # noqa: N815
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    hot_functions: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint ``source``; returns host-sync findings.
+
+    ``hot_functions``: qualified names (``LMTrainer.fit``) whose loop
+    bodies are in scope.  ``None`` means every function in the source is
+    treated as hot — the mode tests and ``--selftest`` use on planted
+    sources."""
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    finder = _HotFunctionFinder()
+    finder.visit(tree)
+    if hot_functions is None:
+        targets: Dict[str, ast.AST] = dict(finder.defs)
+    else:
+        targets = {}
+        missing: Set[str] = set()
+        for name in hot_functions:
+            if name in finder.defs:
+                targets[name] = finder.defs[name]
+            else:
+                missing.add(name)
+        if missing:
+            raise ValueError(
+                f"hot functions {sorted(missing)} not found in {path}; "
+                "update core.HOT_LOOPS after renames")
+    findings: List[Finding] = []
+    for qualname, node in sorted(targets.items()):
+        scanner = _LoopScanner(lines)
+        for stmt in getattr(node, "body", []):
+            scanner.visit(stmt)
+        for call, label in scanner.hits:
+            findings.append(Finding(
+                kind="host-sync",
+                severity="error",
+                where=f"{path}:{call.lineno}",
+                message=(f"blocking {label} inside the {qualname} hot loop "
+                         "— convert lazily (meters/MetricsLogger) or mark "
+                         f"'# {ALLOW_MARKER}' if the sync is deliberate"),
+            ))
+    return findings
+
+
+def lint_file(path: str,
+              hot_functions: Optional[Iterable[str]] = None) -> List[Finding]:
+    with open(path) as f:
+        return lint_source(f.read(), path=path, hot_functions=hot_functions)

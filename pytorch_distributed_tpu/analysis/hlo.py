@@ -1,0 +1,295 @@
+"""Compiled-HLO text parsing: the shared matcher layer under shardlint.
+
+XLA's post-optimization module (``jitted.lower(...).compile().as_text()``)
+is the ground truth for what actually runs per device: shapes there are
+*per-device* (post-SPMD-partitioning) shapes, collectives are explicit
+``all-reduce``/``all-gather``/... instructions, and buffer donation shows
+up (or silently doesn't) in the module header's ``input_output_alias`` map.
+PR 1 found the replicated ``[V, D]`` dE accumulator by hand-grepping this
+text; these helpers turn that grep into reusable structure shared by
+``analysis/core.py`` and ``scripts/hlo_dy_check.py``.
+
+Nothing here imports jax — it is pure text parsing, unit-testable on
+string fixtures without compiling anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+Shape = Tuple[str, Tuple[int, ...]]  # (dtype, dims)
+
+DTYPE_BYTES: Dict[str, int] = {
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(
+    r"\b(pred|bf16|f16|f32|f64|s4|s8|s16|s32|s64|u4|u8|u16|u32|u64|c64|c128)"
+    r"\[([0-9,]*)\]"
+)
+
+# `%name = <type> opcode(...)` — the type may be a tuple; the opcode is the
+# first bare word after the (possibly layout-annotated) result type.
+_INSTR_RE = re.compile(
+    r"^\s*(?P<root>ROOT\s+)?%?(?P<name>[\w.\-]+)\s+=\s+(?P<rhs>.+)$")
+_OPCODE_RE = re.compile(r"(?P<opcode>[a-z][a-z0-9\-]*)\(")
+_COMPUTATION_RE = re.compile(r"^(?:ENTRY\s+)?%?(?P<name>[\w.\-]+)\s+\(.*\{\s*$")
+
+# Collectives counted toward the per-step budget.  Async pairs count once
+# (the -start op carries the payload; -done is bookkeeping).
+COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute", "collective-broadcast",
+)
+_COLLECTIVE_SET = frozenset(COLLECTIVE_OPS) | frozenset(
+    op + "-start" for op in COLLECTIVE_OPS)
+
+
+def shape_bytes(shape: Shape) -> int:
+    dtype, dims = shape
+    n = DTYPE_BYTES.get(dtype, 4)
+    for d in dims:
+        n *= d
+    return n
+
+
+def iter_shapes(fragment: str) -> Iterator[Shape]:
+    """All ``dtype[d0,d1,...]`` tokens in an HLO text fragment, in order."""
+    for m in _SHAPE_RE.finditer(fragment):
+        dims = tuple(int(d) for d in m.group(2).split(",")) \
+            if m.group(2) else ()
+        yield (m.group(1), dims)
+
+
+@dataclasses.dataclass
+class Instruction:
+    """One parsed HLO instruction (output side only)."""
+
+    name: str
+    opcode: str
+    shapes: List[Shape]        # result shapes (tuple types contribute all)
+    computation: str
+    line: str
+    is_root: bool = False
+
+    def result_bytes(self) -> int:
+        return sum(shape_bytes(s) for s in self.shapes)
+
+
+def _result_type_and_opcode(rhs: str) -> Optional[Tuple[str, str]]:
+    """Split an instruction's RHS into (result-type text, opcode)."""
+    if rhs.startswith("("):
+        # tuple type: find the matching close paren
+        depth = 0
+        for i, ch in enumerate(rhs):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    m = _OPCODE_RE.search(rhs, i + 1)
+                    return (rhs[:i + 1], m.group("opcode")) if m else None
+        return None
+    m = _SHAPE_RE.match(rhs)
+    if not m:
+        return None
+    # skip a layout annotation like {1,0} or {1,0:T(8,128)}
+    rest = rhs[m.end():]
+    if rest.startswith("{"):
+        close = rest.find("}")
+        rest = rest[close + 1:] if close >= 0 else rest
+    om = _OPCODE_RE.match(rest.lstrip())
+    if om is None:
+        return None
+    return rhs[:m.end()], om.group("opcode")
+
+
+def parse_instructions(hlo_text: str) -> List[Instruction]:
+    """Parse every ``%x = type op(...)`` line across all computations."""
+    instrs: List[Instruction] = []
+    computation = ""
+    for raw in hlo_text.splitlines():
+        comp = _COMPUTATION_RE.match(raw)
+        if comp is not None and "=" not in raw.split("(")[0]:
+            computation = comp.group("name")
+            continue
+        m = _INSTR_RE.match(raw)
+        if m is None or "(" not in m.group("rhs"):
+            continue
+        split = _result_type_and_opcode(m.group("rhs"))
+        if split is None:
+            continue
+        type_text, opcode = split
+        instrs.append(Instruction(
+            name=m.group("name"),
+            opcode=opcode,
+            shapes=list(iter_shapes(type_text)),
+            computation=computation,
+            line=raw.strip(),
+            is_root=bool(m.group("root")),
+        ))
+    return instrs
+
+
+def collect_collectives(
+    instrs: Iterable[Instruction],
+) -> Dict[str, Dict[str, int]]:
+    """Per-collective-kind ``{"count", "bytes"}`` (per-device payload)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for ins in instrs:
+        if ins.opcode not in _COLLECTIVE_SET:
+            continue
+        kind = ins.opcode[:-len("-start")] \
+            if ins.opcode.endswith("-start") else ins.opcode
+        slot = out.setdefault(kind, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += ins.result_bytes()
+    return out
+
+
+# ------------------------------------------------------------ module header
+
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}\s*[,)]")
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9,\s]*)\}:\s*\((\d+),\s*\{([0-9,\s]*)\}")
+
+
+def parse_input_output_alias(
+    hlo_text: str,
+) -> List[Tuple[Tuple[int, ...], int, Tuple[int, ...]]]:
+    """The header's donation map as ``(output_path, param_num,
+    param_path)`` triples; empty when nothing aliases."""
+    header = hlo_text.split("\n", 1)[0]
+    # the alias map nests braces: grab from `input_output_alias={` to the
+    # matching close by scanning (entries themselves contain `{}`).
+    key = "input_output_alias={"
+    start = header.find(key)
+    if start < 0:
+        return []
+    depth, i = 1, start + len(key)
+    while i < len(header) and depth:
+        if header[i] == "{":
+            depth += 1
+        elif header[i] == "}":
+            depth -= 1
+        i += 1
+    block = header[start + len(key):i - 1]
+
+    def path(text: str) -> Tuple[int, ...]:
+        text = text.strip()
+        return tuple(int(t) for t in text.split(",")) if text else ()
+
+    return [
+        (path(m.group(1)), int(m.group(2)), path(m.group(3)))
+        for m in _ALIAS_ENTRY_RE.finditer(block)
+    ]
+
+
+def aliased_param_numbers(hlo_text: str) -> List[int]:
+    """Entry-parameter numbers that donate their buffer to an output."""
+    return sorted({p for _, p, _ in parse_input_output_alias(hlo_text)})
+
+
+def _entry_layout_parts(hlo_text: str) -> Optional[Tuple[str, str]]:
+    """``(params_text, outputs_text)`` of the header's
+    ``entry_computation_layout={(...)->...}``, split at the top-level
+    ``->`` with balanced brace/paren scanning (layout annotations like
+    ``{1,0:T(8,128)}`` nest both delimiters)."""
+    header = hlo_text.split("\n", 1)[0]
+    key = "entry_computation_layout={"
+    start = header.find(key)
+    if start < 0:
+        return None
+    depth, i = 1, start + len(key)
+    while i < len(header) and depth:
+        if header[i] in "{(":
+            depth += 1
+        elif header[i] in "})":
+            depth -= 1
+        i += 1
+    block = header[start + len(key):i - 1]
+    depth = 0
+    for j in range(len(block) - 1):
+        if block[j] in "{(":
+            depth += 1
+        elif block[j] in "})":
+            depth -= 1
+        elif block[j:j + 2] == "->" and depth == 0:
+            return block[:j], block[j + 2:]
+    return None
+
+
+def entry_parameter_shapes(hlo_text: str) -> List[Shape]:
+    """Per-device entry parameter shapes, in parameter-number order, from
+    the header's ``entry_computation_layout={(...)->...}``."""
+    parts = _entry_layout_parts(hlo_text)
+    return list(iter_shapes(parts[0])) if parts else []
+
+
+def entry_output_shapes(hlo_text: str) -> List[Shape]:
+    """Per-device entry *output* shapes from the header layout — the other
+    half of the donation-opportunity question (an un-donated large input
+    only matters if a shape-compatible output exists to alias it to)."""
+    parts = _entry_layout_parts(hlo_text)
+    return list(iter_shapes(parts[1])) if parts else []
+
+
+# ------------------------------------------------- materialization matchers
+
+def find_materializations(
+    hlo_text: str,
+    dtype: str,
+    dims: Sequence[int],
+    opcodes: Sequence[str] = ("fusion",),
+    exclude_root: bool = True,
+) -> List[Instruction]:
+    """Instructions producing a buffer of exactly ``dtype[dims]``.
+
+    The question scripts/hlo_dy_check.py asks: does XLA *materialize* a
+    given intermediate (a fusion writes a buffer of that shape to memory)
+    or keep it fused into its consumers?  ``opcodes=None`` matches any
+    producer opcode except ``parameter``."""
+    want: Shape = (dtype, tuple(int(d) for d in dims))
+    out = []
+    for ins in parse_instructions(hlo_text):
+        if exclude_root and ins.is_root:
+            continue
+        if opcodes is not None and ins.opcode not in opcodes:
+            continue
+        if opcodes is None and ins.opcode == "parameter":
+            continue
+        if want in ins.shapes:
+            out.append(ins)
+    return out
+
+
+def count_custom_call_convolutions(hlo_text: str) -> int:
+    """Convolutions lowered to backend custom-calls (the CPU/TPU library
+    path) — the denominator hlo_dy_check reports its fusion count against."""
+    n = 0
+    for line in hlo_text.splitlines():
+        if "custom-call" in line and "convolution" in line.lower():
+            n += 1
+        elif "kind=kCustom" in line and "convolution" in line:
+            n += 1
+    return n
+
+
+def nonparameter_shape_index(
+    instrs: Iterable[Instruction],
+) -> Dict[Shape, Instruction]:
+    """First non-``parameter`` producer of each result shape — the lookup
+    the replicated-tensor detector probes with global jaxpr shapes."""
+    index: Dict[Shape, Instruction] = {}
+    for ins in instrs:
+        if ins.opcode == "parameter":
+            continue
+        for s in ins.shapes:
+            index.setdefault(s, ins)
+    return index

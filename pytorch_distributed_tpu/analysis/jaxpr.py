@@ -1,0 +1,185 @@
+"""Jaxpr walking: global (pre-partitioning) shapes for the analyzer.
+
+The compiled HLO (analysis/hlo.py) only shows *per-device* shapes; the
+jaxpr is where the global view lives — every equation's output aval is a
+global logical shape.  The replicated-tensor detector cross-references the
+two: a global-shaped intermediate that shows up at FULL size in the
+per-device module is materialized on every device (replicated, or
+all-gathered) rather than sharded.
+
+``shard_map`` bodies are excluded from the global-shape set: their avals
+are already per-shard, so matching them against per-device HLO shapes
+would flag perfectly sharded values (the explicit-collectives step, the
+pipeline schedules).  Detectors that need them (dtype promotions) still
+recurse inside with the ``local`` flag.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+Shape = Tuple[str, Tuple[int, ...]]  # (HLO dtype name, dims)
+
+# numpy/jax dtype name -> HLO shape-token dtype name
+_DTYPE_TO_HLO = {
+    "bool": "pred", "int4": "s4", "uint4": "u4",
+    "int8": "s8", "int16": "s16", "int32": "s32", "int64": "s64",
+    "uint8": "u8", "uint16": "u16", "uint32": "u32", "uint64": "u64",
+    "bfloat16": "bf16", "float16": "f16", "float32": "f32",
+    "float64": "f64", "complex64": "c64", "complex128": "c128",
+}
+
+_LOCAL_PRIMITIVES = ("shard_map",)
+
+
+def hlo_dtype(dtype) -> str:
+    return _DTYPE_TO_HLO.get(np.dtype(dtype).name, np.dtype(dtype).name)
+
+
+def aval_shape(aval) -> Optional[Shape]:
+    """(hlo dtype, dims) for a ShapedArray-like aval; None for abstract
+    tokens/etc. that carry no shape."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    try:
+        return (hlo_dtype(dtype), tuple(int(d) for d in shape))
+    except TypeError:  # symbolic dims — out of scope
+        return None
+
+
+def aval_bytes(aval) -> int:
+    s = aval_shape(aval)
+    if s is None:
+        return 0
+    from pytorch_distributed_tpu.analysis.hlo import shape_bytes
+
+    return shape_bytes(s)
+
+
+def _sub_jaxprs(eqn) -> List:
+    subs = []
+    for v in eqn.params.values():
+        items = v if isinstance(v, (list, tuple)) else [v]
+        for item in items:
+            if hasattr(item, "eqns"):           # plain Jaxpr
+                subs.append(item)
+            elif hasattr(item, "jaxpr") and hasattr(
+                    getattr(item, "jaxpr"), "eqns"):  # ClosedJaxpr
+                subs.append(item.jaxpr)
+    return subs
+
+
+def iter_eqns(jaxpr, local: bool = False) -> Iterator[Tuple[object, bool]]:
+    """Depth-first ``(eqn, is_shard_map_local)`` over a jaxpr and every
+    sub-jaxpr (pjit/scan/while/cond/custom-vjp/remat bodies)."""
+    jx = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
+    for eqn in jx.eqns:
+        yield eqn, local
+        sub_local = local or eqn.primitive.name in _LOCAL_PRIMITIVES
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_eqns(sub, sub_local)
+
+
+def source_summary(eqn) -> str:
+    """``file:line (fn)`` for an equation, best-effort."""
+    try:
+        from jax._src import source_info_util
+
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:
+        return "<unknown>"
+
+
+def loop_carry_shapes(jaxpr) -> Dict[Shape, Dict[str, object]]:
+    """Shapes carried through a ``scan``/``while`` OUTSIDE any shard_map.
+
+    A large *replicated* loop carry is the PR-1 hazard class in its exact
+    form — an accumulator (the fused-CE ``[V, D]`` dE sums) rebuilt on every
+    device every iteration — and is distinguishable from the one-shot
+    param-shaped intermediates of the declared pure-DP layout (grads,
+    updated params), which match entry-parameter shapes and only rate an
+    info finding.  Maps carry shape -> {"primitive", "source"}."""
+    carries: Dict[Shape, Dict[str, object]] = {}
+    for eqn, local in iter_eqns(jaxpr):
+        if local:
+            continue
+        name = eqn.primitive.name
+        if name == "scan":
+            n_carry = int(eqn.params.get("num_carry", 0))
+            carry_vars = eqn.outvars[:n_carry]
+        elif name == "while":
+            carry_vars = eqn.outvars
+        else:
+            continue
+        for var in carry_vars:
+            s = aval_shape(getattr(var, "aval", None))
+            if s is None or s in carries:
+                continue
+            carries[s] = {
+                "primitive": name,
+                "source": source_summary(eqn),
+            }
+    return carries
+
+
+def global_intermediate_shapes(
+    jaxpr, min_bytes: int = 0,
+) -> Dict[Shape, Dict[str, object]]:
+    """Global-logical-shape index of every intermediate ≥ ``min_bytes``.
+
+    Maps (dtype, dims) -> {"bytes", "primitive", "source"} for the first
+    equation producing that shape outside any shard_map body.  Input avals
+    (constvars/invars) are not included — entry parameters are excluded on
+    the HLO side by opcode instead."""
+    from pytorch_distributed_tpu.analysis.hlo import shape_bytes
+
+    index: Dict[Shape, Dict[str, object]] = {}
+    for eqn, local in iter_eqns(jaxpr):
+        if local:
+            continue
+        for var in eqn.outvars:
+            s = aval_shape(getattr(var, "aval", None))
+            if s is None:
+                continue
+            n = shape_bytes(s)
+            if n < min_bytes or s in index:
+                continue
+            index[s] = {
+                "bytes": n,
+                "primitive": eqn.primitive.name,
+                "source": source_summary(eqn),
+            }
+    return index
+
+
+def find_dtype_promotions(jaxpr, min_bytes: int) -> List[Dict[str, object]]:
+    """Large low-precision→f32/f64 ``convert_element_type`` equations.
+
+    Matmul f32 accumulation via ``preferred_element_type`` does NOT appear
+    here (it is not a convert); this catches materialized upcasts — the
+    backward-pass f32 copies of big bf16 activations that double their
+    footprint."""
+    out: List[Dict[str, object]] = []
+    for eqn, _ in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        in_aval = getattr(eqn.invars[0], "aval", None)
+        out_aval = getattr(eqn.outvars[0], "aval", None)
+        src = aval_shape(in_aval)
+        dst = aval_shape(out_aval)
+        if src is None or dst is None:
+            continue
+        if src[0] not in ("bf16", "f16") or dst[0] not in ("f32", "f64"):
+            continue
+        n = aval_bytes(out_aval)
+        if n < min_bytes:
+            continue
+        out.append({
+            "shape": dst[1], "from": src[0], "to": dst[0], "bytes": n,
+            "source": source_summary(eqn),
+        })
+    return out

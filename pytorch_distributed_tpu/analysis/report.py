@@ -1,0 +1,187 @@
+"""Typed findings and per-step reports for the shardlint static analyzer.
+
+The analyzer (analysis/core.py) walks a jitted step's jaxpr and compiled
+HLO and emits ``Finding`` records in a small closed vocabulary of hazard
+kinds, so CI can gate on severity instead of grepping HLO text per PR:
+
+- ``replicated-large-tensor`` (error) — an intermediate materialized at its
+  full global size on every device of a >1-device mesh (the PR-1 fused-CE
+  ``[V, D]`` dE accumulator class; arxiv 2004.13336's silent-DP-waste).
+- ``replicated-state`` (info) — a train-state-shaped value updated at full
+  size per device: the *declared* pure-DP layout, flagged as the standing
+  FSDP opportunity rather than a regression.
+- ``lost-donation`` (error) — ``donate_argnums`` was passed but XLA's
+  ``input_output_alias`` map covers fewer donated leaves than expected
+  (shape/dtype/sharding mismatch silently drops the alias).
+- ``no-donation`` (warn) — a step that threads train state through without
+  donating it at all.
+- ``dtype-promotion`` (warn) — a large bf16/f16 intermediate upcast to f32
+  (``convert_element_type`` in the jaxpr, global shape ≥ threshold).
+- ``collective-regression`` (error) — per-step collective count/bytes above
+  the checked-in baseline (EQuARX-style collective-bytes budget).
+- ``host-sync`` (error) — a blocking device→host conversion inside a train
+  hot loop (analysis/astlint.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warn", "info")
+
+KINDS = (
+    "replicated-large-tensor",
+    "replicated-state",
+    "lost-donation",
+    "no-donation",
+    "dtype-promotion",
+    "collective-regression",
+    "host-sync",
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One typed hazard. ``where`` is a recipe/step name or ``file:line``."""
+
+    kind: str
+    severity: str
+    where: str
+    message: str
+    bytes: int = 0
+    shape: Tuple[int, ...] = ()
+    dtype: str = ""
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown finding kind {self.kind!r}")
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["shape"] = list(self.shape)
+        return d
+
+    def __str__(self) -> str:
+        loc = f" {self.dtype}{list(self.shape)}" if self.shape else ""
+        size = f" ({self.bytes / 2**20:.2f} MiB)" if self.bytes else ""
+        return (f"[{self.severity}] {self.kind} @ {self.where}:{loc}{size} "
+                f"{self.message}")
+
+
+@dataclasses.dataclass
+class StepReport:
+    """Everything the analyzer learned about one jitted step."""
+
+    name: str
+    mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    # per collective opcode: {"count": n, "bytes": per-device payload bytes}
+    collectives: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+    # compiled per-device sizes from XLA's memory analysis (0 if unavailable)
+    memory: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # donation accounting: requested/expected/aliased leaf counts + bytes
+    donation: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def by_kind(self, kind: str) -> List[Finding]:
+        return [f for f in self.findings if f.kind == kind]
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "mesh_shape": dict(self.mesh_shape),
+            "findings": [f.to_dict() for f in self.findings],
+            "collectives": self.collectives,
+            "memory": self.memory,
+            "donation": self.donation,
+        }
+
+
+# --------------------------------------------------------------- baselines
+
+def baseline_entry(report: StepReport) -> Dict[str, Any]:
+    """The part of a report that is pinned against CI: the collective
+    budget.  Findings are gated directly by severity, not baselined."""
+    return {"collectives": {
+        k: {"count": v["count"], "bytes": v["bytes"]}
+        for k, v in sorted(report.collectives.items())
+    }}
+
+
+def diff_against_baseline(report: StepReport,
+                          entry: Optional[Dict[str, Any]]) -> List[Finding]:
+    """Compare a report's collective budget with its baseline entry.
+
+    Regressions (more ops, or more per-device payload bytes, of any
+    collective kind — including kinds the baseline never saw) are
+    error-severity ``collective-regression`` findings; improvements come
+    back as info so the operator knows the baseline is stale."""
+    if entry is None:
+        return [Finding(
+            kind="collective-regression", severity="warn", where=report.name,
+            message="no baseline entry for this step; run "
+                    "scripts/shardlint.py --update-baseline to pin it",
+        )]
+    findings: List[Finding] = []
+    base = entry.get("collectives", {})
+    kinds = sorted(set(base) | set(report.collectives))
+    for kind in kinds:
+        now = report.collectives.get(kind, {"count": 0, "bytes": 0})
+        ref = base.get(kind, {"count": 0, "bytes": 0})
+        if now["count"] > ref["count"] or now["bytes"] > ref["bytes"]:
+            findings.append(Finding(
+                kind="collective-regression", severity="error",
+                where=f"{report.name}:{kind}",
+                bytes=now["bytes"] - ref["bytes"],
+                message=(f"{kind} budget exceeded: {now['count']} ops / "
+                         f"{now['bytes']} B vs baseline {ref['count']} ops / "
+                         f"{ref['bytes']} B"),
+            ))
+        elif now["count"] < ref["count"] or now["bytes"] < ref["bytes"]:
+            findings.append(Finding(
+                kind="collective-regression", severity="info",
+                where=f"{report.name}:{kind}",
+                message=(f"{kind} below baseline ({now['count']} ops / "
+                         f"{now['bytes']} B vs {ref['count']} / "
+                         f"{ref['bytes']}): refresh with --update-baseline"),
+            ))
+    return findings
+
+
+def load_baseline(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_baseline(path: str, reports: Sequence[StepReport]) -> None:
+    data = {r.name: baseline_entry(r) for r in reports}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def render_table(reports: Sequence[StepReport]) -> str:
+    """Human summary: one row per step + its findings underneath."""
+    lines = []
+    for r in reports:
+        coll = ", ".join(
+            f"{k}×{v['count']}" for k, v in sorted(r.collectives.items())
+        ) or "none"
+        errs = len(r.errors())
+        lines.append(
+            f"{r.name:<24} mesh={r.mesh_shape or '{}'} "
+            f"collectives: {coll}  findings: {len(r.findings)} "
+            f"({errs} errors)")
+        for f in r.findings:
+            lines.append(f"    {f}")
+    return "\n".join(lines)

@@ -63,6 +63,12 @@ def _make_block_apply(L: int, B: int, max_len: int, vocab_size: int,
         return jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
 
+    # NOT donated, deliberately (shardlint donation audit): the cache is
+    # dead after every call, but XLA dedups identical executable outputs
+    # into one buffer — every layer's equal cache_index scalar comes back
+    # aliased — so donate_argnums=(1,) on the returned tree trips PJRT's
+    # "attempt to donate the same buffer twice" at the next call.  The k/v
+    # double-buffer is the price of the shared-buffer layout.
     @jax.jit
     def apply(params, cache, tokens):
         logits, mut = model.apply(

@@ -1,15 +1,34 @@
 """Does XLA materialize dy (the BN-backward conv-output gradient) to HBM in
-the unfused ResNet step, or fuse it into the dgrad/wgrad consumers?"""
-import jax, jax.numpy as jnp, re
-import numpy as np
+the unfused ResNet step, or fuse it into the dgrad/wgrad consumers?
+
+Rewritten on the shardlint matcher layer (analysis/hlo.py): the private
+regexes became ``find_materializations`` (fusions producing a buffer of
+exactly dy's shape) and ``count_custom_call_convolutions`` — the same
+helpers the analyzer's detectors use, so this one-off question and the CI
+fence share one parsing path.  Output contract unchanged: prints the
+counts and writes the full module to runs/hlo_unfused_bwd.txt.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_tpu.analysis.hlo import (  # noqa: E402
+    count_custom_call_convolutions,
+    find_materializations,
+)
 
 B, H, Ci, Co = 256, 56, 64, 64
 dtype = jnp.bfloat16
-s = jnp.ones((Co,), jnp.float32); t = jnp.full((Co,), .1, jnp.float32)
-u = jnp.zeros((Co,), jnp.float32); v = jnp.zeros((Co,), jnp.float32)
+s = jnp.ones((Co,), jnp.float32); t = jnp.full((Co,), .1, jnp.float32)  # noqa: E702
+u = jnp.zeros((Co,), jnp.float32); v = jnp.zeros((Co,), jnp.float32)  # noqa: E702
+
 
 def unfused(y, do, a, w):
-    yf = y.astype(jnp.float32); dof = do.astype(jnp.float32)
+    yf = y.astype(jnp.float32); dof = do.astype(jnp.float32)  # noqa: E702
     dof = jnp.where(yf * s + v > 0, dof, 0.0)
     dy = (dof * s + yf * t + u).astype(dtype)
     da = jax.lax.conv_general_dilated(
@@ -22,17 +41,17 @@ def unfused(y, do, a, w):
         preferred_element_type=jnp.float32)
     return da.astype(jnp.float32).sum() + dw.sum()
 
-y = jnp.ones((B, H, H, Co), dtype); do = jnp.ones((B, H, H, Co), dtype)
-a = jnp.ones((B, H, H, Ci), dtype); w = jnp.ones((3, 3, Ci, Co), jnp.float32)
+
+y = jnp.ones((B, H, H, Co), dtype); do = jnp.ones((B, H, H, Co), dtype)  # noqa: E702
+a = jnp.ones((B, H, H, Ci), dtype); w = jnp.ones((3, 3, Ci, Co), jnp.float32)  # noqa: E702
 txt = jax.jit(unfused).lower(y, do, a, w).compile().as_text()
 # count fusions producing a [B,H,H,Co]-shaped bf16 output (a materialized dy)
-# vs convolution fusions with elementwise producers inside
-convs = re.findall(r"kind=kCustom.*convolution", txt)
-fus = [l for l in txt.splitlines() if "fusion" in l and "bf16[256,56,56,64]" in l and "ROOT" not in l]
-print("convolution custom-calls:", len(convs))
-print("lines w/ fusion producing bf16[256,56,56,64]:")
-for l in fus[:12]: print("  ", l.strip()[:160])
-import os
+# vs convolution custom-calls with elementwise producers fused inside
+fus = find_materializations(txt, "bf16", (B, H, H, Co), opcodes=("fusion",))
+print("convolution custom-calls:", count_custom_call_convolutions(txt))
+print("lines w/ fusion producing bf16[%d,%d,%d,%d]:" % (B, H, H, Co))
+for ins in fus[:12]:
+    print("  ", ins.line[:160])
 os.makedirs("runs", exist_ok=True)
-open("runs/hlo_unfused_bwd.txt","w").write(txt)
+open("runs/hlo_unfused_bwd.txt", "w").write(txt)
 print("total HLO lines:", len(txt.splitlines()))

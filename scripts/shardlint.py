@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""shardlint: static analysis of every jitted step on a CPU mesh.
+
+Lowers each recipe's step builder (image train/eval, LM train/eval, every
+fused-CE mode, all three pipeline schedules, greedy decode) on a simulated
+4-way CPU mesh and walks the jaxpr + compiled HLO for:
+
+- replicated-large-tensor  full-global-size intermediates on >1-device
+                           meshes (loop carries = the PR-1 [V,D] dE class)
+- replicated-state         param-shaped per-device updates (declared DP
+                           layout; the standing FSDP opportunity) [info]
+- lost-donation            donate_argnums leaves XLA silently didn't alias
+- no-donation              never-donating steps with alias opportunities
+- dtype-promotion          large bf16/f16 -> f32 materialized upcasts
+- collective-regression    per-step collective count/bytes vs the
+                           checked-in analysis/baseline.json budget
+- host-sync                blocking float()/np.asarray/.block_until_ready()
+                           inside registered training hot loops (AST pass)
+
+Exit status 1 when any error-severity finding survives.
+
+Usage:
+  python scripts/shardlint.py                    # full sweep + baseline diff
+  python scripts/shardlint.py --steps lm_train_dp,lm_fused_ce_dp
+  python scripts/shardlint.py --json report.json # machine-readable output
+  python scripts/shardlint.py --update-baseline  # pin current collective
+                                                 # budgets as the new fence
+  python scripts/shardlint.py --selftest         # planted-hazard checks
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# Must precede the first jax import: the analyzer needs >= 4 simulated
+# devices (mirrors tests/conftest.py so baselines match the test sweep).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+from pytorch_distributed_tpu.analysis import (  # noqa: E402
+    diff_against_baseline,
+    load_baseline,
+    render_table,
+    save_baseline,
+)
+from pytorch_distributed_tpu.analysis import core  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--steps", default=None,
+                    help="comma-separated subset of steps (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list known step names and exit")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full reports as JSON")
+    ap.add_argument("--baseline", default=core.baseline_path(),
+                    help="collective-budget baseline to diff against "
+                         "(default: the checked-in analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the collective-budget diff")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current collective budgets to --baseline "
+                         "instead of diffing (run after a reviewed change "
+                         "that intentionally alters the budget)")
+    ap.add_argument("--min-replicated-bytes", type=int,
+                    default=core.DEFAULT_MIN_REPLICATED_BYTES)
+    ap.add_argument("--min-promotion-bytes", type=int,
+                    default=core.DEFAULT_MIN_PROMOTION_BYTES)
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the planted-hazard detector checks and exit")
+    args = ap.parse_args()
+
+    if args.list:
+        for name in core.RECIPES:
+            print(name)
+        print("hot-loops")
+        return 0
+
+    if args.selftest:
+        summary = core.selftest(verbose=True)
+        print(f"shardlint selftest OK: {summary}")
+        return 0
+
+    names = args.steps.split(",") if args.steps else None
+    reports = core.analyze_all(
+        names,
+        min_replicated_bytes=args.min_replicated_bytes,
+        min_promotion_bytes=args.min_promotion_bytes,
+    )
+
+    if args.update_baseline:
+        # The hot-loop lint and single-device decode have no collective
+        # budget to pin; baseline covers mesh'd steps only.
+        save_baseline(args.baseline,
+                      [r for r in reports if r.mesh_shape])
+        print(f"wrote collective-budget baseline for "
+              f"{sum(1 for r in reports if r.mesh_shape)} steps to "
+              f"{args.baseline}")
+    elif not args.no_baseline:
+        baseline = (load_baseline(args.baseline)
+                    if os.path.exists(args.baseline) else {})
+        if not baseline:
+            print(f"note: no baseline at {args.baseline}; run "
+                  "--update-baseline to pin collective budgets")
+        for r in reports:
+            if not r.mesh_shape:
+                continue
+            for f in diff_against_baseline(r, baseline.get(r.name)):
+                r.add(f)
+
+    print(render_table(reports))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump([r.to_dict() for r in reports], f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.json}")
+
+    n_err = sum(len(r.errors()) for r in reports)
+    if n_err:
+        print(f"shardlint: {n_err} error finding(s)", file=sys.stderr)
+        return 1
+    print("shardlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

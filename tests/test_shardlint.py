@@ -1,0 +1,288 @@
+"""shardlint (pytorch_distributed_tpu/analysis/): every detector proven
+against planted hazards, every fenced-good path proven clean.
+
+Layers under test:
+- pure text parsing (analysis/hlo.py) on a hand-written HLO fixture — no
+  compilation involved;
+- the AST host-sync lint (analysis/astlint.py) on planted sources;
+- report/baseline plumbing (analysis/report.py);
+- the live analyzer (analysis/core.py) on the 4-way CPU mesh: the
+  synthetic bad step must trip all planted hazards, the fused-CE dp/tp
+  modes must show zero replicated-[V,D] findings while the replicated
+  mode is flagged (the PR-1 regression fence), and the full recipe sweep
+  must stay clean against the checked-in collective-budget baseline.
+"""
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_tpu.analysis import (
+    Finding,
+    StepReport,
+    diff_against_baseline,
+    load_baseline,
+)
+from pytorch_distributed_tpu.analysis import astlint, core
+from pytorch_distributed_tpu.analysis import hlo as hlo_mod
+from pytorch_distributed_tpu.analysis.report import baseline_entry
+
+# A miniature post-optimization module exercising every parsed construct:
+# donation aliases, entry layout with tiled-layout annotations, an async
+# collective pair, a tuple-typed instruction, and a non-entry computation.
+HLO_FIXTURE = """\
+HloModule test, input_output_alias={ {0}: (0, {}, MAY_ALIAS), {1}: (2, {}, MUST_ALIAS) }, entry_computation_layout={(f32[64,32]{1,0}, s32[]{:T(256)}, f32[64,32]{1,0})->(f32[64,32]{1,0}, f32[]{:T(256)})}
+
+%add_comp (x: f32[], y: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  %y = f32[] parameter(1)
+  ROOT %add.1 = f32[] add(f32[] %x, f32[] %y)
+}
+
+ENTRY %main (p0: f32[64,32], p1: s32[], p2: f32[64,32]) -> (f32[64,32], f32[]) {
+  %p0 = f32[64,32]{1,0} parameter(0)
+  %p2 = f32[64,32]{1,0} parameter(2)
+  %mul = f32[64,32]{1,0} multiply(f32[64,32]{1,0} %p0, f32[64,32]{1,0} %p2)
+  %ar-start = f32[64,32]{1,0} all-reduce-start(f32[64,32]{1,0} %mul), replica_groups=[1,4]<=[4], to_apply=%add_comp
+  %ar-done = f32[64,32]{1,0} all-reduce-done(f32[64,32]{1,0} %ar-start)
+  %fus = f32[64,32]{1,0} fusion(f32[64,32]{1,0} %ar-done), kind=kLoop, calls=%add_comp
+  %ag = f32[256,32]{1,0} all-gather(f32[64,32]{1,0} %fus), dimensions={0}
+  %c = f32[] constant(0)
+  ROOT %tup = (f32[64,32]{1,0}, f32[]) tuple(f32[64,32]{1,0} %fus, f32[] %c)
+}
+"""
+
+
+# ------------------------------------------------------------- hlo parsing
+
+def test_parse_instructions_opcodes_and_computations():
+    instrs = hlo_mod.parse_instructions(HLO_FIXTURE)
+    by_name = {i.name: i for i in instrs}
+    assert by_name["mul"].opcode == "multiply"
+    assert by_name["mul"].computation == "main"
+    assert by_name["add.1"].computation == "add_comp"
+    assert by_name["add.1"].is_root
+    assert by_name["mul"].shapes == [("f32", (64, 32))]
+    # tuple result type contributes every member shape
+    assert by_name["tup"].shapes == [("f32", (64, 32)), ("f32", ())]
+    assert by_name["tup"].result_bytes() == 64 * 32 * 4 + 4
+
+
+def test_collectives_async_pair_counted_once():
+    coll = hlo_mod.collect_collectives(hlo_mod.parse_instructions(HLO_FIXTURE))
+    # -start carries the payload, -done is bookkeeping
+    assert coll["all-reduce"] == {"count": 1, "bytes": 64 * 32 * 4}
+    assert coll["all-gather"] == {"count": 1, "bytes": 256 * 32 * 4}
+
+
+def test_alias_map_and_entry_layout():
+    assert hlo_mod.parse_input_output_alias(HLO_FIXTURE) == [
+        ((0,), 0, ()), ((1,), 2, ())]
+    assert hlo_mod.aliased_param_numbers(HLO_FIXTURE) == [0, 2]
+    assert hlo_mod.entry_parameter_shapes(HLO_FIXTURE) == [
+        ("f32", (64, 32)), ("s32", ()), ("f32", (64, 32))]
+    assert hlo_mod.entry_output_shapes(HLO_FIXTURE) == [
+        ("f32", (64, 32)), ("f32", ())]
+
+
+def test_find_materializations_excludes_root_and_filters_opcode():
+    hits = hlo_mod.find_materializations(HLO_FIXTURE, "f32", (64, 32))
+    assert [i.name for i in hits] == ["fus"]  # only the fusion producer
+    any_op = hlo_mod.find_materializations(
+        HLO_FIXTURE, "f32", (64, 32), opcodes=None)
+    assert "mul" in [i.name for i in any_op]
+    assert "p0" not in [i.name for i in any_op]  # parameters excluded
+
+
+# ---------------------------------------------------------------- astlint
+
+PLANTED = """\
+import numpy as np
+
+
+class T:
+    def fit(self, steps):
+        for i in range(steps):
+            state, metrics = self.step(state)
+            x = float(metrics["loss"])
+            y = np.asarray(metrics["acc"])
+            metrics["loss"].block_until_ready()
+            ok = float(metrics["t"])  # shardlint: allow-sync
+            f = lambda v: float(v)
+        done = float(metrics["loss"])
+        return done
+
+    def cold(self, rows):
+        for r in rows:
+            out = float(r)
+        return out
+"""
+
+
+def test_planted_syncs_detected_with_lines():
+    findings = astlint.lint_source(PLANTED, "planted.py",
+                                   hot_functions=("T.fit",))
+    assert len(findings) == 3
+    assert all(f.kind == "host-sync" and f.severity == "error"
+               for f in findings)
+    lines = sorted(int(f.where.rsplit(":", 1)[1]) for f in findings)
+    assert lines == [8, 9, 10]
+
+
+def test_sync_outside_loop_and_non_hot_function_ignored():
+    # the float() after the loop (line 13) and everything in cold() is
+    # out of scope; the lambda body inside the loop is a definition
+    findings = astlint.lint_source(PLANTED, "planted.py",
+                                   hot_functions=("T.fit",))
+    assert all(int(f.where.rsplit(":", 1)[1]) <= 10 for f in findings)
+
+
+def test_all_functions_hot_when_unspecified():
+    findings = astlint.lint_source(PLANTED, "planted.py")
+    assert len(findings) == 4  # + the one in cold()
+
+
+def test_missing_hot_function_raises():
+    with pytest.raises(ValueError, match="not found"):
+        astlint.lint_source(PLANTED, "planted.py",
+                            hot_functions=("T.gone",))
+
+
+def test_registered_hot_loops_are_clean():
+    report = core.lint_hot_loops()
+    assert report.findings == []
+
+
+# ------------------------------------------------------- report / baseline
+
+def test_finding_vocabulary_enforced():
+    with pytest.raises(ValueError):
+        Finding(kind="bogus", severity="error", where="x", message="m")
+    with pytest.raises(ValueError):
+        Finding(kind="host-sync", severity="fatal", where="x", message="m")
+
+
+def test_baseline_diff_regression_improvement_and_missing_entry():
+    rep = StepReport(name="s", mesh_shape={"data": 4},
+                     collectives={"all-reduce": {"count": 3, "bytes": 300}})
+    base = baseline_entry(rep)
+    assert diff_against_baseline(rep, base) == []
+    worse = StepReport(name="s", mesh_shape={"data": 4}, collectives={
+        "all-reduce": {"count": 3, "bytes": 300},
+        "all-gather": {"count": 1, "bytes": 64}})
+    regress = diff_against_baseline(worse, base)
+    assert [f.severity for f in regress] == ["error"]
+    assert regress[0].kind == "collective-regression"
+    better = StepReport(name="s", mesh_shape={"data": 4},
+                        collectives={"all-reduce": {"count": 2, "bytes": 200}})
+    assert [f.severity for f in diff_against_baseline(better, base)] == [
+        "info"]
+    missing = diff_against_baseline(rep, None)
+    assert [f.severity for f in missing] == ["warn"]
+
+
+# --------------------------------------------------------- live analyzer
+
+def test_synthetic_bad_step_trips_every_planted_hazard():
+    mesh = core._mesh(("data",), (4,))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # XLA's unusable-donation warning
+        jitted, args, donate = core.build_synthetic_bad_step(mesh)
+        rep = core.analyze_jitted(jitted, args, name="synthetic-bad",
+                                  mesh=mesh, donate=donate)
+    kinds = {f.kind for f in rep.findings}
+    assert kinds == {"replicated-large-tensor", "dtype-promotion",
+                     "lost-donation"}
+    repl = rep.by_kind("replicated-large-tensor")
+    assert [f.shape for f in repl] == [(2048, 128)]
+    assert repl[0].severity == "error"
+    assert "loop-carried" in repl[0].message
+    prom = rep.by_kind("dtype-promotion")
+    assert prom[0].dtype == "f32" and prom[0].bytes == 8 * 65536 * 4
+    lost = rep.by_kind("lost-donation")
+    assert lost[0].severity == "error"
+    assert rep.donation["missing"] == [0]
+
+
+def test_fused_ce_fence_replicated_flagged_dp_tp_clean():
+    """The PR-1 regression fence: the replicated fused-CE mode carries the
+    full [V, D] dE accumulator on every device of the data mesh; the dp
+    and tp shardings must eliminate it entirely."""
+    V, D = core._LM["vocab"], core._LM["d_model"]
+    bad = core.analyze_recipe("lm_fused_ce_replicated",
+                              min_replicated_bytes=4096)
+    flagged = bad.by_kind("replicated-large-tensor")
+    assert any(f.shape == (V, D) for f in flagged), bad.findings
+    for mode in ("lm_fused_ce_dp", "lm_fused_ce_tp"):
+        good = core.analyze_recipe(mode, min_replicated_bytes=4096)
+        assert good.by_kind("replicated-large-tensor") == [], (
+            mode, good.findings)
+
+
+def test_train_step_donations_fully_aliased():
+    for name in ("lm_train_dp", "lm_pp_1f1b"):
+        rep = core.analyze_recipe(name)
+        assert rep.donation["missing"] == [], (name, rep.donation)
+        assert rep.by_kind("lost-donation") == []
+        assert rep.donation["aliased"] == rep.donation["expected"]
+
+
+def test_no_donation_opportunity_warns():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = core._mesh(("data",), (4,))
+    rep_sh = NamedSharding(mesh, P())
+    f = jax.jit(lambda s: (s * 0.9, jnp.sum(s)),
+                in_shardings=(rep_sh,), out_shardings=(rep_sh, rep_sh))
+    s = jnp.ones((512, 512), jnp.float32)  # 1 MiB, shape-matches output 0
+    rep = core.analyze_jitted(f, (s,), name="undonated", mesh=mesh,
+                              donate=())
+    warns = rep.by_kind("no-donation")
+    assert len(warns) == 1 and warns[0].severity == "warn"
+    assert rep.donation["opportunity_bytes"] == 512 * 512 * 4
+
+
+def test_selftest_passes():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        summary = core.selftest()
+    assert summary["ok"]
+
+
+def test_recipe_sweep_clean_against_checked_in_baseline():
+    """The tier-1 fence: every recipe's step analyzed on the 4-way CPU
+    mesh — zero error findings, and per-step collective budgets exactly
+    match analysis/baseline.json (regenerate deliberately with
+    ``scripts/shardlint.py --update-baseline``)."""
+    reports = core.analyze_all()
+    assert {r.name for r in reports} == set(core.RECIPES) | {"hot-loops"}
+    baseline = load_baseline(core.baseline_path())
+    for r in reports:
+        if r.mesh_shape:
+            for f in diff_against_baseline(r, baseline.get(r.name)):
+                r.add(f)
+        assert r.errors() == [], (r.name, r.findings)
+    # the donation audit holds across every train step builder
+    for r in reports:
+        if r.donation.get("expected"):
+            assert r.donation["missing"] == [], (r.name, r.donation)
+
+
+@pytest.mark.slow
+def test_cli_selftest_subprocess():
+    """The CLI entry point end to end (separate process, own XLA_FLAGS)."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "shardlint.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=root)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "selftest OK" in out.stdout
