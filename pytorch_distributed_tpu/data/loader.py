@@ -220,13 +220,24 @@ class DataLoader:
         }
 
     def __iter__(self) -> Iterator[Batch]:
+        return self.iter_batches(0)
+
+    def iter_batches(self, start: int = 0) -> Iterator[Batch]:
+        """Iterate from batch ``start`` of this epoch's shard — the
+        step-granular resume path (ft/): the sampler's (seed, epoch)
+        permutation is recomputed, the first ``start`` batches are skipped
+        by *index arithmetic* (no fetch, no decode), and the stream
+        continues exactly where the checkpointed run left off."""
         indices, valid = self.sampler.shard()
         nb = len(self)
+        if not 0 <= start <= nb:
+            raise ValueError(
+                f"resume step {start} out of range for {nb} batches/epoch")
         if self.worker_type == "process":
-            yield from self._iter_process(indices, valid, nb)
+            yield from self._iter_process(indices, valid, nb, start)
             return
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
-            for b in range(nb):
+            for b in range(start, nb):
                 idx, val = self._batch_indices(indices, valid, b)
                 samples = list(pool.map(self._fetch, idx, val))
                 yield self._assemble(b, val, samples)
@@ -277,7 +288,8 @@ class DataLoader:
         except Exception:  # noqa: BLE001 — interpreter may be tearing down
             pass
 
-    def _iter_process(self, indices, valid, nb: int) -> Iterator[Batch]:
+    def _iter_process(self, indices, valid, nb: int,
+                      start: int = 0) -> Iterator[Batch]:
         """Worker *processes* for the per-sample fetch — the GIL-proof mode
         for Python/PIL decode (the reference's ``DataLoader(num_workers=…)``
         process pool, reference distributed.py:176-180).  The native-decode
@@ -296,7 +308,7 @@ class DataLoader:
         the IPC overhead stays a constant per batch, not per image."""
         pool = self._ensure_pool()
         W = self.num_workers
-        for b in range(nb):
+        for b in range(start, nb):
             idx, val = self._batch_indices(indices, valid, b)
             args = [
                 (int(i), int(v), self.seed, self.sampler.epoch)

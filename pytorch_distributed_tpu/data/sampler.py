@@ -54,6 +54,19 @@ class DistributedShardSampler:
         """Reshuffle for a new epoch (reference distributed.py:202-203)."""
         self.epoch = epoch
 
+    def state_dict(self) -> dict:
+        """The iterator RNG state a step-granular checkpoint records:
+        ``(seed, epoch)`` fully determines the global permutation (computed
+        identically on every rank with no communication), so restoring
+        these two integers + a step offset reproduces the exact remaining
+        index stream — no index lists on disk."""
+        return {"seed": int(self.seed), "epoch": int(self.epoch)}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore ``(seed, epoch)`` from a checkpoint's ft record."""
+        self.seed = int(state["seed"])
+        self.epoch = int(state["epoch"])
+
     def global_indices(self) -> Tuple[np.ndarray, np.ndarray]:
         """(indices, valid) after shuffle+pad, before rank sharding."""
         if self.shuffle:

@@ -1,0 +1,65 @@
+"""Runtime fault-tolerance subsystem (ISSUE 4): failures as routine events.
+
+Complements the detection layers — ``obs/`` (stragglers, metrics) and
+``analysis/`` (static sharding hazards) — with *recovery*:
+
+- ``integrity``  — sha256 sidecars, atomic writes, bounded I/O retries;
+  a flipped bit or torn write is detected before deserialization, and
+  the loader falls back to the previous retained checkpoint.
+- ``divergence`` — ``DivergenceGuard``: the host policy over the step's
+  in-graph ``nonfinite`` flag (skip the bad batch; K consecutive → roll
+  back to the last-good state with an LR backoff), plus ``StateKeeper``
+  (the host-RAM last-good snapshot).
+- ``chaos``      — deterministic, seedable fault injectors (SIGTERM/
+  SIGKILL at step k, NaN batches, LR spikes, per-rank delay, byte-level
+  checkpoint corruption) driving the survival tests and
+  ``scripts/chaoskit.py``.
+
+Step-granular resume itself lives in the trainers + ``train/checkpoint``
+(``--save-steps``, iterator state in the checkpoint's ``ft`` record).
+"""
+
+from pytorch_distributed_tpu.ft.chaos import (
+    ChaosInjector,
+    ChaosSchedule,
+    DelayRank,
+    KillAt,
+    LRSpikeAt,
+    NaNBatchAt,
+    SignalAt,
+    corrupt_file,
+)
+from pytorch_distributed_tpu.ft.divergence import DivergenceGuard, StateKeeper
+from pytorch_distributed_tpu.ft.integrity import (
+    CheckpointCorruptError,
+    check_integrity,
+    file_sha256,
+    read_sidecar,
+    replace_with_sidecar,
+    retrying,
+    sidecar_path,
+    verify_sidecar,
+    write_sidecar,
+)
+
+__all__ = [
+    "CheckpointCorruptError",
+    "ChaosInjector",
+    "ChaosSchedule",
+    "DelayRank",
+    "DivergenceGuard",
+    "KillAt",
+    "LRSpikeAt",
+    "NaNBatchAt",
+    "SignalAt",
+    "StateKeeper",
+    "check_integrity",
+    "corrupt_file",
+    "file_sha256",
+    "read_sidecar",
+    "replace_with_sidecar",
+    "retrying",
+    "sidecar_path",
+    "verify_sidecar",
+    "write_sidecar",
+]

@@ -1,0 +1,148 @@
+"""Divergence guard: in-graph non-finite detection, host-side skip/rollback
+policy, and the last-known-good state snapshot (ISSUE 4 pillar 2).
+
+Division of labor:
+
+- **Inside the jitted step** (``guard_nonfinite=True`` on
+  ``make_train_step`` / ``make_lm_train_step``): a ``nonfinite`` flag is
+  computed from the loss and global grad norm, and the parameter /
+  momentum / BN-stats update is gated with ``jnp.where`` — a bad batch's
+  update is *structurally* skipped before the host ever hears about it, so
+  NaNs can never enter the weights through a single poisoned batch.
+- **Host side** (this module): ``DivergenceGuard`` watches the flag with
+  the obs-layer's lazy-sync discipline — flags buffer as *unconverted*
+  device scalars and drain in one amortized host sync every
+  ``check_every`` observations, so the hot loop never blocks per step.
+  The policy: every flagged step is recorded as a ``skip`` ft_event; K
+  *consecutive* flagged steps mean skipping isn't working (the state
+  itself is corrupt — e.g. an earlier overflow) and the guard asks the
+  trainer to roll back to the last-good snapshot with an LR backoff.
+
+``StateKeeper`` holds that snapshot in host RAM (gathered with the
+checkpoint module's multi-host-safe ``_to_host``, so every rank must call
+``update`` at the same cadence on multi-process meshes — the trainers
+refresh it at each ``--save-steps`` boundary).  ``restore`` returns a
+host-numpy ``TrainState``; the jitted step's ``in_shardings`` re-place it
+on device at the next call, exactly like a ``--resume`` load.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+
+class DivergenceGuard:
+    """Skip-and-rollback policy over the step's ``nonfinite`` flag.
+
+    >>> guard = DivergenceGuard(rollback_k=3, check_every=10, obs=logger)
+    >>> ...
+    >>> if guard.observe(step, metrics.get("nonfinite")):
+    ...     state = keeper.restore()          # trainer-side rollback
+    ...     guard.note_rollback(step, keeper.step)
+
+    ``observe`` returns True when a rollback is due (decided at drain
+    cadence, so up to ``check_every - 1`` steps late — the documented price
+    of never syncing per step; the in-graph gate has already prevented any
+    of those steps from touching the weights).
+    """
+
+    def __init__(self, rollback_k: int = 3, check_every: int = 10,
+                 lr_backoff: float = 0.5, obs=None):
+        if rollback_k < 1:
+            raise ValueError(f"rollback_k must be >= 1, got {rollback_k}")
+        if not 0.0 < lr_backoff <= 1.0:
+            raise ValueError(
+                f"lr_backoff must be in (0, 1], got {lr_backoff}")
+        self.rollback_k = int(rollback_k)
+        self.check_every = max(1, int(check_every))
+        self.lr_backoff = float(lr_backoff)
+        self.obs = obs
+        self.lr_scale = 1.0
+        self.consecutive = 0
+        self.rollbacks = 0
+        self.skipped: List[int] = []      # steps whose update was gated off
+        self._pending: List[Tuple[int, Any]] = []  # unconverted flags
+
+    def observe(self, step: int, flag) -> bool:
+        """Buffer one step's (possibly unready device) flag; drains every
+        ``check_every`` observations.  Returns True when the drain decided
+        a rollback is needed."""
+        if flag is None:
+            return False
+        self._pending.append((int(step), flag))
+        if len(self._pending) >= self.check_every:
+            return self.drain()
+        return False
+
+    def drain(self) -> bool:
+        """Convert buffered flags (the one amortized host sync) and apply
+        the policy.  Idempotent when the buffer is empty."""
+        if not self._pending:
+            return False
+        pending, self._pending = self._pending, []
+        rollback = False
+        for step, flag in pending:
+            bad = float(flag) > 0.0
+            if not bad:
+                self.consecutive = 0
+                continue
+            self.consecutive += 1
+            self.skipped.append(step)
+            self._emit("skip", step=step, consecutive=self.consecutive)
+            if self.consecutive >= self.rollback_k:
+                rollback = True
+        return rollback
+
+    def note_rollback(self, step: int, restored_step: Optional[int]) -> float:
+        """Record a completed rollback: backs off the LR scale, resets the
+        consecutive counter, emits the ft_event.  Returns the new scale."""
+        self.lr_scale *= self.lr_backoff
+        self.consecutive = 0
+        self.rollbacks += 1
+        self._emit("rollback", step=int(step),
+                   restored_step=(int(restored_step)
+                                  if restored_step is not None else -1),
+                   lr_scale=self.lr_scale)
+        return self.lr_scale
+
+    def _emit(self, kind: str, **fields) -> None:
+        if self.obs is not None and hasattr(self.obs, "log_event"):
+            self.obs.log_event(kind, **fields)
+
+
+class StateKeeper:
+    """Host-RAM snapshot of the last-known-good ``TrainState``.
+
+    Rollback source of last resort when no on-disk checkpoint exists yet
+    (and the fast path when one does — no filesystem round-trip).  Uses the
+    checkpoint module's ``_to_host``, which all-gathers non-addressable
+    (multi-host-sharded) leaves, so on multi-process meshes every rank must
+    call ``update`` at the same step — the trainers do it at the
+    ``--save-steps`` cadence, right where ``save_checkpoint`` already has
+    the same collective requirement."""
+
+    def __init__(self):
+        self._host = None
+        self.step: Optional[int] = None
+
+    @property
+    def has_snapshot(self) -> bool:
+        return self._host is not None
+
+    def update(self, state, step: int) -> None:
+        from pytorch_distributed_tpu.train.checkpoint import _to_host
+
+        self._host = _to_host(state)
+        self.step = int(step)
+
+    def restore(self):
+        """The snapshot as a host-numpy TrainState (caller assigns it; the
+        jitted step's in_shardings re-shard on the next call)."""
+        if self._host is None:
+            raise RuntimeError(
+                "StateKeeper has no snapshot to restore (update() never "
+                "called)")
+        import jax
+
+        # Copy: the trainer will donate the restored leaves into the step.
+        return jax.tree_util.tree_map(lambda x: x.copy(), self._host)

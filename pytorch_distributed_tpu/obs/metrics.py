@@ -162,6 +162,27 @@ class MetricsLogger:
         if len(self._pending) >= self.flush_every:
             self.flush()
 
+    def log_event(self, kind: str, step: Optional[int] = None,
+                  **fields: Any) -> None:
+        """Buffer one structured *event* record (``{"ft_event": kind, …}``)
+        through the same pending/flush pipeline as step records — the FT
+        subsystem's skip/rollback/preemption trail (ft/divergence.py;
+        summarized by ``scripts/obs_report.py``).  Events are rare, so they
+        flush immediately: a crash right after a preemption event must not
+        lose the record that explains the crash."""
+        if not self.enabled:
+            return
+        rec: Dict[str, Any] = {
+            "ft_event": str(kind),
+            "t": time.time(),
+            "process": self.process_index,
+        }
+        if step is not None:
+            rec["step"] = int(step)
+        rec.update(fields)
+        self._pending.append(rec)
+        self.flush()
+
     def flush(self) -> None:
         """Drain pending records: convert device scalars (the one host sync,
         amortized over ``flush_every`` steps), write JSONL, notify sinks."""

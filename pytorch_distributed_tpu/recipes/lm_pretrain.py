@@ -126,6 +126,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
     p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--resume", type=str, default=None, metavar="PATH",
+                   help="resume from a checkpoint: restores params/momentum "
+                        "AND the exact step (the ft record), so the run "
+                        "continues mid-stream instead of restarting")
+    p.add_argument("--save-steps", type=int, default=0, dest="save_steps",
+                   metavar="N",
+                   help="also checkpoint every N steps (step-granular "
+                        "resume: preemption/SIGKILL loses at most N steps); "
+                        "0 = end-of-run only")
+    p.add_argument("--preempt-signals", type=str, default="term",
+                   dest="preempt_signals", metavar="SIGS",
+                   help="comma-separated signals that trigger checkpoint-"
+                        "and-exit at the next step boundary (default "
+                        "'term'; add 'int' for interactive Ctrl-C runs)")
+    p.add_argument("--nan-guard", action="store_true", dest="nan_guard",
+                   help="divergence guard: skip non-finite steps in-graph; "
+                        "after --ft-rollback-k consecutive bad steps, roll "
+                        "back to the last-good state with an LR backoff")
+    p.add_argument("--ft-rollback-k", type=int, default=3,
+                   dest="ft_rollback_k", metavar="K",
+                   help="consecutive non-finite steps before rollback")
+    p.add_argument("--ft-check-every", type=int, default=10,
+                   dest="ft_check_every", metavar="N",
+                   help="drain the guard's buffered flags every N steps "
+                        "(one amortized host sync)")
+    p.add_argument("--ft-lr-backoff", type=float, default=0.5,
+                   dest="ft_lr_backoff", metavar="F",
+                   help="LR multiplier applied at each rollback")
     p.add_argument("--dataset-length", type=int, default=4096)
     p.add_argument("--text-glob", type=str, default=None,
                    help="train on real files: byte-level LM over this glob "
@@ -355,6 +383,22 @@ def main(argv=None) -> float:
             from pytorch_distributed_tpu.train.lm import warmup_cosine_lr
 
             schedule = warmup_cosine_lr(args.lr, args.warmup_steps, args.steps)
+        # Preemption guard (previously only the image Trainer self-
+        # installed one; the LM recipe ran unguarded): --preempt-signals
+        # SIGTERM (pod reclaim) by default, SIGINT opt-in for interactive
+        # runs.  Installed here (main thread — a Python signal-handler
+        # restriction) and chained/uninstalled around fit.
+        import threading
+
+        from pytorch_distributed_tpu.utils.preempt import (
+            PreemptionGuard,
+            parse_signals,
+        )
+
+        guard = None
+        if threading.current_thread() is threading.main_thread():
+            guard = PreemptionGuard(
+                signals=parse_signals(args.preempt_signals)).install()
         trainer = LMTrainer(
             model, mesh, dataset, args.batch_size, lr=args.lr,
             param_specs=specs, seed=args.seed, is_primary=ctx.is_primary,
@@ -366,8 +410,17 @@ def main(argv=None) -> float:
             fused_ce_mode=args.fused_ce_mode,
             metrics_jsonl=args.metrics_jsonl, hb_dir=args.hb_dir,
             hb_interval_s=args.hb_interval_s,
+            save_steps=args.save_steps, resume=args.resume,
+            nan_guard=args.nan_guard, ft_rollback_k=args.ft_rollback_k,
+            ft_check_every=args.ft_check_every,
+            ft_lr_backoff=args.ft_lr_backoff,
+            preempt=guard,
         )
-        final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
+        try:
+            final_loss = trainer.fit(args.steps, print_freq=args.print_freq)
+        finally:
+            if guard is not None:
+                guard.uninstall()
         if args.generate > 0:  # plain-dp only, validated with the args above
             import jax as _jax
             import numpy as _np

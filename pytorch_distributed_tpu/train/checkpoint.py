@@ -13,22 +13,66 @@ recipe's checkpoint regardless of mesh shape, because state is replicated
 
 Format: flax msgpack (``flax.serialization``), written atomically
 (tmp + rename).
+
+Fault tolerance (ft/): every msgpack write carries an atomic sha256
+sidecar, the previous checkpoint is retained as ``checkpoint.prev.msgpack``
+(retain N=2, matching the orbax manager's ``max_to_keep=2``), loads verify
+the sidecar *before* deserializing and fall back to the retained previous
+file when the latest is corrupt/truncated, and all file I/O runs under
+bounded exponential-backoff retries for flaky shared filesystems.  The
+payload additionally carries an ``ft`` record (step-in-epoch, global step,
+sampler RNG state, LR backoff scale) so ``--resume`` restores the exact
+step, not just the epoch.
 """
 
 from __future__ import annotations
 
 import os
-import shutil
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
+from pytorch_distributed_tpu.ft.integrity import (
+    CheckpointCorruptError,
+    check_integrity,
+    replace_with_sidecar,
+    retrying,
+    verify_sidecar,
+    write_sidecar,
+)
 from pytorch_distributed_tpu.train.state import TrainState
 
 CHECKPOINT_NAME = "checkpoint.msgpack"
+PREV_NAME = "checkpoint.prev.msgpack"
 BEST_NAME = "model_best.msgpack"
+
+# Data-iterator / FT state stored alongside the model state: enough to
+# restore the exact step.  ``step`` is the step-in-epoch offset (0 = "this
+# epoch is complete; resume starts the next one" — the legacy epoch
+# semantics); the sampler's (seed, epoch) pair regenerates the identical
+# permutation with no communication, so no index lists are stored.
+FT_DEFAULTS: Dict[str, Any] = {
+    "step": 0,
+    "global_step": 0,
+    "sampler_seed": 0,
+    "sampler_epoch": 0,
+    "lr_scale": 1.0,
+}
+
+
+def _ft_record(ft: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Normalize a (possibly partial/absent) ft dict to the canonical
+    schema with plain-python values msgpack/json can carry."""
+    out = dict(FT_DEFAULTS)
+    for k, v in (ft or {}).items():
+        if k not in FT_DEFAULTS:
+            raise ValueError(f"unknown ft checkpoint field {k!r}; expected "
+                             f"one of {sorted(FT_DEFAULTS)}")
+        out[k] = float(v) if k == "lr_scale" else int(v)
+    return out
 
 
 def _to_host(tree: Any, want_value: bool = True) -> Any:
@@ -94,6 +138,7 @@ def wait_for_async_saves() -> None:
 def _save_orbax(
     directory: str, state: TrainState, epoch: int, arch: str,
     best_acc1: float, is_best: bool, metric: Optional[float] = None,
+    ft: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Async sharded save: every process writes its own shards (OCDBT) — no
     host gather, no full-tree allgather; the at-scale story the msgpack
@@ -115,7 +160,8 @@ def _save_orbax(
             state=ocp.args.StandardSave(tree),
             meta=ocp.args.JsonSave(
                 {"epoch": int(epoch), "arch": arch,
-                 "best_acc1": float(best_acc1), "is_best": bool(is_best)}
+                 "best_acc1": float(best_acc1), "is_best": bool(is_best),
+                 "ft": _ft_record(ft)}
             ),
         ),
         # The retention metric must be THIS epoch's own score: the running
@@ -171,6 +217,7 @@ def _load_orbax(path: str, state_template: TrainState):
         momentum=st["momentum"],
     )
     meta = {k: restored["meta"][k] for k in ("epoch", "arch", "best_acc1")}
+    meta["ft"] = _ft_record(restored["meta"].get("ft"))
     return state, meta
 
 
@@ -184,6 +231,7 @@ def save_checkpoint(
     is_primary: bool = True,
     backend: str = "msgpack",
     metric: Optional[float] = None,
+    ft: Optional[Dict[str, Any]] = None,
 ) -> Optional[str]:
     """Rank-0-guarded atomic save (reference distributed.py:218-225).
 
@@ -193,11 +241,22 @@ def save_checkpoint(
     would deadlock the job at the first checkpoint. All ranks gather; only
     the primary writes.
 
+    ``ft``: optional step-granular resume record (see ``FT_DEFAULTS``);
+    omitted fields default to the epoch-boundary semantics.
+
+    Write discipline (msgpack): payload to tmp + rename, the previous
+    checkpoint rotated to ``checkpoint.prev.msgpack`` (with its sidecar)
+    first, then the new sha256 sidecar — so at every instant the directory
+    holds at least one complete, verifiable checkpoint.  The whole sequence
+    is retried with bounded backoff on OSError (flaky shared filesystems);
+    it is safe to re-run from the top because the rotation step is skipped
+    once the target no longer exists.
+
     ``backend="orbax"``: async sharded per-process writes instead (see
     ``_save_orbax``); all ranks call, orbax coordinates."""
     if backend == "orbax":
         return _save_orbax(directory, state, epoch, arch, best_acc1, is_best,
-                           metric=metric)
+                           metric=metric, ft=ft)
     if backend != "msgpack":
         raise ValueError(f"unknown checkpoint backend '{backend}'")
     host_state = _to_host(
@@ -211,57 +270,129 @@ def save_checkpoint(
     )
     if not is_primary:
         return None
-    os.makedirs(directory, exist_ok=True)
     payload = {
         "epoch": epoch,
         "arch": arch,
         "best_acc1": float(best_acc1),
+        "ft": _ft_record(ft),
         "state": host_state,
     }
+    blob = serialization.to_bytes(payload)
     path = os.path.join(directory, CHECKPOINT_NAME)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(payload))
-    os.replace(tmp, path)
+
+    def write() -> str:
+        os.makedirs(directory, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        if os.path.exists(path):
+            # Retain N=2: the outgoing latest becomes the fallback the
+            # loader reaches for when the new file turns out corrupt.
+            replace_with_sidecar(path, os.path.join(directory, PREV_NAME))
+        os.replace(tmp, path)
+        write_sidecar(path)
+        return path
+
+    retrying(write)
     if is_best:
-        shutil.copyfile(path, os.path.join(directory, BEST_NAME))
+        # Crash-safe best copy: tmp + os.replace like the main file (a bare
+        # copyfile interrupted mid-write left a torn model_best).
+        best = os.path.join(directory, BEST_NAME)
+
+        def write_best() -> None:
+            tmp = best + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, best)
+            write_sidecar(best)
+
+        retrying(write_best)
     return path
 
 
-def load_checkpoint(
+def _load_msgpack(
     path: str, state_template: TrainState
 ) -> Tuple[TrainState, Dict[str, Any]]:
-    """Restore ``(state, meta)`` from a checkpoint file.
+    """Verify-then-deserialize one msgpack checkpoint file.
 
-    ``state_template`` supplies the pytree structure/shapes (a freshly
-    initialized state for the same arch); meta carries epoch/arch/best_acc1
-    for the ``--start-epoch``/resume flow.
-
-    Backend is auto-detected: a directory (or ``.../orbax[/<step>]`` path)
-    restores via orbax; a file is the msgpack format.
-    """
-    if os.path.isdir(path):
-        return _load_orbax(path, state_template)
-    with open(path, "rb") as f:
-        raw = f.read()
-    template = {
-        "epoch": 0,
-        "arch": "",
-        "best_acc1": 0.0,
-        "state": {
-            "step": state_template.step,
-            "params": state_template.params,
-            "batch_stats": state_template.batch_stats,
-            "momentum": state_template.momentum,
-        },
-    }
-    payload = serialization.from_bytes(template, raw)
-    st = payload["state"]
+    Sidecar verification runs BEFORE flax touches the bytes, so corruption
+    surfaces as ``CheckpointCorruptError`` instead of a cryptic msgpack
+    unpack failure.  Legacy files without a sidecar still load; their parse
+    errors are converted to ``CheckpointCorruptError`` (a verified file
+    that fails to parse indicates a template/arch mismatch and propagates
+    as-is)."""
+    check_integrity(path)
+    verified = verify_sidecar(path) is True
+    raw = retrying(lambda: open(path, "rb").read())
+    try:
+        tree = serialization.msgpack_restore(raw)
+        # from_state_dict (not from_bytes-with-template): tolerates the
+        # pre-FT payload layout — a missing 'ft' key defaults instead of
+        # failing the whole-template key match.
+        st = serialization.from_state_dict(
+            {
+                "step": state_template.step,
+                "params": state_template.params,
+                "batch_stats": state_template.batch_stats,
+                "momentum": state_template.momentum,
+            },
+            tree["state"],
+        )
+        meta = {
+            "epoch": int(tree["epoch"]),
+            "arch": str(tree["arch"]),
+            "best_acc1": float(tree["best_acc1"]),
+            "ft": _ft_record(tree.get("ft")),
+        }
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:
+        if verified:
+            raise
+        raise CheckpointCorruptError(
+            f"checkpoint '{path}' failed to deserialize and carries no "
+            f"sha256 sidecar to pinpoint corruption: {e}"
+        ) from e
     state = TrainState(
         step=st["step"],
         params=st["params"],
         batch_stats=st["batch_stats"],
         momentum=st["momentum"],
     )
-    meta = {k: payload[k] for k in ("epoch", "arch", "best_acc1")}
     return state, meta
+
+
+def load_checkpoint(
+    path: str, state_template: TrainState, fallback: bool = True
+) -> Tuple[TrainState, Dict[str, Any]]:
+    """Restore ``(state, meta)`` from a checkpoint file.
+
+    ``state_template`` supplies the pytree structure/shapes (a freshly
+    initialized state for the same arch); meta carries epoch/arch/best_acc1
+    plus the ``ft`` step-granular resume record.
+
+    Backend is auto-detected: a directory (or ``.../orbax[/<step>]`` path)
+    restores via orbax; a file is the msgpack format.
+
+    ``fallback``: when the latest ``checkpoint.msgpack`` fails sidecar
+    verification (or a legacy file fails to parse), resume continues from
+    the retained ``checkpoint.prev.msgpack`` instead of crashing — losing
+    one save interval, not the run.  Only when both are bad does
+    ``CheckpointCorruptError`` propagate.
+    """
+    if os.path.isdir(path):
+        return _load_orbax(path, state_template)
+    try:
+        return _load_msgpack(path, state_template)
+    except CheckpointCorruptError as e:
+        prev = None
+        if os.path.basename(path) == CHECKPOINT_NAME:
+            prev = os.path.join(os.path.dirname(path), PREV_NAME)
+        if fallback and prev and os.path.exists(prev):
+            warnings.warn(
+                f"latest checkpoint is corrupt; falling back to '{prev}' "
+                f"({e})",
+                stacklevel=2,
+            )
+            return _load_msgpack(prev, state_template)
+        raise

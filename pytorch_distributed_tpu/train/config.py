@@ -79,6 +79,17 @@ class Config:
     # (workspace-hygiene; save_checkpoint creates the directory).
     checkpoint_dir: str = "runs"
     ckpt_backend: str = "msgpack"
+    # Fault tolerance (ft/): mid-epoch checkpoint cadence (0 = epoch
+    # boundaries only — a preemption then loses the partial epoch; N > 0
+    # bounds the loss to N steps even under SIGKILL), the in-graph
+    # non-finite guard with its rollback policy, and which signals the
+    # preemption guard traps.
+    save_steps: int = 0
+    nan_guard: bool = False
+    ft_rollback_k: int = 3
+    ft_check_every: int = 10
+    ft_lr_backoff: float = 0.5
+    preempt_signals: str = "term"
     epoch_csv: Optional[str] = None
     profile_dir: Optional[str] = None
     # Profiler capture windows (obs/trace.py ProfileWindow): 'E' or 'A:B'
@@ -174,6 +185,34 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    choices=("msgpack", "orbax"), dest="ckpt_backend",
                    help="msgpack = single-file portable (default); orbax = "
                    "async sharded per-process writes (multi-host TP/SP scale)")
+    p.add_argument("--save-steps", default=d.save_steps, type=int,
+                   dest="save_steps", metavar="N",
+                   help="also checkpoint every N train steps (step-granular "
+                   "resume: preemption/SIGKILL loses at most N steps instead "
+                   "of the whole epoch); 0 = epoch boundaries only")
+    p.add_argument("--nan-guard", action="store_true", dest="nan_guard",
+                   help="divergence guard: detect non-finite loss/grad-norm "
+                   "inside the compiled step, skip the bad batch's update, "
+                   "and after --ft-rollback-k consecutive bad steps roll "
+                   "back to the last-good state with an LR backoff")
+    p.add_argument("--ft-rollback-k", default=d.ft_rollback_k, type=int,
+                   dest="ft_rollback_k", metavar="K",
+                   help="consecutive non-finite steps before the guard "
+                   "rolls back (default 3)")
+    p.add_argument("--ft-check-every", default=d.ft_check_every, type=int,
+                   dest="ft_check_every", metavar="N",
+                   help="drain the guard's buffered non-finite flags every "
+                   "N steps — one amortized host sync, never per step "
+                   "(default 10)")
+    p.add_argument("--ft-lr-backoff", default=d.ft_lr_backoff, type=float,
+                   dest="ft_lr_backoff", metavar="F",
+                   help="multiply the LR by this factor at each rollback "
+                   "(default 0.5)")
+    p.add_argument("--preempt-signals", default=d.preempt_signals, type=str,
+                   dest="preempt_signals", metavar="SIGS",
+                   help="comma-separated signals the preemption guard traps "
+                   "(default 'term'; add 'int' for interactive Ctrl-C runs, "
+                   "e.g. 'term,int')")
     p.add_argument("--epoch-csv", default=d.epoch_csv, type=str,
                    help="append [timestamp, epoch_seconds] rows to this CSV")
     p.add_argument("--profile-dir", default=d.profile_dir, type=str,

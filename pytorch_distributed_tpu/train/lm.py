@@ -27,7 +27,11 @@ from pytorch_distributed_tpu.ops import cross_entropy
 from pytorch_distributed_tpu.train.meters import StepMeters
 from pytorch_distributed_tpu.train.optim import sgd_init, sgd_update
 from pytorch_distributed_tpu.train.state import TrainState
-from pytorch_distributed_tpu.train.steps import tree_l2_norm
+from pytorch_distributed_tpu.train.steps import (
+    gate_update,
+    nonfinite_flag,
+    tree_l2_norm,
+)
 
 
 class SyntheticTokenDataset:
@@ -204,6 +208,7 @@ def make_lm_train_step(
     fused_ce_chunks: int = 0,
     fused_ce_mode: str = "auto",
     log_norms: bool = False,
+    guard_nonfinite: bool = False,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
@@ -226,7 +231,12 @@ def make_lm_train_step(
     (per-leaf reductions stay sharding-local; the scalars replicate).  Off
     by default — the extra reduce ops lengthen compiles, so the cost is
     only paid when a metrics sink is on (``LMTrainer`` enables it with
-    ``metrics_jsonl``)."""
+    ``metrics_jsonl``).
+
+    ``guard_nonfinite``: gate the whole update on an in-graph
+    loss/grad-norm finiteness check and emit the ``nonfinite`` flag as a
+    lazy metric — the divergence guard's detection half (train/steps.py
+    ``nonfinite_flag``/``gate_update``; policy in ft/divergence.py)."""
     manual = getattr(model, "has_manual_grads", lambda: False)()
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -356,10 +366,12 @@ def make_lm_train_step(
                 lambda g, p: (g * inv).astype(p.dtype), grads, state.params)
             loss, acc = loss * inv, acc * inv
         # Pre-clip global grad norm: computed in-graph when clipping needs
-        # it or when the obs layer asked for it (an on-device scalar —
-        # converted lazily, never a host sync).
+        # it, when the obs layer asked for it, or when the divergence guard
+        # watches it (an on-device scalar — converted lazily, never a host
+        # sync).
         gnorm = (tree_l2_norm(grads)
-                 if (log_norms or clip_grad_norm > 0.0) else None)
+                 if (log_norms or clip_grad_norm > 0.0 or guard_nonfinite)
+                 else None)
         if clip_grad_norm > 0.0:
             with jax.named_scope("grad_clip"):
                 scale = jnp.minimum(
@@ -373,9 +385,14 @@ def make_lm_train_step(
                 grads, state.momentum, state.params, lr,
                 momentum=momentum, weight_decay=weight_decay,
             )
+        metrics = {"loss": loss, "acc": acc * 100.0}
+        if guard_nonfinite:
+            bad = nonfinite_flag(loss, gnorm)
+            new_params = gate_update(bad, state.params, new_params)
+            new_momentum = gate_update(bad, state.momentum, new_momentum)
+            metrics["nonfinite"] = bad
         new_state = TrainState(state.step + 1, new_params, state.batch_stats,
                                new_momentum)
-        metrics = {"loss": loss, "acc": acc * 100.0}
         if log_norms:
             metrics["grad_norm"] = gnorm
             metrics["param_norm"] = tree_l2_norm(new_params)
@@ -461,6 +478,13 @@ class LMTrainer:
         metrics_jsonl: Optional[str] = None,
         hb_dir: Optional[str] = None,
         hb_interval_s: float = 5.0,
+        save_steps: int = 0,
+        resume: Optional[str] = None,
+        nan_guard: bool = False,
+        ft_rollback_k: int = 3,
+        ft_check_every: int = 10,
+        ft_lr_backoff: float = 0.5,
+        chaos=None,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -476,7 +500,19 @@ class LMTrainer:
         (auto | replicated | dp | tp — see ``resolve_fused_ce_mode``);
         ``metrics_jsonl``/``hb_dir``: unified observability (obs/) — one
         structured record per step, and per-process heartbeats for the
-        cross-process straggler monitor."""
+        cross-process straggler monitor.
+
+        Fault tolerance (ft/): ``save_steps`` checkpoints every N steps
+        (ft record carries the step, so SIGKILL loses at most N steps);
+        ``resume`` restores state AND the exact step from a checkpoint —
+        the run continues as if never interrupted (the step-indexed
+        wraparound batching regenerates the identical token stream);
+        ``nan_guard`` turns on the in-graph non-finite skip plus the
+        K-consecutive rollback policy with LR backoff (``ft_rollback_k``,
+        ``ft_check_every``, ``ft_lr_backoff`` — see
+        ``ft.divergence.DivergenceGuard``); ``chaos``: an optional
+        ``ft.chaos`` injector schedule driven once per loop step (tests
+        and drills only)."""
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -510,7 +546,8 @@ class LMTrainer:
                                           fused_ce_mode=fused_ce_mode,
                                           # in-graph norms only when a
                                           # metrics sink will consume them
-                                          log_norms=bool(metrics_jsonl))
+                                          log_norms=bool(metrics_jsonl),
+                                          guard_nonfinite=nan_guard)
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every
@@ -532,6 +569,35 @@ class LMTrainer:
         self.hb = (HeartbeatWriter(hb_dir, jax.process_index(),
                                    interval_s=hb_interval_s)
                    if hb_dir else None)
+
+        # ---- fault tolerance (ft/) ----
+        self.save_steps = int(save_steps)
+        self.chaos = chaos
+        self.ft_guard = None
+        self._keeper = None
+        if nan_guard:
+            from pytorch_distributed_tpu.ft import DivergenceGuard, StateKeeper
+
+            self.ft_guard = DivergenceGuard(
+                rollback_k=ft_rollback_k, check_every=ft_check_every,
+                lr_backoff=ft_lr_backoff, obs=self.obs)
+            self._keeper = StateKeeper()
+        self._start_step = 0
+        if resume:
+            from pytorch_distributed_tpu.train.checkpoint import load_checkpoint
+
+            loaded, meta = load_checkpoint(resume, self.state)
+            # Host-numpy leaves → re-shard to this trainer's specs (any
+            # mesh shape can resume any mesh shape's checkpoint).
+            self.state = shard_state(loaded, self.param_specs, mesh)
+            ft = meta["ft"]
+            self._start_step = max(int(ft["global_step"]), int(ft["step"]))
+            if self.ft_guard is not None:
+                self.ft_guard.lr_scale = float(ft["lr_scale"])
+            if self._eval_fn is not None and float(meta["best_acc1"]) > 0:
+                self.best_ppl = float(meta["best_acc1"])
+            print(f"=> resumed {meta['arch']} from '{resume}' at step "
+                  f"{self._start_step}", flush=True)
 
     def _row_span(self) -> Tuple[int, int]:
         """This process's row range of the global batch under the token
@@ -623,6 +689,45 @@ class LMTrainer:
         self.eval_history.append((loss, ppl, acc))
         return loss, ppl, acc
 
+    def _ft_record(self, completed: int) -> dict:
+        """The step-granular resume record for a checkpoint at
+        ``completed`` finished steps (LM is epochless: step == global
+        step; the wraparound batching is purely step-indexed, so these
+        two integers restore the exact token stream)."""
+        return {
+            "step": int(completed),
+            "global_step": int(completed),
+            "lr_scale": (self.ft_guard.lr_scale
+                         if self.ft_guard is not None else 1.0),
+        }
+
+    def _save_checkpoint(self, completed: int, is_best: bool = False) -> None:
+        """ALL ranks call: save_checkpoint gathers sharded leaves with a
+        cross-process collective before its primary guard — gating the
+        call itself on is_primary would deadlock multi-host TP/SP runs.
+        best_acc1 slot carries the best perplexity for the LM family."""
+        from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            self.checkpoint_dir, self.state, 0, "transformer_lm",
+            self.best_ppl if self._eval_fn is not None else 0.0,
+            is_best=is_best, is_primary=self.is_primary,
+            ft=self._ft_record(completed),
+        )
+
+    def _rollback(self, step: int) -> None:
+        """Divergence recovery: restore the last-good snapshot and back
+        off the LR scale (ft/divergence.py policy).  The jitted step's
+        ``in_shardings`` re-shard the host-numpy snapshot on the next
+        call, exactly like a ``--resume`` load."""
+        restored_step = None
+        if self._keeper is not None and self._keeper.has_snapshot:
+            self.state = self._keeper.restore()
+            restored_step = self._keeper.step
+        scale = self.ft_guard.note_rollback(step, restored_step)
+        print(f"=> divergence rollback at step {step}: restored state from "
+              f"step {restored_step}, lr scale now {scale:g}", flush=True)
+
     def fit(self, steps: int, print_freq: int = 10) -> float:
         from pytorch_distributed_tpu.obs import scope
 
@@ -631,11 +736,12 @@ class LMTrainer:
             [("loss", "Loss", ":.4e"), ("acc", "Acc@1", ":6.2f")],
             prefix="Step: ",
         )
-        lr = jnp.float32(self.lr)
+        start = min(self._start_step, steps)
         # Tokens per optimizer step — the LM throughput unit (tokens/s).
         tokens_per_step = self.batch_size * self.dataset.seq_len
         final_ppl = None  # ppl from an interval eval on the very last step
         preempted = False
+        completed = start  # steps finished (preemption/ft checkpoints)
         # Prefetch ≥2: batch assembly (real host work for TextFileDataset
         # windows) + async transfer dispatch run on a producer thread, off
         # the step hot path — the LM counterpart of the image DeviceFeeder
@@ -643,18 +749,24 @@ class LMTrainer:
         from pytorch_distributed_tpu.data.loader import AsyncFeeder
 
         # Each process assembles ONLY its own rows (wraparound batching,
-        # the convention both LM datasets implement).
+        # the convention both LM datasets implement); a resumed run starts
+        # the stream at the checkpointed step — no epoch rerun.
         host_iter = (
-            self._local_batch(self.dataset, i) for i in range(steps)
+            self._local_batch(self.dataset, i) for i in range(start, steps)
         )
         if self.prefetch > 0:
             token_iter = AsyncFeeder(self._put_tokens,
                                      prefetch=self.prefetch)(host_iter)
         else:  # synchronous baseline (measured in lm_feeder_bench)
             token_iter = (self._put_tokens(b) for b in host_iter)
+        if self._keeper is not None and not self._keeper.has_snapshot:
+            # Initial last-good snapshot (all ranks — see StateKeeper).
+            self._keeper.update(self.state, start)
+        lr_val = None  # cached: jnp.float32() only when the value changes
+        lr = jnp.float32(self.lr)
         try:
             meters.restart_clock()
-            for i in range(steps):
+            for i in range(start, steps):
                 # print_freq cadence: the cross-process agreement collective
                 # (see utils/preempt.py) must run at the same step on every
                 # rank, and stays off the per-step hot path.
@@ -662,13 +774,23 @@ class LMTrainer:
                         and self._preempt_agreed()):
                     print(f"=> preemption signal: stopping at step {i}",
                           flush=True)
+                    self.obs.log_event("preempt", step=i)
                     preempted = True
                     break
+                if self.chaos is not None:
+                    self.chaos.on_step(self, i)
                 tokens = next(token_iter)
-                if self.lr_schedule is not None:
-                    lr = jnp.float32(self.lr_schedule(i))
+                if self.chaos is not None:
+                    tokens = self.chaos.on_batch(i, tokens)
+                val = (self.lr_schedule(i)
+                       if self.lr_schedule is not None else self.lr)
+                if self.ft_guard is not None:
+                    val = val * self.ft_guard.lr_scale
+                if val != lr_val:
+                    lr_val, lr = val, jnp.float32(val)
                 with scope("lm_step"):
                     self.state, metrics = self.step_fn(self.state, tokens, lr)
+                completed = i + 1
                 dt = meters.update(metrics, self.batch_size)
                 self.obs.log_step(
                     i, step_time=dt, n_items=tokens_per_step, lr=lr,
@@ -677,6 +799,27 @@ class LMTrainer:
                 if self.hb is not None:
                     self.hb.beat(i)
                 meters.maybe_display(i, print_freq)
+                at_save = (self.save_steps > 0
+                           and completed % self.save_steps == 0)
+                if self.ft_guard is not None:
+                    # Lazy-sync policy: flags buffer unconverted and drain
+                    # every check_every steps — forced at a save boundary so
+                    # a snapshot never races an undetected divergence.
+                    rollback = self.ft_guard.observe(
+                        i, metrics.get("nonfinite"))
+                    if at_save:
+                        rollback = self.ft_guard.drain() or rollback
+                    if rollback:
+                        self._rollback(i)
+                    # A flagged streak means the current state is suspect —
+                    # don't refresh the last-good snapshot from it.
+                    at_save = at_save and self.ft_guard.consecutive == 0
+                if at_save:
+                    if self._keeper is not None:
+                        self._keeper.update(self.state, completed)
+                    if self.checkpoint_dir:
+                        self._save_checkpoint(completed)
+                        meters.restart_clock()  # exclude ckpt I/O from meter
                 if (
                     self._eval_fn is not None
                     and self.eval_every > 0
@@ -687,6 +830,11 @@ class LMTrainer:
                     meters.restart_clock()  # eval must not pollute the meter
                 else:
                     final_ppl = None
+            if self.ft_guard is not None and self.ft_guard.drain():
+                # Trailing flags buffered past the last cadence point must
+                # resolve before the end-of-fit checkpoint can capture a
+                # diverged state.
+                self._rollback(completed)
         finally:
             token_iter.close()  # unblocks the producer on early exit
             if self.hb is not None:
@@ -705,15 +853,7 @@ class LMTrainer:
             self.best_ppl = min(self.best_ppl, final_ppl)
         last_loss = meters["loss"].val  # end-of-training loss, not run avg
         if self.checkpoint_dir:
-            from pytorch_distributed_tpu.train.checkpoint import save_checkpoint
-
-            # ALL ranks call: save_checkpoint gathers sharded leaves with a
-            # cross-process collective before its primary guard — gating the
-            # call itself on is_primary would deadlock multi-host TP/SP runs.
-            # best_acc1 slot carries the best perplexity for the LM family.
-            save_checkpoint(self.checkpoint_dir, self.state, 0,
-                            "transformer_lm",
-                            self.best_ppl if self._eval_fn is not None else 0.0,
-                            is_best=is_best,
-                            is_primary=self.is_primary)
+            # End-of-fit checkpoint; its ft record carries the exact
+            # completed-step count, so a preempted run resumes mid-stream.
+            self._save_checkpoint(completed, is_best=is_best)
         return last_loss

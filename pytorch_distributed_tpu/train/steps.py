@@ -46,6 +46,27 @@ def tree_l2_norm(tree) -> jnp.ndarray:
     ))
 
 
+def nonfinite_flag(loss: jnp.ndarray, grad_norm: jnp.ndarray) -> jnp.ndarray:
+    """1.0 when loss or the global grad norm is NaN/inf, else 0.0 — the
+    divergence-guard observable (ft/divergence.py).  The grad norm covers
+    gradient overflow the loss alone misses (f32 loss can stay finite while
+    a bf16 backward has already produced infs)."""
+    ok = jnp.logical_and(jnp.isfinite(loss), jnp.isfinite(grad_norm))
+    return jnp.logical_not(ok).astype(jnp.float32)
+
+
+def gate_update(bad: jnp.ndarray, old_tree, new_tree):
+    """Select ``old_tree`` leaf-wise when ``bad`` (a 0/1 scalar) is set —
+    the in-graph skip that keeps a non-finite batch's update out of the
+    weights entirely, with no host round-trip.  ``jnp.where`` on a
+    replicated scalar predicate compiles to a select XLA fuses into the
+    optimizer; sharded leaves keep their layout."""
+    pred = bad > 0
+    return jax.tree_util.tree_map(
+        lambda old, new: jnp.where(pred, old, new), old_tree, new_tree
+    )
+
+
 def _forward_and_sums(model, params, batch_stats, batch: Batch, train: bool,
                       dropout_rng=None):
     """Weighted-sum loss/metric numerators + weight count (exact over padding)."""
@@ -85,6 +106,7 @@ def make_train_step(
     tx=None,
     accum_steps: int = 1,
     log_norms: bool = False,
+    guard_nonfinite: bool = False,
 ) -> Callable[[TrainState, Batch, jnp.ndarray], Tuple[TrainState, Metrics]]:
     """Build the jitted train step for ``mesh``.
 
@@ -120,6 +142,13 @@ def make_train_step(
     MetricsLogger).  Off by default: the per-leaf reductions measurably
     lengthen XLA compiles, so the cost is only paid when a metrics sink is
     actually attached (Trainer enables it with ``--metrics-jsonl``).
+
+    ``guard_nonfinite``: compute a ``nonfinite`` flag from loss + global
+    grad norm and gate the whole update (params, momentum, BN stats) on it
+    inside the compiled step — a NaN/inf batch is structurally skipped
+    (state passes through unchanged except the step counter) and the flag
+    lands in the metrics as a lazily-converted device scalar for the host
+    ``DivergenceGuard`` policy (ft/divergence.py).  ``--nan-guard``.
 
     BatchNorm semantics differ deliberately, matching each formulation's GPU
     ancestor: GSPMD BN normalizes over the *global* batch (SyncBN — XLA
@@ -257,10 +286,18 @@ def make_train_step(
             "acc1": jax.lax.psum(c1, data_axis) * 100.0 / gcount,
             "acc5": jax.lax.psum(c5, data_axis) * 100.0 / gcount,
         }
+        # Synced grads are identical on every shard, so the per-shard
+        # norm IS the global norm — no extra collective.
+        gnorm = (tree_l2_norm(grads)
+                 if (log_norms or guard_nonfinite) else None)
+        if guard_nonfinite:
+            bad = nonfinite_flag(metrics["loss"], gnorm)
+            new_params = gate_update(bad, state.params, new_params)
+            new_momentum = gate_update(bad, state.momentum, new_momentum)
+            new_stats = gate_update(bad, state.batch_stats, new_stats)
+            metrics["nonfinite"] = bad
         if log_norms:
-            # Synced grads are identical on every shard, so the per-shard
-            # norm IS the global norm — no extra collective.
-            metrics["grad_norm"] = tree_l2_norm(grads)
+            metrics["grad_norm"] = gnorm
             metrics["param_norm"] = tree_l2_norm(new_params)
         return (
             TrainState(state.step + 1, new_params, new_stats, new_momentum),
@@ -285,8 +322,16 @@ def make_train_step(
             "acc1": c1 * 100.0 / count,
             "acc5": c5 * 100.0 / count,
         }
+        gnorm = (tree_l2_norm(grads)
+                 if (log_norms or guard_nonfinite) else None)
+        if guard_nonfinite:
+            bad = nonfinite_flag(metrics["loss"], gnorm)
+            new_params = gate_update(bad, state.params, new_params)
+            new_momentum = gate_update(bad, state.momentum, new_momentum)
+            new_stats = gate_update(bad, state.batch_stats, new_stats)
+            metrics["nonfinite"] = bad
         if log_norms:
-            metrics["grad_norm"] = tree_l2_norm(grads)
+            metrics["grad_norm"] = gnorm
             metrics["param_norm"] = tree_l2_norm(new_params)
         return (
             TrainState(state.step + 1, new_params, new_stats, new_momentum),

@@ -54,15 +54,20 @@ class Trainer:
         data_axis: str = "data",
         tx=None,
         preempt=None,
+        chaos=None,
     ):
         """``tx``: optional optax GradientTransformation replacing the
         default torch-parity SGD (see train/steps.py docstring).
 
         ``preempt``: optional ``utils.preempt.PreemptionGuard`` (already
-        installed) polled between steps; ``fit()`` installs a SIGTERM guard
-        by default when none is given."""
+        installed) polled between steps; ``fit()`` installs a guard for
+        ``cfg.preempt_signals`` (default SIGTERM) when none is given.
+
+        ``chaos``: optional ``ft.chaos`` injector schedule called once per
+        train step (fault-injection drills and the survival tests)."""
         self.cfg = cfg
         self.preempt = preempt
+        self.chaos = chaos
         self._agree = None  # built lazily (PreemptionAgreement over the mesh)
         self.ctx = ctx or DistContext(
             jax.process_index(), jax.process_count(), None
@@ -148,15 +153,51 @@ class Trainer:
         if cfg.pretrained:
             self._load_pretrained()
 
+        # Divergence guard + last-good snapshot (ft/): policy over the
+        # in-graph nonfinite flag the step emits under --nan-guard.
+        self.ft_guard = None
+        self._keeper = None
+        if getattr(cfg, "nan_guard", False):
+            from pytorch_distributed_tpu.ft import DivergenceGuard, StateKeeper
+
+            self._keeper = StateKeeper()
+            # obs wired below (constructed later in __init__); attached then.
+            self.ft_guard = DivergenceGuard(
+                rollback_k=cfg.ft_rollback_k,
+                check_every=cfg.ft_check_every,
+                lr_backoff=cfg.ft_lr_backoff)
+
         self.best_acc1 = 0.0
+        self._resume_step = 0    # step-in-epoch offset for the first epoch
+        self._resume_global = 0
         if cfg.resume:
             self.state, meta = load_checkpoint(cfg.resume, self.state)
             self.best_acc1 = float(meta["best_acc1"])
+            ft = meta["ft"]
+            self._resume_step = int(ft["step"])
+            self._resume_global = int(ft["global_step"])
+            if self.ft_guard is not None:
+                self.ft_guard.lr_scale = float(ft["lr_scale"])
+            if self._resume_step > 0 and int(ft["sampler_seed"]) != (
+                    cfg.seed if cfg.seed is not None else 0):
+                import warnings
+
+                warnings.warn(
+                    f"resuming mid-epoch with --seed "
+                    f"{cfg.seed if cfg.seed is not None else 0} but the "
+                    f"checkpoint's sampler ran with seed "
+                    f"{int(ft['sampler_seed'])}: the shuffle permutation "
+                    f"differs, so the resumed epoch will not be "
+                    f"sample-exact", stacklevel=2)
             if cfg.start_epoch == 0:
-                cfg.start_epoch = int(meta["epoch"]) + 1
+                # Mid-epoch checkpoint (ft step > 0): rerun the SAME epoch
+                # from that step; epoch-boundary checkpoint: next epoch.
+                cfg.start_epoch = int(meta["epoch"]) + (
+                    0 if self._resume_step > 0 else 1)
             print(
                 f"=> resumed {meta['arch']} from '{cfg.resume}' "
-                f"(epoch {meta['epoch']}, best_acc1 {self.best_acc1:.3f})"
+                f"(epoch {meta['epoch']}, step {self._resume_step}, "
+                f"best_acc1 {self.best_acc1:.3f})"
             )
 
         # Validate accumulation settings BEFORE building the step — an invalid
@@ -190,6 +231,7 @@ class Trainer:
             # them — the reductions lengthen compiles, so observability
             # costs nothing when off.
             log_norms=bool(cfg.metrics_jsonl),
+            guard_nonfinite=bool(getattr(cfg, "nan_guard", False)),
         )
         self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
@@ -203,7 +245,11 @@ class Trainer:
         self.hb = (HeartbeatWriter(cfg.hb_dir, self.ctx.process_index,
                                    interval_s=cfg.hb_interval_s)
                    if cfg.hb_dir else None)
-        self._global_step = 0  # monotonically counts logged train steps
+        if self.ft_guard is not None:
+            self.ft_guard.obs = self.obs  # ft_event records → metrics JSONL
+        # Monotonic logged-train-step counter; a resume restores it so the
+        # metrics JSONL step axis continues instead of restarting at 0.
+        self._global_step = self._resume_global
 
     def _load_pretrained(self) -> None:
         """``--pretrained`` parity (reference distributed.py:134-136 loads zoo
@@ -318,7 +364,55 @@ class Trainer:
         )
 
     # ----------------------------------------------------------------- train
-    def train_epoch(self, epoch: int, profiler: Optional[ProfileWindow] = None) -> None:
+    def _ft_record(self, epoch: int, step_in_epoch: int) -> dict:
+        return {
+            "step": int(step_in_epoch),
+            "global_step": int(self._global_step),
+            "sampler_seed": int(self.train_sampler.seed),
+            "sampler_epoch": int(epoch),
+            "lr_scale": (self.ft_guard.lr_scale
+                         if self.ft_guard is not None else 1.0),
+        }
+
+    def _save_step_checkpoint(self, epoch: int, step_in_epoch: int) -> None:
+        """Mid-epoch (step-granular) checkpoint: --save-steps cadence and
+        the preemption path.  ``step_in_epoch`` counts *completed* steps of
+        ``epoch``; 0 completed steps degrade to the epoch-boundary form
+        (previous epoch, step 0) so resume semantics stay uniform."""
+        cfg = self.cfg
+        if step_in_epoch > 0:
+            e, ft = epoch, self._ft_record(epoch, step_in_epoch)
+        else:
+            e, ft = epoch - 1, self._ft_record(epoch - 1, 0)
+        save_checkpoint(
+            cfg.checkpoint_dir, self.state, e, cfg.arch, self.best_acc1,
+            is_best=False, is_primary=self.ctx.is_primary,
+            backend=cfg.ckpt_backend, metric=0.0, ft=ft,
+        )
+        if self._keeper is not None:
+            self._keeper.update(self.state, self._global_step)
+
+    def _rollback(self, epoch: int, step_in_epoch: int) -> float:
+        """Divergence recovery: restore the last-good host snapshot (the
+        jitted step's in_shardings re-shard it next call) and back off the
+        LR scale.  Returns the new scale for the caller's lr rebuild."""
+        restored = None
+        if self._keeper is not None and self._keeper.has_snapshot:
+            self.state = self._keeper.restore()
+            restored = self._keeper.step
+        scale = self.ft_guard.note_rollback(self._global_step, restored)
+        print(f"=> divergence rollback at epoch {epoch} step "
+              f"{step_in_epoch}: restored state from global step "
+              f"{restored}, lr scale now {scale:g}", flush=True)
+        return scale
+
+    def train_epoch(
+        self, epoch: int, profiler: Optional[ProfileWindow] = None,
+        start_step: int = 0,
+    ) -> Tuple[int, bool]:
+        """One epoch from ``start_step`` (0 except the first epoch of a
+        mid-epoch resume).  Returns ``(completed_steps, preempted)`` so the
+        epoch driver knows exactly where a preemption landed."""
         cfg = self.cfg
         if cfg.lr_schedule == "cosine":
             lr = cosine_lr(cfg.lr, epoch, cfg.epochs,
@@ -337,9 +431,15 @@ class Trainer:
         )
         self.train_loader.set_epoch(epoch)
         self.val_sampler.set_epoch(epoch)
-        lr_arr = jnp.float32(lr)
+        scale = self.ft_guard.lr_scale if self.ft_guard is not None else 1.0
+        lr_arr = jnp.float32(lr * scale)
+        completed = start_step
+        if self._keeper is not None and not self._keeper.has_snapshot:
+            self._keeper.update(self.state, self._global_step)
         meters.restart_clock()
-        for i, batch in enumerate(self.feeder(iter(self.train_loader))):
+        for i, batch in enumerate(
+                self.feeder(self.train_loader.iter_batches(start_step)),
+                start=start_step):
             if profiler is not None:
                 profiler.step_begin(epoch, i)
             # Polled at print_freq cadence so the agreement collective (a
@@ -348,10 +448,14 @@ class Trainer:
             # different boundaries) stays off the per-step hot path.
             if (self.preempt is not None and i % cfg.print_freq == 0
                     and self._preempt_agreed()):
-                break
+                return completed, True
+            if self.chaos is not None:
+                self.chaos.on_step(self, i)
+                batch = self.chaos.on_batch(i, batch)
             n = self.cfg.batch_size
             with scope("train_step"):
                 self.state, metrics = self.train_step(self.state, batch, lr_arr)
+            completed = i + 1
             # Unready device scalars: meters and the metrics logger convert
             # lazily, so no per-step host sync (SURVEY.md §7.4 item 1).
             dt = meters.update(metrics, n)
@@ -364,6 +468,29 @@ class Trainer:
                 self.hb.beat(self._global_step)
             self._global_step += 1
             meters.maybe_display(i, cfg.print_freq)
+            at_save = (cfg.save_steps > 0 and completed % cfg.save_steps == 0
+                       and completed < len(self.train_loader))
+            if self.ft_guard is not None:
+                # Flags buffer unconverted; drained every ft_check_every
+                # steps (one amortized host sync) — forced before a
+                # snapshot so it never races an undetected divergence.
+                rollback = self.ft_guard.observe(
+                    self._global_step - 1, metrics.get("nonfinite"))
+                if at_save:
+                    rollback = self.ft_guard.drain() or rollback
+                if rollback:
+                    lr_arr = jnp.float32(lr * self._rollback(epoch, i))
+                # A flagged streak means the current state is suspect —
+                # don't refresh the last-good snapshot/checkpoint from it.
+                at_save = at_save and self.ft_guard.consecutive == 0
+            if at_save:
+                self._save_step_checkpoint(epoch, completed)
+                meters.restart_clock()  # exclude checkpoint I/O from meter
+        if self.ft_guard is not None and self.ft_guard.drain():
+            # Trailing flags (buffered past the last cadence point) must be
+            # resolved before the epoch-end checkpoint can capture them.
+            self._rollback(epoch, completed)
+        return completed, False
 
     # ------------------------------------------------------------------ eval
     def validate(self) -> float:
@@ -416,17 +543,23 @@ class Trainer:
             self._telemetry_on = True
         import threading
 
-        from pytorch_distributed_tpu.utils.preempt import PreemptionGuard
+        from pytorch_distributed_tpu.utils.preempt import (
+            PreemptionGuard,
+            parse_signals,
+        )
 
-        # Default guard: SIGTERM (the pod-reclaim grace signal) triggers a
-        # checkpoint-and-exit at the next safe boundary (SURVEY §5.3
-        # upgrade).  Callers may pass their own guard to Trainer().  Signal
-        # handlers are main-thread-only in Python, so off-main-thread fit()
-        # callers simply run unguarded unless they pass one in.
+        # Default guard: cfg.preempt_signals (SIGTERM, the pod-reclaim
+        # grace signal, by default; '--preempt-signals term,int' adds
+        # Ctrl-C for interactive runs) triggers a checkpoint-and-exit at
+        # the next safe boundary (SURVEY §5.3 upgrade).  Callers may pass
+        # their own guard to Trainer().  Signal handlers are
+        # main-thread-only in Python, so off-main-thread fit() callers
+        # simply run unguarded unless they pass one in.
         installed = (self.preempt is None
                      and threading.current_thread() is threading.main_thread())
         if installed:
-            self.preempt = PreemptionGuard().install()
+            self.preempt = PreemptionGuard(
+                signals=parse_signals(cfg.preempt_signals)).install()
         try:
             return self._fit_epochs()
         finally:
@@ -458,21 +591,30 @@ class Trainer:
         for epoch in range(cfg.start_epoch, cfg.epochs):
             self.obs.epoch_start()
             profiler.epoch_begin(epoch)
-            self.train_epoch(epoch, profiler)
+            # Mid-epoch resume: the first epoch starts at the checkpointed
+            # step offset — the sampler's (seed, epoch) permutation
+            # regenerates the identical index stream, and the loader skips
+            # the already-trained prefix by index arithmetic.
+            start_step = (self._resume_step
+                          if epoch == cfg.start_epoch else 0)
+            completed, preempted = self.train_epoch(epoch, profiler,
+                                                    start_step=start_step)
             jax.block_until_ready(self.state.params)
             if profiler.epoch_end():
                 print(f"=> wrote profiler trace to '{cfg.profile_dir}'")
-            if self.preempt is not None and self._preempt_agreed():
-                # Preempted mid-epoch: the epoch is incomplete, so record the
-                # previous one — resume reruns this epoch from its start.
-                print(f"=> preemption signal: checkpointing at epoch {epoch} "
-                      f"and exiting", flush=True)
-                save_checkpoint(
-                    cfg.checkpoint_dir, self.state, epoch - 1, cfg.arch,
-                    self.best_acc1, is_best=False,
-                    is_primary=self.ctx.is_primary, backend=cfg.ckpt_backend,
-                    metric=0.0,
-                )
+            if not preempted and (self.preempt is not None
+                                  and self._preempt_agreed()):
+                preempted = True  # signal landed between last poll and here
+            if preempted:
+                # Step-granular preemption checkpoint: the ft record pins
+                # the exact completed step, so --resume continues from it —
+                # no epoch rerun (the pre-FT behavior threw away up to a
+                # whole epoch here).
+                print(f"=> preemption signal: checkpointing at epoch "
+                      f"{epoch} step {completed} and exiting", flush=True)
+                self.obs.log_event("preempt", step=self._global_step,
+                                   epoch=epoch, step_in_epoch=completed)
+                self._save_step_checkpoint(epoch, completed)
                 break
             acc1 = self.validate()
             elapsed = self.obs.epoch_end()  # drives the registered epoch CSV
@@ -489,7 +631,10 @@ class Trainer:
                 is_primary=self.ctx.is_primary,
                 backend=cfg.ckpt_backend,
                 metric=acc1,  # this epoch's own score (orbax best retention)
+                ft=self._ft_record(epoch, 0),
             )
+            if self._keeper is not None:
+                self._keeper.update(self.state, self._global_step)
         if cfg.ckpt_backend == "orbax":
             from pytorch_distributed_tpu.train.checkpoint import (
                 wait_for_async_saves,

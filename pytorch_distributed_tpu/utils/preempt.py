@@ -20,6 +20,41 @@ import threading
 from typing import Dict, Tuple
 
 
+def parse_signals(spec: str) -> Tuple[int, ...]:
+    """``'term,int'`` / ``'SIGTERM, SIGINT'`` / ``'15'`` → signal numbers.
+
+    The ``--preempt-signals`` parser: SIGTERM is every platform's reclaim
+    grace signal; SIGINT is the opt-in for interactive runs where Ctrl-C
+    should checkpoint-and-exit instead of stack-tracing (SIGKILL is
+    rejected — it cannot be trapped; that case is what ``--save-steps``
+    cadence checkpoints are for)."""
+    out = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if tok.isdigit():
+            num = int(tok)
+        else:
+            name = tok.upper()
+            if not name.startswith("SIG"):
+                name = "SIG" + name
+            try:
+                num = int(getattr(signal, name))
+            except AttributeError:
+                raise ValueError(
+                    f"unknown signal {tok!r} in --preempt-signals "
+                    f"{spec!r}") from None
+        if num == int(signal.SIGKILL):
+            raise ValueError(
+                "--preempt-signals: SIGKILL cannot be trapped; rely on "
+                "--save-steps cadence checkpoints for kill-without-grace")
+        out.append(num)
+    if not out:
+        raise ValueError(f"--preempt-signals {spec!r} names no signals")
+    return tuple(dict.fromkeys(out))  # dedup, keep order
+
+
 class PreemptionGuard:
     """Flag-on-signal with handler chaining.
 

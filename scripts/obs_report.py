@@ -86,6 +86,34 @@ def summarize_metrics(records: List[dict]) -> List[str]:
     return lines
 
 
+def summarize_ft_events(records: List[dict]) -> List[str]:
+    """Fold the FT subsystem's structured ``ft_event`` records (skips,
+    rollbacks, preemptions — ft/divergence.py and the trainers) into the
+    summary: per-kind counts with the steps involved, plus the final LR
+    backoff scale after the last rollback."""
+    events = [r for r in records if "ft_event" in r]
+    if not events:
+        return []
+    by_kind: Dict[str, List[dict]] = {}
+    for e in events:
+        by_kind.setdefault(str(e["ft_event"]), []).append(e)
+    lines = ["== ft events =="]
+    for kind in sorted(by_kind):
+        evs = by_kind[kind]
+        steps = [e["step"] for e in evs if "step" in e]
+        shown = ",".join(str(s) for s in steps[:8])
+        if len(steps) > 8:
+            shown += ",…"
+        lines.append(f"  {kind:<16}  {len(evs)}x"
+                     + (f"  steps {shown}" if steps else ""))
+    rollbacks = by_kind.get("rollback", [])
+    scales = [e["lr_scale"] for e in rollbacks if "lr_scale" in e]
+    if scales:
+        lines.append(f"  lr scale          {scales[-1]:g} after "
+                     f"{len(rollbacks)} rollback(s)")
+    return lines
+
+
 def summarize_telemetry(path: str) -> List[str]:
     """Per-device peak/limit from the ``timestamp,index,bytes_limit,
     bytes_in_use,peak_bytes`` CSV (no header in the statistics.sh contract)."""
@@ -141,8 +169,11 @@ def summarize_heartbeats(hb_dir: str, now: Optional[float],
 def report(args) -> str:
     sections = []
     if args.metrics_jsonl:
+        records = load_metrics(args.metrics_jsonl)
         sections.append("== steps ==")
-        sections += summarize_metrics(load_metrics(args.metrics_jsonl))
+        sections += summarize_metrics(
+            [r for r in records if "ft_event" not in r])
+        sections += summarize_ft_events(records)
     if args.telemetry_csv:
         sections.append("== devices ==")
         sections += summarize_telemetry(args.telemetry_csv)
@@ -172,6 +203,11 @@ def _selftest() -> int:
                              n_items=128, lr=0.1,
                              scalars={"loss": 2.0 - 0.05 * i,
                                       "grad_norm": 1.0 + 0.1 * i})
+            # ft_event records interleave in the same JSONL (ft/)
+            log.log_event("skip", step=7, consecutive=1)
+            log.log_event("skip", step=8, consecutive=2)
+            log.log_event("rollback", step=9, restored_step=5, lr_scale=0.5)
+            log.log_event("preempt", step=19)
         # heartbeats: pid 0 current, pid 1 lagging AND stale
         hb_dir = os.path.join(d, "hb")
         w0 = HeartbeatWriter(hb_dir, 0, interval_s=0.0)
@@ -192,6 +228,8 @@ def _selftest() -> int:
             now=now, max_step_lag=3, max_beat_age=60.0))
         for needle in ("== steps ==", "steps logged      20", "p95",
                        "throughput", "loss", "grad_norm",
+                       "== ft events ==", "skip", "rollback", "preempt",
+                       "lr scale          0.5 after 1 rollback",
                        "== devices ==", "device 0", "device 1",
                        "== heartbeats ==", "STRAGGLER", "step lag",
                        "beat age"):

@@ -36,7 +36,10 @@ def test_round_trip(tmp_path):
     assert path and os.path.exists(path)
     template = _state(seed=2)  # different values, same structure
     restored, meta = load_checkpoint(path, template)
+    ft = meta.pop("ft")
     assert meta == {"epoch": 7, "arch": "resnet18", "best_acc1": 55.5}
+    # No ft record passed: defaults = epoch-boundary semantics.
+    assert ft["step"] == 0 and ft["lr_scale"] == 1.0
     _tree_equal(restored.params, state.params)
     _tree_equal(restored.momentum, state.momentum)
     _tree_equal(restored.batch_stats, state.batch_stats)
