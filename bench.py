@@ -64,11 +64,29 @@ def _stale_exit_code() -> int:
         return 0
 
 
+def _bench_event(kind: str, **fields) -> None:
+    """Structured staleness trail (scripts/benchlib.py): the same JSONL
+    record schema the obs layer uses, so ``scripts/obs_report.py`` folds
+    the probe's stale reason + last-good timestamp into a run summary.
+    Best-effort — the stdout JSON contract must survive regardless."""
+    try:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from benchlib import bench_event
+
+        bench_event(kind, metric=METRIC, **fields)
+    except Exception:  # noqa: BLE001 — observability never blocks emission
+        pass
+
+
 def _emit_failure(error: str) -> "NoReturn":
     """Last resort: report last-known-good (marked stale) instead of 0.0."""
     try:
         with open(LKG_PATH) as f:
             lkg = json.load(f)
+        _bench_event("stale", reason=error,
+                     last_good=lkg.get("captured_at"),
+                     value=lkg.get("value"))
         _emit({
             "metric": METRIC,
             "value": lkg["value"],
@@ -79,6 +97,7 @@ def _emit_failure(error: str) -> "NoReturn":
             "error": error,
         }, _stale_exit_code())
     except (OSError, KeyError, ValueError):
+        _bench_event("failed", reason=error)
         _emit({"metric": METRIC, "value": 0.0, "unit": UNIT,
                "vs_baseline": 0.0, "error": error}, 1)
 
@@ -148,6 +167,11 @@ def main() -> None:
             try:
                 with open(LKG_PATH) as f:
                     lkg = json.load(f)
+                _bench_event("stale",
+                             reason="backend init hung >240s after probe "
+                                    "success",
+                             last_good=lkg.get("captured_at"),
+                             value=lkg.get("value"))
                 print(json.dumps({
                     "metric": METRIC, "value": lkg["value"], "unit": UNIT,
                     "vs_baseline": lkg["vs_baseline"], "stale": True,

@@ -7,13 +7,37 @@ is three ``.item()`` calls per batch plus a 500 ms nvidia-smi CSV).
   epoch CSV and telemetry sampler hang off one entry point.
 - ``trace``     — ``scope()``/``ProfileWindow``: TraceAnnotation +
   named_scope under one idiom, and epoch/step-windowed profiler capture.
-- ``heartbeat`` — per-process ``{pid, step, t}`` beats to a shared run
-  directory + cross-process straggler detection (stdlib-only monitor).
+- ``heartbeat`` — per-process ``{pid, step, t, ema, last_ft}`` beats to a
+  shared run directory + cross-process straggler detection that tells
+  *slow* ranks from *dead* ones (stdlib-only monitor).
+- ``flops``     — analytic per-step FLOPs/bytes models for the registered
+  model families, cross-checkable against XLA ``cost_analysis()``, a
+  per-chip peak table, and the ``MFUReporter`` that turns step seconds
+  into MFU/HFU fields.
+- ``goodput``   — the goodput/badput ledger over the metrics JSONL
+  (nan-skips, rollback discards, preemption gaps, recompiles, stalls).
+- ``watchdog``  — ``RecompileWatchdog``: jax.monitoring-hooked counter
+  that flags any post-warmup recompilation of a jitted step-fn.
 
 ``scripts/obs_report.py`` folds a run's JSONL + heartbeats + telemetry CSV
-into one human-readable summary.
+into one human-readable summary, and ``--diff A B`` fences two runs
+against each other with PASS/REGRESS verdicts.
 """
 
+from pytorch_distributed_tpu.obs.flops import (
+    MFUReporter,
+    StepCost,
+    device_peak_flops,
+    image_step_cost,
+    lm_step_cost,
+    lm_step_cost_for,
+    xla_step_flops,
+)
+from pytorch_distributed_tpu.obs.goodput import (
+    GoodputTracker,
+    compute_goodput,
+    summarize_goodput,
+)
 from pytorch_distributed_tpu.obs.heartbeat import (
     HeartbeatWriter,
     find_stragglers,
@@ -30,6 +54,7 @@ from pytorch_distributed_tpu.obs.trace import (
     parse_span,
     scope,
 )
+from pytorch_distributed_tpu.obs.watchdog import RecompileWatchdog
 
 __all__ = [
     "REQUIRED_FIELDS",
@@ -42,4 +67,15 @@ __all__ = [
     "annotate",
     "parse_span",
     "ProfileWindow",
+    "StepCost",
+    "MFUReporter",
+    "image_step_cost",
+    "lm_step_cost",
+    "lm_step_cost_for",
+    "xla_step_flops",
+    "device_peak_flops",
+    "GoodputTracker",
+    "compute_goodput",
+    "summarize_goodput",
+    "RecompileWatchdog",
 ]

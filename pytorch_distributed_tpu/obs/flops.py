@@ -1,0 +1,369 @@
+"""Efficiency accounting: analytic per-step FLOPs/bytes models and MFU/HFU.
+
+The obs layer (metrics.py) answers "how fast is each step"; this module
+answers "how much of the hardware that speed represents".  For every
+registered model family it builds an analytic ``StepCost`` — matmul/conv
+core FLOPs for forward + backward + optimizer update, plus a rough HBM
+bytes estimate — and divides achieved FLOP/s by the chip's peak:
+
+- **MFU** uses *model* FLOPs: the algorithmically necessary work (the
+  PaLM-appendix convention).  Recompute taxes do not inflate it.
+- **HFU** uses *hardware* FLOPs: model FLOPs plus rematerialization /
+  fused-CE chunk-recompute work the chips actually execute.  HFU ≥ MFU;
+  the gap IS the recompute tax (e.g. ViT ``remat=True`` trades ~1/3 extra
+  matmuls for activation residency — models/vit.py).
+
+Counting conventions (chosen to match XLA's ``cost_analysis()`` so the
+analytic model can be cross-checked, tests/test_efficiency.py):
+
+- one multiply-add = 2 FLOPs;
+- convolutions exclude padded taps (XLA's HloCostAnalysis counts only
+  valid kernel applications — border pixels cost less);
+- backward = 2x forward for the matmul/conv core (dgrad + wgrad);
+- the SGD update is ~6 FLOPs/param and is **replicated** on every device
+  under data parallelism — ``StepCost.per_device_flops`` accounts for
+  that when comparing against a per-device ``cost_analysis()`` figure;
+- elementwise/transcendental work (BN, layernorm, softmax, rope) is NOT
+  counted: it is a few percent of the core on these families, and XLA
+  books transcendentals separately anyway.  Parity is asserted at +-10%.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+# --------------------------------------------------------------------- peaks
+# Dense-matmul peak per chip, FLOP/s, at the framework's bf16 compute
+# policy (f32 for the v2/v3 generation is half of these — close enough for
+# a utilization denominator).  Keys match jax Device.device_kind prefixes.
+PEAK_FLOPS_PER_CHIP: Dict[str, float] = {
+    "tpu v2": 45e12,
+    "tpu v3": 123e12,
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,   # v5e device_kind spells it out
+    "tpu v5e": 197e12,
+    "tpu v5p": 459e12,
+    "tpu v6e": 918e12,
+    "tpu v6 lite": 918e12,
+}
+
+# CPU-test fallback: a nominal per-"device" figure so MFU math stays finite
+# and deterministic on the simulated CPU mesh (the number is a placeholder,
+# not a measurement — CI asserts plumbing, never CPU utilization).
+CPU_FALLBACK_PEAK = 50e9
+
+
+def device_peak_flops(device=None) -> float:
+    """Peak FLOP/s for one chip.  ``PTD_TPU_PEAK_FLOPS`` overrides (chips
+    this table predates, or a measured-roofline denominator); unknown
+    accelerators fall back to the CPU placeholder rather than failing the
+    run — MFU is observability, not a gate."""
+    env = os.environ.get("PTD_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for prefix, peak in PEAK_FLOPS_PER_CHIP.items():
+        if kind.startswith(prefix):
+            return peak
+    return CPU_FALLBACK_PEAK
+
+
+# ---------------------------------------------------------------- step costs
+@dataclasses.dataclass(frozen=True)
+class StepCost:
+    """Per-optimizer-step cost of one registered model family config.
+
+    ``model_flops``    algorithmic FLOPs (MFU numerator);
+    ``hardware_flops`` incl. remat / fused-CE recompute (HFU numerator);
+    ``bytes``          rough HBM traffic (params+grads+optimizer r/w and
+                       activations twice) — an arithmetic-intensity hint,
+                       not cross-checked;
+    ``update_flops``   optimizer portion (replicated per device under DP);
+    ``params``         parameter count the update estimate used.
+    """
+
+    model_flops: float
+    hardware_flops: float
+    bytes: float
+    update_flops: float
+    params: int
+    breakdown: Dict[str, float]
+
+    def per_device_flops(self, n_devices: int) -> float:
+        """XLA-comparable per-device estimate: the forward/backward core is
+        sharded over the mesh but the optimizer update runs replicated on
+        every device (the declared-DP layout shardlint calls
+        replicated-state)."""
+        n = max(1, int(n_devices))
+        return (self.hardware_flops - self.update_flops) / n + self.update_flops
+
+
+_SGD_FLOPS_PER_PARAM = 6.0  # wd mul-add, momentum mul-add, lr mul + sub
+
+
+def _valid_taps(size: int, k: int, stride: int, pad: int) -> int:
+    """Sum over output positions of in-bounds kernel taps along one spatial
+    dim — the XLA convolution convention (padded taps cost nothing)."""
+    out = (size + 2 * pad - k) // stride + 1
+    total = 0
+    for o in range(out):
+        start = o * stride - pad
+        total += max(0, min(start + k, size) - max(start, 0))
+    return total
+
+
+class _Walk:
+    """Accumulator the per-family shape walks share."""
+
+    def __init__(self):
+        self.fwd = 0.0        # forward core FLOPs per sample
+        self.params = 0
+        self.act_elts = 0.0   # activation elements produced per sample
+
+    def conv(self, h, w, cin, cout, kh, kw, stride=1, pad=None, groups=1,
+             bn=True):
+        if pad is None:
+            pad = kh // 2
+        th = _valid_taps(h, kh, stride, pad)
+        tw = _valid_taps(w, kw, stride, pad)
+        ho = (h + 2 * pad - kh) // stride + 1
+        wo = (w + 2 * pad - kw) // stride + 1
+        self.fwd += 2.0 * cout * (cin / groups) * th * tw
+        self.params += kh * kw * (cin // groups) * cout + (2 * cout if bn else 0)
+        self.act_elts += ho * wo * cout
+        return ho, wo
+
+    def dense(self, n_rows, cin, cout, params=True):
+        self.fwd += 2.0 * n_rows * cin * cout
+        if params:
+            self.params += cin * cout + cout
+        self.act_elts += n_rows * cout
+
+
+# ResNet-family table mirroring models/resnet.py's functools.partial zoo:
+# (stage_sizes, block, groups, base_width).
+_RESNET_CFGS: Dict[str, tuple] = {
+    "resnet18": ([2, 2, 2, 2], "basic", 1, 64),
+    "resnet34": ([3, 4, 6, 3], "basic", 1, 64),
+    "resnet50": ([3, 4, 6, 3], "bottleneck", 1, 64),
+    "resnet101": ([3, 4, 23, 3], "bottleneck", 1, 64),
+    "resnet152": ([3, 8, 36, 3], "bottleneck", 1, 64),
+    "wide_resnet50_2": ([3, 4, 6, 3], "bottleneck", 1, 128),
+    "wide_resnet101_2": ([3, 4, 23, 3], "bottleneck", 1, 128),
+    "resnext50_32x4d": ([3, 4, 6, 3], "bottleneck", 32, 4),
+    "resnext101_32x8d": ([3, 4, 23, 3], "bottleneck", 32, 8),
+}
+
+# ViT table mirroring models/vit.py: (patch, d_model, layers, heads, mlp).
+_VIT_CFGS: Dict[str, tuple] = {
+    "vit_b_16": (16, 768, 12, 12, 3072),
+    "vit_b_32": (32, 768, 12, 12, 3072),
+    "vit_l_16": (16, 1024, 24, 16, 4096),
+}
+
+
+def _resnet_walk(arch: str, image_size: int, num_classes: int) -> _Walk:
+    stage_sizes, block, groups, base_width = _RESNET_CFGS[arch]
+    exp = 1 if block == "basic" else 4
+    wk = _Walk()
+    h, w = wk.conv(image_size, image_size, 3, 64, 7, 7, stride=2, pad=3)
+    h, w = (h + 2 - 3) // 2 + 1, (w + 2 - 3) // 2 + 1  # maxpool 3x3 s2 p1
+    c = 64
+    for i, nblk in enumerate(stage_sizes):
+        filt = 64 * 2 ** i
+        for j in range(nblk):
+            s = 2 if (i > 0 and j == 0) else 1
+            if block == "basic":
+                h2, w2 = wk.conv(h, w, c, filt, 3, 3, stride=s)
+                wk.conv(h2, w2, filt, filt, 3, 3)
+            else:
+                width = int(filt * base_width / 64) * groups
+                wk.conv(h, w, c, width, 1, 1, pad=0)
+                h2, w2 = wk.conv(h, w, width, width, 3, 3, stride=s,
+                                 groups=groups)
+                wk.conv(h2, w2, width, filt * exp, 1, 1, pad=0)
+            if c != filt * exp or s > 1:
+                wk.conv(h, w, c, filt * exp, 1, 1, stride=s, pad=0)
+            h, w, c = h2, w2, filt * exp
+    wk.dense(1, c, num_classes)
+    return wk
+
+
+def _transformer_core(wk: _Walk, tokens: float, d: int, mlp: int,
+                      seq: float) -> None:
+    """One transformer block's matmul core for ``tokens`` rows attending
+    over a ``seq``-long context (dense attention: causal masking does not
+    reduce the einsums XLA emits)."""
+    wk.dense(tokens, d, 3 * d, params=False)      # qkv
+    wk.params += 3 * d * d                        # transformer.py: no bias
+    wk.fwd += 4.0 * tokens * seq * d              # scores + weighted sum
+    wk.act_elts += tokens * seq                   # score matrix (per head sum)
+    wk.dense(tokens, d, d, params=False)          # proj
+    wk.params += d * d
+    wk.dense(tokens, d, mlp)                      # fc1
+    wk.dense(tokens, mlp, d)                      # fc2
+    wk.params += 4 * d                            # two layernorms
+
+
+def _vit_walk(arch: str, image_size: int, num_classes: int) -> _Walk:
+    patch, d, layers, _heads, mlp = _VIT_CFGS[arch]
+    grid = image_size // patch
+    tokens = grid * grid + 1  # + class token
+    wk = _Walk()
+    wk.dense(grid * grid, patch * patch * 3, d)   # patch embed
+    wk.params += d + tokens * d                   # cls token + pos embeddings
+    for _ in range(layers):
+        _transformer_core(wk, tokens, d, mlp, tokens)
+    wk.dense(1, d, num_classes)                   # head (class token only)
+    return wk
+
+
+def _finish(wk: _Walk, batch: int, recompute_fwd: float = 0.0,
+            breakdown: Optional[Dict[str, float]] = None) -> StepCost:
+    fwd = wk.fwd * batch
+    update = _SGD_FLOPS_PER_PARAM * wk.params
+    model = 3.0 * fwd + update
+    hardware = model + recompute_fwd * batch
+    # Rough bytes: params+grads+momentum r/w (f32) + activations twice
+    # (produce in fwd, re-read in bwd) at 4 bytes — an intensity hint only.
+    nbytes = 6.0 * 4 * wk.params + 2.0 * 4 * wk.act_elts * batch
+    bd = {"forward": fwd, "backward": 2.0 * fwd, "update": update,
+          "recompute": recompute_fwd * batch}
+    if breakdown:
+        bd.update(breakdown)
+    return StepCost(model_flops=model, hardware_flops=hardware, bytes=nbytes,
+                    update_flops=update, params=wk.params, breakdown=bd)
+
+
+def image_step_cost(arch: str, batch: int, image_size: int,
+                    num_classes: int = 1000, remat: bool = False) -> StepCost:
+    """Analytic train-step cost for the image families with an analytic
+    model (ResNet zoo + ViT).  Other archs raise — silently guessing a
+    denominator would make MFU numbers lies."""
+    if arch in _RESNET_CFGS:
+        wk = _resnet_walk(arch, image_size, num_classes)
+        recompute = 0.0
+    elif arch in _VIT_CFGS:
+        wk = _vit_walk(arch, image_size, num_classes)
+        # nn.remat on every encoder block replays the block forwards in
+        # backward: ~+1x forward of the block stack (the ~1/3-extra-matmul
+        # tax noted at models/vit.py).
+        recompute = wk.fwd if remat else 0.0
+    else:
+        raise ValueError(
+            f"no analytic FLOPs model for arch {arch!r}; --mfu supports "
+            f"{sorted(_RESNET_CFGS) + sorted(_VIT_CFGS)} (obs/flops.py)")
+    return _finish(wk, batch, recompute_fwd=recompute)
+
+
+def lm_step_cost(vocab_size: int, d_model: int, n_layers: int, batch: int,
+                 seq_len: int, mlp_ratio: int = 4, fused_ce: bool = False,
+                 remat: bool = False, moe_experts: int = 0,
+                 moe_top_k: int = 1) -> StepCost:
+    """Analytic train-step cost for the transformer-LM family.
+
+    ``fused_ce``: the chunked tied-head+CE backward (ops/fused_ce.py)
+    recomputes each chunk's logits block instead of stashing the [T, V]
+    tensor — +2·T·D·V hardware FLOPs, identical model FLOPs; the
+    replicated/dp/tp sharding variants all do the same global arithmetic.
+    ``remat``: block rematerialization (+1x block-stack forward, hardware
+    only).  The pipeline schedules (gpipe/1f1b/interleaved) run the same
+    math as the plain stack, so no schedule parameter: FLOPs don't change,
+    only the bubble does — and the bubble is a *time* effect MFU already
+    sees through the step-time denominator."""
+    d, T = d_model, batch * seq_len
+    wk = _Walk()
+    wk.params += vocab_size * d                   # tied embedding
+    block_fwd0 = wk.fwd
+    for _ in range(n_layers):
+        if moe_experts > 1:
+            wk.dense(T // batch, d, 3 * d, params=False)
+            wk.params += 3 * d * d
+            wk.fwd += 4.0 * (T // batch) * seq_len * d
+            wk.dense(T // batch, d, d, params=False)
+            wk.params += d * d
+            # router + top_k expert MLPs per token; expert params stack E-wide
+            wk.dense(T // batch, d, moe_experts, params=False)
+            wk.params += d * moe_experts
+            wk.fwd += moe_top_k * (2.0 * (T // batch) * d * mlp_ratio * d * 2)
+            wk.params += moe_experts * (2 * d * mlp_ratio * d
+                                        + mlp_ratio * d + d)
+            wk.params += 4 * d
+        else:
+            _transformer_core(wk, T // batch, d, mlp_ratio * d, seq_len)
+    wk.params += 2 * d                            # final layernorm
+    block_fwd = wk.fwd - block_fwd0               # per-sample block stack
+    # Head: tied embed.attend over the full sequence unfused; the fused
+    # path projects only the seq_len-1 loss rows.
+    head_rows = (seq_len - 1) if fused_ce else seq_len
+    wk.dense(head_rows, d, vocab_size, params=False)
+    recompute = 0.0
+    if remat:
+        recompute += block_fwd
+    if fused_ce:
+        recompute += 2.0 * (seq_len - 1) * d * vocab_size
+    return _finish(wk, batch, recompute_fwd=recompute)
+
+
+def lm_step_cost_for(model: Any, batch: int, seq_len: int,
+                     fused_ce_chunks: int = 0) -> StepCost:
+    """Build the LM cost from a live model instance (TransformerLM or
+    PipelinedTransformerLM — both carry the config attributes)."""
+    n_layers = getattr(model, "n_layers", None)
+    if n_layers is None:  # pipeline model: chunks x blocks-per-chunk
+        n_layers = int(model.n_chunks) * int(model.n_blocks)
+    remat = bool(getattr(model, "remat", False))
+    if getattr(model, "has_manual_grads", lambda: False)():
+        # 1F1B/interleaved stash stage *inputs* only and replay the stage
+        # forward in backward — remat by construction.
+        remat = True
+    return lm_step_cost(
+        vocab_size=int(model.vocab_size),
+        d_model=int(model.d_model),
+        n_layers=int(n_layers),
+        batch=batch,
+        seq_len=seq_len,
+        fused_ce=bool(fused_ce_chunks),
+        remat=remat,
+        moe_experts=int(getattr(model, "moe_experts", 0) or 0),
+        moe_top_k=int(getattr(model, "moe_top_k", 1) or 1),
+    )
+
+
+def xla_step_flops(jitted, *args) -> float:
+    """Per-device FLOPs from the compiler's own cost model
+    (``lower().compile().cost_analysis()``) — the cross-check oracle the
+    analytic models are tested against (compare with
+    ``StepCost.per_device_flops(n)``)."""
+    analysis = jitted.lower(*args).compile().cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0]
+    return float(analysis["flops"])
+
+
+# ------------------------------------------------------------------ reporter
+class MFUReporter:
+    """Turns host-measured step seconds into per-step MFU/HFU fields for
+    the metrics JSONL (all-host math — never touches the device)."""
+
+    def __init__(self, cost: StepCost, n_devices: int,
+                 peak_per_chip: Optional[float] = None):
+        self.cost = cost
+        self.n_devices = max(1, int(n_devices))
+        self.peak = (peak_per_chip if peak_per_chip is not None
+                     else device_peak_flops())
+        self._denom = self.peak * self.n_devices
+
+    def fields(self, step_time: float) -> Dict[str, float]:
+        dt = max(float(step_time), 1e-9)
+        return {
+            "mfu": 100.0 * self.cost.model_flops / dt / self._denom,
+            "hfu": 100.0 * self.cost.hardware_flops / dt / self._denom,
+            "model_tflops": self.cost.model_flops / dt / 1e12,
+        }

@@ -43,20 +43,34 @@ class HeartbeatWriter:
         os.makedirs(hb_dir, exist_ok=True)
         self._last = float("-inf")
 
-    def beat(self, step: int, force: bool = False) -> bool:
-        """Record a beat at ``step``; returns True when a line was written."""
+    def beat(self, step: int, force: bool = False,
+             step_time_ema: Optional[float] = None,
+             last_ft: Optional[str] = None) -> bool:
+        """Record a beat at ``step``; returns True when a line was written.
+
+        ``step_time_ema`` (seconds) and ``last_ft`` (the most recent
+        ft_event kind) ride along when given, so the monitor can tell a
+        *slow* rank (fresh beats, fat EMA) from a *dead* one (stale beats)
+        and see whether the rank already said why it is behind."""
         now = time.time()
         if not force and now - self._last < self.interval_s:
             return False
         self._last = now
         rec = {"pid": self.process_index, "step": int(step), "t": now}
+        if step_time_ema is not None:
+            rec["ema"] = float(step_time_ema)
+        if last_ft is not None:
+            rec["last_ft"] = str(last_ft)
         with open(self.path, "a") as f:
             f.write(json.dumps(rec) + "\n")
         return True
 
-    def close(self, step: Optional[int] = None) -> None:
+    def close(self, step: Optional[int] = None,
+              step_time_ema: Optional[float] = None,
+              last_ft: Optional[str] = None) -> None:
         if step is not None:
-            self.beat(step, force=True)
+            self.beat(step, force=True, step_time_ema=step_time_ema,
+                      last_ft=last_ft)
 
 
 def read_heartbeats(hb_dir: str) -> Dict[int, dict]:
@@ -83,39 +97,62 @@ def read_heartbeats(hb_dir: str) -> Dict[int, dict]:
     return beats
 
 
+def _median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2] if s else None
+
+
 def find_stragglers(
     beats: Dict[int, dict],
     now: Optional[float] = None,
     max_step_lag: int = 3,
     max_age_s: float = 60.0,
+    slow_ema_factor: float = 2.0,
 ) -> Dict[int, str]:
     """Flag straggling processes → ``{pid: human-readable reason}``.
 
-    Two independent signals:
+    Three signals, distinguishing *slow* ranks from *dead* ranks:
     - **step lag**: the process's latest step trails the front-runner by
-      more than ``max_step_lag`` (slow host; collectives will rate-limit
-      everyone to it);
-    - **beat age**: the newest beat is older than ``max_age_s`` (hung or
-      dead process — the one the lock-stepped mesh cannot see from step
-      counters alone, since a stuck rank stalls every rank's step).
+      more than ``max_step_lag`` (collectives will rate-limit everyone to
+      it).  When beats carry a step-time EMA, a fat EMA vs the fleet
+      median (> ``slow_ema_factor``x) marks the rank as *slow* — alive
+      but dragging, the "replace the host" case;
+    - **beat age**: the newest beat is older than ``max_age_s`` — *dead or
+      hung*, the one the lock-stepped mesh cannot see from step counters
+      alone, since a stuck rank stalls every rank's step;
+    - a beat's ``last_ft`` event kind is appended to the reason when
+      present, so a rank that already said why it is behind (preempt,
+      rollback) reads differently from a silent one.
     """
     if not beats:
         return {}
     if now is None:
         now = time.time()
     lead = max(b["step"] for b in beats.values())
+    # Fleet-median EMA over *fresh* ranks only: a dead rank's stale EMA
+    # must not drag the baseline.
+    med_ema = _median([b["ema"] for b in beats.values()
+                       if "ema" in b and now - b["t"] <= max_age_s])
     flagged: Dict[int, str] = {}
     for pid in sorted(beats):
         b = beats[pid]
         reasons = []
         lag = lead - b["step"]
-        if lag > max_step_lag:
-            reasons.append(
-                f"step lag {lag} > {max_step_lag} "
-                f"(at step {b['step']}, lead {lead})")
         age = now - b["t"]
+        if lag > max_step_lag:
+            reason = (f"step lag {lag} > {max_step_lag} "
+                      f"(at step {b['step']}, lead {lead})")
+            ema = b.get("ema")
+            if (age <= max_age_s and ema is not None and med_ema
+                    and ema > slow_ema_factor * med_ema):
+                reason += (f"; slow rank: step-time ema {ema:.3f}s vs "
+                           f"fleet median {med_ema:.3f}s")
+            reasons.append(reason)
         if age > max_age_s:
-            reasons.append(f"beat age {age:.1f}s > {max_age_s:.0f}s")
+            reasons.append(
+                f"beat age {age:.1f}s > {max_age_s:.0f}s (dead or hung)")
+        if reasons and b.get("last_ft"):
+            reasons.append(f"last ft_event: {b['last_ft']}")
         if reasons:
             flagged[pid] = "; ".join(reasons)
     return flagged

@@ -70,6 +70,10 @@ class MetricsLogger:
         self._pending: List[Dict[str, Any]] = []
         self._times: collections.deque = collections.deque(maxlen=window)
         self._ema: Optional[float] = None
+        # Last event kind logged (skip/rollback/preempt/recompile): the
+        # heartbeat writer stamps it into beats so the straggler monitor
+        # can tell a rank that *said why* it is behind from a silent one.
+        self.last_event_kind: Optional[str] = None
         self._file = None
         self._step_sinks: List[Any] = []
         self._epoch_sinks: List[Any] = []
@@ -115,6 +119,12 @@ class MetricsLogger:
         return out
 
     # ----------------------------------------------------------------- steps
+    @property
+    def ema(self) -> Optional[float]:
+        """Current step-time EMA (None before the first step) — exported so
+        heartbeats can carry it (obs/heartbeat.py slow-vs-dead signal)."""
+        return self._ema
+
     @property
     def enabled(self) -> bool:
         """True when some step sink (JSONL file or callable) consumes
@@ -170,6 +180,7 @@ class MetricsLogger:
         summarized by ``scripts/obs_report.py``).  Events are rare, so they
         flush immediately: a crash right after a preemption event must not
         lose the record that explains the crash."""
+        self.last_event_kind = str(kind)  # beats carry it even w/o a sink
         if not self.enabled:
             return
         rec: Dict[str, Any] = {

@@ -1,0 +1,152 @@
+"""Recompile watchdog: runtime detection of silent re-compilation.
+
+The static half of this story is shardlint's host-sync/hazard detection
+(analysis/); this is the runtime twin.  A steady-state training loop
+should compile each jitted step function exactly once — every further
+compilation means a shape, dtype, or donation signature quietly changed
+(a dynamic batch tail, an accidental Python-scalar operand, a resharded
+resume) and the run just paid seconds-to-minutes of XLA time it will pay
+again on every recurrence.
+
+Hook: ``jax.monitoring``'s cache-miss instrumentation.  jax records a
+duration event on every *actual* backend compilation
+(``/jax/core/compile/backend_compile_duration``) and on every tracing-
+cache miss (``/jax/core/compile/jaxpr_trace_duration``); the watchdog
+listens for both and attributes them to whichever labelled region the
+current thread is inside (``watch("train_step")`` around the step call).
+Compiles beyond ``warmup_compiles`` per label are anomalies: counted,
+printed, and emitted as ``recompile`` events into the metrics JSONL so
+``obs_report``'s goodput ledger books the time as badput.
+
+Host-transfer note: jax 0.4.x emits no monitoring event for device→host
+copies, so runtime transfer detection is out of scope here — the shardlint
+AST lint covers the hot loops statically, and the obs layer's lazy-scalar
+discipline keeps intentional syncs off the per-step path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+UNATTRIBUTED = "<unattributed>"
+
+
+class _Watch:
+    """Reentrant-per-call context: sets the calling thread's label."""
+
+    def __init__(self, wd: "RecompileWatchdog", label: str,
+                 step: Optional[int]):
+        self.wd, self.label, self.step = wd, label, step
+
+    def __enter__(self):
+        tl = self.wd._tl
+        self.prev = (getattr(tl, "label", None), getattr(tl, "step", None))
+        tl.label, tl.step = self.label, self.step
+        return self
+
+    def __exit__(self, *exc):
+        self.wd._tl.label, self.wd._tl.step = self.prev
+        return False
+
+
+class RecompileWatchdog:
+    """Counts compilations/retraces per labelled region; flags any
+    compilation past ``warmup_compiles`` for that label as an anomaly.
+
+    >>> wd = RecompileWatchdog(obs=logger).install()
+    >>> with wd.watch("train_step", step=i):
+    ...     state, metrics = train_step(state, batch, lr)
+    ...
+    >>> wd.uninstall()
+
+    The first compile under each label is warm-up (one compile per jitted
+    step-fn is the contract); attribution is thread-local, so a background
+    feeder thread's transfers can never be booked to the step.  Compiles
+    outside any ``watch`` land under ``<unattributed>`` and are counted
+    but never flagged — one-shot helpers (eval builders, checkpoint
+    gathers) are not anomalies.
+    """
+
+    def __init__(self, obs: Any = None, warmup_compiles: int = 1):
+        if warmup_compiles < 1:
+            raise ValueError(
+                f"warmup_compiles must be >= 1, got {warmup_compiles}")
+        self.obs = obs
+        self.warmup_compiles = int(warmup_compiles)
+        self.compiles: Dict[str, int] = {}
+        self.retraces: Dict[str, int] = {}
+        self.anomalies: List[dict] = []
+        self._tl = threading.local()
+        self._installed = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- lifecycle
+    def install(self) -> "RecompileWatchdog":
+        if not self._installed:
+            import jax.monitoring as monitoring
+
+            monitoring.register_event_duration_secs_listener(self._on_event)
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        from jax._src import monitoring as _m
+
+        try:
+            _m._unregister_event_duration_listener_by_callback(self._on_event)
+        except (AssertionError, AttributeError, ValueError):
+            # Listener list API drifted or already gone: leave the dead
+            # listener registered; _on_event no-ops once uninstalled.
+            pass
+
+    def __enter__(self) -> "RecompileWatchdog":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # ------------------------------------------------------------ attribution
+    def watch(self, label: str, step: Optional[int] = None) -> _Watch:
+        """Attribute compiles fired by this thread inside the context to
+        ``label`` (typically wrapped right around the jitted step call)."""
+        return _Watch(self, str(label), step)
+
+    # ---------------------------------------------------------------- events
+    def _on_event(self, event: str, duration_secs: float, **kw) -> None:
+        if not self._installed:
+            return
+        if event == TRACE_EVENT:
+            label = getattr(self._tl, "label", None) or UNATTRIBUTED
+            with self._lock:
+                self.retraces[label] = self.retraces.get(label, 0) + 1
+            return
+        if event != BACKEND_COMPILE_EVENT:
+            return
+        label = getattr(self._tl, "label", None) or UNATTRIBUTED
+        step = getattr(self._tl, "step", None)
+        with self._lock:
+            n = self.compiles.get(label, 0) + 1
+            self.compiles[label] = n
+        if label == UNATTRIBUTED or n <= self.warmup_compiles:
+            return
+        anomaly = {"label": label, "compile_index": n,
+                   "duration_s": float(duration_secs)}
+        if step is not None:
+            anomaly["step"] = int(step)
+        self.anomalies.append(anomaly)
+        print(f"!! recompile watchdog: {label} compiled again "
+              f"(#{n}, {duration_secs:.2f}s"
+              + (f", step {step}" if step is not None else "") + ") — "
+              "shape/dtype/donation signature changed after warmup",
+              flush=True)
+        if self.obs is not None and hasattr(self.obs, "log_event"):
+            self.obs.log_event("recompile", step=step, label=label,
+                               compile_index=n,
+                               duration_s=float(duration_secs))

@@ -173,6 +173,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hb-interval", type=float, default=5.0,
                    dest="hb_interval_s", metavar="SEC",
                    help="minimum seconds between heartbeats (default 5)")
+    p.add_argument("--mfu", action="store_true",
+                   help="report per-step MFU/HFU in the metrics JSONL: the "
+                        "analytic LM FLOPs model (obs/flops.py — fused-CE, "
+                        "remat, and pipeline schedules accounted) over the "
+                        "chips' peak FLOPs")
+    p.add_argument("--goodput", action="store_true",
+                   help="track the goodput/badput ledger live (nan-skips, "
+                        "rollback discards, preemption gaps, recompiles, "
+                        "stalls) and print the summary at end of fit")
+    p.add_argument("--watch-recompiles", action="store_true",
+                   dest="watch_recompiles",
+                   help="recompile watchdog (obs/watchdog.py): flag any "
+                        "post-warmup recompilation of the jitted step as "
+                        "an anomaly event via jax.monitoring")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -410,6 +424,8 @@ def main(argv=None) -> float:
             fused_ce_mode=args.fused_ce_mode,
             metrics_jsonl=args.metrics_jsonl, hb_dir=args.hb_dir,
             hb_interval_s=args.hb_interval_s,
+            mfu=args.mfu, goodput=args.goodput,
+            watch_recompiles=args.watch_recompiles,
             save_steps=args.save_steps, resume=args.resume,
             nan_guard=args.nan_guard, ft_rollback_k=args.ft_rollback_k,
             ft_check_every=args.ft_check_every,

@@ -104,6 +104,12 @@ class Config:
     metrics_jsonl: Optional[str] = None
     hb_dir: Optional[str] = None
     hb_interval_s: float = 5.0
+    # Efficiency accounting (obs/flops.py, obs/goodput.py, obs/watchdog.py):
+    # per-step MFU/HFU from the analytic FLOPs model, the live goodput/
+    # badput ledger, and the jax.monitoring recompile watchdog.
+    mfu: bool = False
+    goodput: bool = False
+    watch_recompiles: bool = False
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -241,6 +247,23 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
     p.add_argument("--hb-interval", default=d.hb_interval_s, type=float,
                    dest="hb_interval_s", metavar="SEC",
                    help="minimum seconds between heartbeats (default 5)")
+    p.add_argument("--mfu", action="store_true",
+                   help="report per-step MFU/HFU in the metrics JSONL: the "
+                   "analytic FLOPs model for the arch (obs/flops.py, "
+                   "cross-checked against XLA cost_analysis) over the "
+                   "chip's peak; supported for the ResNet and ViT families")
+    p.add_argument("--goodput", action="store_true",
+                   help="track the goodput/badput ledger live (nan-skips, "
+                   "rollback discards, preemption gaps, recompiles, "
+                   "stalls) and print the summary at end of fit; the "
+                   "post-hoc equivalent is scripts/obs_report.py over "
+                   "--metrics-jsonl")
+    p.add_argument("--watch-recompiles", action="store_true",
+                   dest="watch_recompiles",
+                   help="recompile watchdog (obs/watchdog.py): count XLA "
+                   "compilations per jitted step-fn via jax.monitoring and "
+                   "flag any recompilation after warmup as an anomaly "
+                   "event in the metrics JSONL")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

@@ -478,6 +478,9 @@ class LMTrainer:
         metrics_jsonl: Optional[str] = None,
         hb_dir: Optional[str] = None,
         hb_interval_s: float = 5.0,
+        mfu: bool = False,
+        goodput: bool = False,
+        watch_recompiles: bool = False,
         save_steps: int = 0,
         resume: Optional[str] = None,
         nan_guard: bool = False,
@@ -501,6 +504,13 @@ class LMTrainer:
         ``metrics_jsonl``/``hb_dir``: unified observability (obs/) — one
         structured record per step, and per-process heartbeats for the
         cross-process straggler monitor.
+
+        Efficiency accounting (obs/flops.py, goodput.py, watchdog.py):
+        ``mfu`` adds per-step MFU/HFU fields from the analytic LM FLOPs
+        model (fused-CE / remat / pipeline-aware) over the chips' peak;
+        ``goodput`` tracks the live goodput/badput ledger and prints it at
+        end of fit; ``watch_recompiles`` installs the jax.monitoring
+        recompile watchdog around the step/eval functions.
 
         Fault tolerance (ft/): ``save_steps`` checkpoints every N steps
         (ft record carries the step, so SIGKILL loses at most N steps);
@@ -569,6 +579,33 @@ class LMTrainer:
         self.hb = (HeartbeatWriter(hb_dir, jax.process_index(),
                                    interval_s=hb_interval_s)
                    if hb_dir else None)
+
+        # ---- efficiency accounting (obs/) ----
+        self._mfu = None
+        if mfu:
+            from pytorch_distributed_tpu.obs.flops import (
+                MFUReporter,
+                device_peak_flops,
+                lm_step_cost_for,
+            )
+
+            cost = lm_step_cost_for(model, batch_size, dataset.seq_len,
+                                    fused_ce_chunks=fused_ce_chunks)
+            dev = mesh.devices.flat[0]
+            self._mfu = MFUReporter(cost, n_devices=mesh.devices.size,
+                                    peak_per_chip=device_peak_flops(dev))
+        self._goodput = None
+        if goodput:
+            from pytorch_distributed_tpu.obs.goodput import GoodputTracker
+
+            self._goodput = self.obs.register(GoodputTracker())
+        self.watchdog = None
+        if watch_recompiles:
+            from pytorch_distributed_tpu.obs.watchdog import (
+                RecompileWatchdog,
+            )
+
+            self.watchdog = RecompileWatchdog(obs=self.obs).install()
 
         # ---- fault tolerance (ft/) ----
         self.save_steps = int(save_steps)
@@ -657,6 +694,15 @@ class LMTrainer:
             )
         return jax.device_put(local_tokens, self.token_sharding)
 
+    def _wd_watch(self, label: str, step: Optional[int] = None):
+        """Watchdog attribution context for a jitted call (inert when
+        ``watch_recompiles`` is off)."""
+        if self.watchdog is not None:
+            return self.watchdog.watch(label, step=step)
+        import contextlib
+
+        return contextlib.nullcontext()
+
     def _preempt_agreed(self) -> bool:
         """Cross-process 'any rank flagged?' — every rank calls this at the
         same step (it runs a collective on multi-process meshes)."""
@@ -677,7 +723,8 @@ class LMTrainer:
         totals = {"loss_sum": 0.0, "correct": 0.0, "count": 0.0}
         for i in range(self.eval_batches):
             tokens = self._put_tokens(self._local_batch(self.eval_dataset, i))
-            sums = self._eval_fn(self.state, tokens)
+            with self._wd_watch("lm_eval_step"):
+                sums = self._eval_fn(self.state, tokens)
             for k in totals:
                 totals[k] += float(sums[k])
         count = max(totals["count"], 1.0)
@@ -730,6 +777,9 @@ class LMTrainer:
 
     def fit(self, steps: int, print_freq: int = 10) -> float:
         from pytorch_distributed_tpu.obs import scope
+
+        if self.watchdog is not None:
+            self.watchdog.install()  # idempotent (re-fit after a fit)
 
         meters = StepMeters(
             steps,
@@ -788,16 +838,19 @@ class LMTrainer:
                     val = val * self.ft_guard.lr_scale
                 if val != lr_val:
                     lr_val, lr = val, jnp.float32(val)
-                with scope("lm_step"):
+                with scope("lm_step"), self._wd_watch("lm_step", i):
                     self.state, metrics = self.step_fn(self.state, tokens, lr)
                 completed = i + 1
                 dt = meters.update(metrics, self.batch_size)
                 self.obs.log_step(
                     i, step_time=dt, n_items=tokens_per_step, lr=lr,
                     scalars=dict(metrics),  # incl. norms when log_norms on
+                    extra=(self._mfu.fields(dt)
+                           if self._mfu is not None else None),
                 )
                 if self.hb is not None:
-                    self.hb.beat(i)
+                    self.hb.beat(i, step_time_ema=self.obs.ema,
+                                 last_ft=self.obs.last_event_kind)
                 meters.maybe_display(i, print_freq)
                 at_save = (self.save_steps > 0
                            and completed % self.save_steps == 0)
@@ -837,8 +890,15 @@ class LMTrainer:
                 self._rollback(completed)
         finally:
             token_iter.close()  # unblocks the producer on early exit
+            if self.watchdog is not None:
+                self.watchdog.uninstall()
             if self.hb is not None:
-                self.hb.close(int(self.state.step) - 1)
+                self.hb.close(int(self.state.step) - 1,
+                              step_time_ema=self.obs.ema,
+                              last_ft=self.obs.last_event_kind)
+            self.obs.flush()
+            if self._goodput is not None:
+                print(f"=> {self._goodput.format_summary()}", flush=True)
             self.obs.close()
         is_best = False
         if self._eval_fn is not None and not preempted:

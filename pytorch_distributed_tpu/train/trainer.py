@@ -247,6 +247,33 @@ class Trainer:
                    if cfg.hb_dir else None)
         if self.ft_guard is not None:
             self.ft_guard.obs = self.obs  # ft_event records → metrics JSONL
+        # Efficiency accounting (obs/): per-step MFU/HFU from the analytic
+        # FLOPs model, the live goodput ledger, and the recompile watchdog.
+        self._mfu = None
+        if getattr(cfg, "mfu", False):
+            from pytorch_distributed_tpu.obs.flops import (
+                MFUReporter,
+                device_peak_flops,
+                image_step_cost,
+            )
+
+            cost = image_step_cost(cfg.arch, cfg.batch_size, cfg.image_size,
+                                   cfg.num_classes)
+            dev = self.mesh.devices.flat[0]
+            self._mfu = MFUReporter(cost, n_devices=self.mesh.devices.size,
+                                    peak_per_chip=device_peak_flops(dev))
+        self._goodput = None
+        if getattr(cfg, "goodput", False):
+            from pytorch_distributed_tpu.obs.goodput import GoodputTracker
+
+            self._goodput = self.obs.register(GoodputTracker())
+        self.watchdog = None
+        if getattr(cfg, "watch_recompiles", False):
+            from pytorch_distributed_tpu.obs.watchdog import (
+                RecompileWatchdog,
+            )
+
+            self.watchdog = RecompileWatchdog(obs=self.obs).install()
         # Monotonic logged-train-step counter; a resume restores it so the
         # metrics JSONL step axis continues instead of restarting at 0.
         self._global_step = self._resume_global
@@ -363,6 +390,15 @@ class Trainer:
             worker_type=cfg.worker_type,
         )
 
+    def _wd_watch(self, label: str, step: Optional[int] = None):
+        """Watchdog attribution context for a jitted call (inert when
+        --watch-recompiles is off)."""
+        if self.watchdog is not None:
+            return self.watchdog.watch(label, step=step)
+        import contextlib
+
+        return contextlib.nullcontext()
+
     # ----------------------------------------------------------------- train
     def _ft_record(self, epoch: int, step_in_epoch: int) -> dict:
         return {
@@ -453,19 +489,24 @@ class Trainer:
                 self.chaos.on_step(self, i)
                 batch = self.chaos.on_batch(i, batch)
             n = self.cfg.batch_size
-            with scope("train_step"):
+            with scope("train_step"), self._wd_watch("train_step",
+                                                     self._global_step):
                 self.state, metrics = self.train_step(self.state, batch, lr_arr)
             completed = i + 1
             # Unready device scalars: meters and the metrics logger convert
             # lazily, so no per-step host sync (SURVEY.md §7.4 item 1).
             dt = meters.update(metrics, n)
+            extra = {"epoch": epoch}
+            if self._mfu is not None:
+                extra.update(self._mfu.fields(dt))
             self.obs.log_step(
                 self._global_step, step_time=dt, n_items=n, lr=lr,
                 scalars=dict(metrics),  # incl. norms when --metrics-jsonl
-                extra={"epoch": epoch},
+                extra=extra,
             )
             if self.hb is not None:
-                self.hb.beat(self._global_step)
+                self.hb.beat(self._global_step, step_time_ema=self.obs.ema,
+                             last_ft=self.obs.last_event_kind)
             self._global_step += 1
             meters.maybe_display(i, cfg.print_freq)
             at_save = (cfg.save_steps > 0 and completed % cfg.save_steps == 0
@@ -505,7 +546,8 @@ class Trainer:
         totals = {"loss_sum": 0.0, "correct1": 0.0, "correct5": 0.0, "count": 0.0}
         end = time.time()
         for i, batch in enumerate(self.feeder(iter(self.val_loader))):
-            sums = self.eval_step(self.state, batch)
+            with self._wd_watch("eval_step"):
+                sums = self.eval_step(self.state, batch)
             c = float(sums["count"])
             if c > 0:
                 losses.update(float(sums["loss_sum"]) / c, int(c))
@@ -560,14 +602,23 @@ class Trainer:
         if installed:
             self.preempt = PreemptionGuard(
                 signals=parse_signals(cfg.preempt_signals)).install()
+        if self.watchdog is not None:
+            self.watchdog.install()  # idempotent (re-fit after a fit)
         try:
             return self._fit_epochs()
         finally:
             if installed:
                 self.preempt.uninstall()
                 self.preempt = None
+            if self.watchdog is not None:
+                self.watchdog.uninstall()
             if self.hb is not None:
-                self.hb.close(max(0, self._global_step - 1))
+                self.hb.close(max(0, self._global_step - 1),
+                              step_time_ema=self.obs.ema,
+                              last_ft=self.obs.last_event_kind)
+            self.obs.flush()
+            if self._goodput is not None:
+                print(f"=> {self._goodput.format_summary()}", flush=True)
             self.obs.close()  # flush JSONL, stop registered telemetry
             self._telemetry_on = False
 

@@ -1,4 +1,4 @@
-"""Shared timing helper for the profiling scripts.
+"""Shared timing + event helpers for the benchmark/profiling scripts.
 
 Sync discipline on this platform: fetch a SCALAR value — on the tunneled
 axon backend ``block_until_ready`` can return before the device queue
@@ -6,6 +6,8 @@ drains, so ``float(out)`` (a value fetch) is the only reliable barrier.
 Benchmarked computations must therefore reduce to a scalar on-device.
 """
 
+import json
+import os
 import time
 
 
@@ -19,3 +21,30 @@ def timed_scalar(fn, *args, iters=5, warmup=2):
         out = fn(*args)
     float(out)
     return (time.perf_counter() - t0) / iters
+
+
+def bench_event(kind, path=None, **fields):
+    """Append one structured ``bench_event`` record to a JSONL file in the
+    metrics-stream schema (``{"bench_event": kind, "t": ..., ...}``) —
+    ``scripts/obs_report.py`` folds it into the run summary alongside step
+    and ft_event records.
+
+    The headline use: ``bench.py`` marking a *stale* probe (tunnel down,
+    last-known-good number replayed) with the reason and the last-good
+    timestamp, so a replayed benchmark is visible out-of-band of the
+    stdout JSON contract.  ``path`` defaults to ``$BENCH_EVENTS_JSONL`` or
+    ``bench_events.jsonl`` next to this repo's ``bench.py``.  Best-effort:
+    never raises — an unwritable event log must not take down the
+    benchmark emission itself."""
+    if path is None:
+        path = os.environ.get("BENCH_EVENTS_JSONL") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench_events.jsonl")
+    rec = {"bench_event": str(kind), "t": time.time()}
+    rec.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    return rec

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Fold one run's observability artifacts into a human-readable summary.
+"""Fold one run's observability artifacts into a human-readable summary,
+or fence two runs against each other (``--diff A B``).
 
 Inputs (any subset):
 - ``--metrics-jsonl``  per-step records from ``obs.MetricsLogger``
@@ -9,12 +10,20 @@ Inputs (any subset):
 - ``--telemetry-csv``  the 500 ms device-memory CSV from
   ``utils.telemetry.TelemetrySampler`` (``--telemetry-csv``).
 
-Output: step-time percentiles + throughput + loss/grad-norm trajectory,
-per-device peak HBM, and a straggler table — the per-stage, per-device
-measurements the reference's per-node nvidia-smi CSVs never aggregated.
+Output: step-time percentiles + throughput + MFU + loss/grad-norm
+trajectory, the goodput/badput ledger (ft_event + recompile records),
+bench staleness events, per-device peak HBM, and a straggler table —
+with malformed JSONL lines *counted*, not silently skipped (the torn
+final line after a SIGKILL is the common case).
 
-``--selftest`` synthesizes all three artifacts in a temp dir, runs the
-report on them, and asserts the summary — the fast tier-1 CI hook.
+``--diff A B`` compares two metrics JSONL files — step-time p50/p95,
+throughput, MFU, goodput — and prints a thresholded PASS/REGRESS verdict
+per metric (exit code 1 on overall REGRESS): the perf-regression fence a
+CI job can gate on.
+
+``--selftest`` synthesizes the artifacts in a temp dir, runs the report
+and both diff verdicts on them, and asserts the output — the fast tier-1
+CI hook.
 """
 
 from __future__ import annotations
@@ -25,7 +34,7 @@ import json
 import os
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -41,23 +50,34 @@ def _mib(n: float) -> str:
     return f"{n / (1024 * 1024):.1f}"
 
 
-def load_metrics(path: str) -> List[dict]:
-    records = []
+def load_metrics(path: str) -> Tuple[List[dict], int]:
+    """Parse a metrics JSONL; returns ``(records, malformed_line_count)``.
+
+    Malformed/truncated lines (the torn tail after a kill — routine since
+    the FT subsystem made kill-and-resume a supported flow) are *counted*
+    so the report can say how much of the stream was lost."""
+    records, malformed = [], 0
     with open(path) as f:
         for line in f:
             line = line.strip()
             if not line:
                 continue
             try:
-                records.append(json.loads(line))
+                rec = json.loads(line)
             except ValueError:
-                continue  # torn tail line from a killed writer
-    return records
+                malformed += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                malformed += 1  # parseable but not a record object
+    return records, malformed
 
 
-def summarize_metrics(records: List[dict]) -> List[str]:
+def summarize_metrics(records: List[dict], malformed: int = 0) -> List[str]:
     if not records:
-        return ["  (no records)"]
+        return ["  (no records)"] + (
+            [f"  malformed lines   {malformed}"] if malformed else [])
     records = sorted(records, key=lambda r: (r.get("step", 0), r.get("t", 0)))
     times = sorted(r["step_time"] for r in records if "step_time" in r)
     lines = [
@@ -68,10 +88,19 @@ def summarize_metrics(records: List[dict]) -> List[str]:
         f"p95 {_pct(times, .95) * 1e3:.1f}ms  "
         f"max {(times[-1] if times else 0) * 1e3:.1f}ms",
     ]
+    if malformed:
+        lines.append(f"  malformed lines   {malformed} "
+                     "(torn tail from a killed writer?)")
     thr = [r["throughput"] for r in records if "throughput" in r]
     if thr:
         lines.append(f"  throughput        mean {sum(thr) / len(thr):.1f}/s  "
                      f"last {thr[-1]:.1f}/s")
+    mfu = [r["mfu"] for r in records if "mfu" in r]
+    if mfu:
+        hfu = [r.get("hfu", 0.0) for r in records if "mfu" in r]
+        lines.append(f"  mfu               mean {sum(mfu) / len(mfu):.1f}%  "
+                     f"last {mfu[-1]:.1f}%  "
+                     f"(hfu mean {sum(hfu) / len(hfu):.1f}%)")
     loss = [r["loss"] for r in records if "loss" in r]
     if loss:
         lines.append(f"  loss              first {loss[0]:.4f}  "
@@ -111,6 +140,28 @@ def summarize_ft_events(records: List[dict]) -> List[str]:
     if scales:
         lines.append(f"  lr scale          {scales[-1]:g} after "
                      f"{len(rollbacks)} rollback(s)")
+    return lines
+
+
+def summarize_bench(records: List[dict]) -> List[str]:
+    """Fold ``bench_event`` records (scripts/benchlib.py — e.g. a stale
+    benchmark probe replaying its last-known-good number) into the
+    summary, so a dashboard reading this report can't mistake a replayed
+    benchmark for a fresh one."""
+    events = [r for r in records if "bench_event" in r]
+    if not events:
+        return []
+    lines = ["== bench =="]
+    for e in events:
+        kind = str(e["bench_event"])
+        detail = []
+        if e.get("metric"):
+            detail.append(str(e["metric"]))
+        if e.get("last_good"):
+            detail.append(f"last good {e['last_good']}")
+        if e.get("reason"):
+            detail.append(str(e["reason"]))
+        lines.append(f"  {kind:<16}  " + "; ".join(detail))
     return lines
 
 
@@ -169,11 +220,16 @@ def summarize_heartbeats(hb_dir: str, now: Optional[float],
 def report(args) -> str:
     sections = []
     if args.metrics_jsonl:
-        records = load_metrics(args.metrics_jsonl)
+        records, malformed = load_metrics(args.metrics_jsonl)
         sections.append("== steps ==")
         sections += summarize_metrics(
-            [r for r in records if "ft_event" not in r])
+            [r for r in records
+             if "ft_event" not in r and "bench_event" not in r], malformed)
         sections += summarize_ft_events(records)
+        from pytorch_distributed_tpu.obs.goodput import summarize_goodput
+
+        sections += summarize_goodput(records)
+        sections += summarize_bench(records)
     if args.telemetry_csv:
         sections.append("== devices ==")
         sections += summarize_telemetry(args.telemetry_csv)
@@ -187,8 +243,106 @@ def report(args) -> str:
     return "\n".join(sections)
 
 
+# ------------------------------------------------------------------ run diff
+def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
+    """Scalar per-run summary for the diff fence."""
+    from pytorch_distributed_tpu.obs.goodput import compute_goodput
+
+    steps = [r for r in records
+             if "step_time" in r and "ft_event" not in r
+             and "bench_event" not in r]
+    times = sorted(r["step_time"] for r in steps)
+    thr = [r["throughput"] for r in steps if "throughput" in r]
+    mfu = [r["mfu"] for r in steps if "mfu" in r]
+    gp = compute_goodput(records)
+    return {
+        "steps": float(len(steps)),
+        "step_time_p50": _pct(times, .5) if times else None,
+        "step_time_p95": _pct(times, .95) if times else None,
+        "throughput": sum(thr) / len(thr) if thr else None,
+        "mfu": sum(mfu) / len(mfu) if mfu else None,
+        "goodput": gp.goodput_pct if gp.steps else None,
+    }
+
+
+# (name, lower_is_better, absolute_pp) — goodput diffs in percentage
+# points, the rest in relative percent.
+_DIFF_METRICS = (
+    ("step_time_p50", True, False),
+    ("step_time_p95", True, False),
+    ("throughput", False, False),
+    ("mfu", False, False),
+    ("goodput", False, True),
+)
+
+
+def diff_report(a_records: List[dict], b_records: List[dict],
+                threshold_pct: float = 10.0,
+                goodput_threshold_pp: float = 5.0,
+                label_a: str = "A", label_b: str = "B") -> Tuple[str, bool]:
+    """Compare run B against baseline run A → (report text, regressed).
+
+    A metric REGRESSes when B is worse than A by more than
+    ``threshold_pct`` percent (relative), or ``goodput_threshold_pp``
+    percentage points for goodput.  Metrics missing from either run are
+    skipped (shown as ``--``) — a run without ``--mfu`` must not fail the
+    fence on MFU."""
+    sa, sb = run_stats(a_records), run_stats(b_records)
+    w = 14
+    lines = [
+        "== diff ==",
+        f"  baseline {label_a}: {sa['steps']:.0f} steps;  "
+        f"candidate {label_b}: {sb['steps']:.0f} steps",
+        f"  {'metric':<{w}} {'A':>10} {'B':>10} {'delta':>9}  verdict",
+    ]
+    regressed = False
+    for name, lower_better, absolute_pp in _DIFF_METRICS:
+        va, vb = sa[name], sb[name]
+        if va is None or vb is None:
+            lines.append(f"  {name:<{w}} {'--':>10} {'--':>10} {'--':>9}  "
+                         "(missing)")
+            continue
+        if absolute_pp:
+            delta = vb - va
+            worse = (va - vb) > goodput_threshold_pp
+            dtxt = f"{delta:+.1f}pp"
+            fa, fb = f"{va:.1f}%", f"{vb:.1f}%"
+        else:
+            if va == 0:
+                lines.append(f"  {name:<{w}} {va:>10.4g} {vb:>10.4g} "
+                             f"{'--':>9}  (zero baseline)")
+                continue
+            delta = 100.0 * (vb - va) / va
+            worse = (delta > threshold_pct if lower_better
+                     else delta < -threshold_pct)
+            dtxt = f"{delta:+.1f}%"
+            if name.startswith("step_time"):
+                fa, fb = f"{va * 1e3:.1f}ms", f"{vb * 1e3:.1f}ms"
+            else:
+                fa, fb = f"{va:.4g}", f"{vb:.4g}"
+        verdict = "REGRESS" if worse else "PASS"
+        regressed = regressed or worse
+        lines.append(f"  {name:<{w}} {fa:>10} {fb:>10} {dtxt:>9}  {verdict}")
+    lines.append(f"overall: {'REGRESS' if regressed else 'PASS'}")
+    return "\n".join(lines), regressed
+
+
+def run_diff(path_a: str, path_b: str, threshold_pct: float,
+             goodput_threshold_pp: float) -> int:
+    a, mal_a = load_metrics(path_a)
+    b, mal_b = load_metrics(path_b)
+    text, regressed = diff_report(
+        a, b, threshold_pct=threshold_pct,
+        goodput_threshold_pp=goodput_threshold_pp,
+        label_a=os.path.basename(path_a), label_b=os.path.basename(path_b))
+    if mal_a or mal_b:
+        text += f"\n(malformed lines: A {mal_a}, B {mal_b})"
+    print(text)
+    return 1 if regressed else 0
+
+
 def _selftest() -> int:
-    """Synthesize all three artifacts, run the report, assert the summary."""
+    """Synthesize the artifacts, run the report + diff fences, assert."""
     import tempfile
 
     from pytorch_distributed_tpu.obs import HeartbeatWriter, MetricsLogger
@@ -202,16 +356,27 @@ def _selftest() -> int:
                 log.log_step(i, step_time=0.01 + 0.001 * (i % 5),
                              n_items=128, lr=0.1,
                              scalars={"loss": 2.0 - 0.05 * i,
-                                      "grad_norm": 1.0 + 0.1 * i})
+                                      "grad_norm": 1.0 + 0.1 * i},
+                             extra={"mfu": 40.0 + 0.1 * i,
+                                    "hfu": 45.0 + 0.1 * i})
             # ft_event records interleave in the same JSONL (ft/)
             log.log_event("skip", step=7, consecutive=1)
             log.log_event("skip", step=8, consecutive=2)
             log.log_event("rollback", step=9, restored_step=5, lr_scale=0.5)
             log.log_event("preempt", step=19)
+        with open(mpath, "a") as f:
+            # torn tail (a killed writer) + a bench staleness event
+            f.write(json.dumps({
+                "bench_event": "stale", "t": now,
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "last_good": "2026-07-31T06:32:08+0000",
+                "reason": "device discovery hung (tunnel unreachable)",
+            }) + "\n")
+            f.write('{"step": 20, "step_time": 0.0')
         # heartbeats: pid 0 current, pid 1 lagging AND stale
         hb_dir = os.path.join(d, "hb")
         w0 = HeartbeatWriter(hb_dir, 0, interval_s=0.0)
-        w0.beat(19)
+        w0.beat(19, step_time_ema=0.011, last_ft="preempt")
         with open(os.path.join(hb_dir, "heartbeat-00001.jsonl"), "w") as f:
             f.write(json.dumps({"pid": 1, "step": 3, "t": now - 120}) + "\n")
         # telemetry CSV (statistics.sh contract)
@@ -228,8 +393,12 @@ def _selftest() -> int:
             now=now, max_step_lag=3, max_beat_age=60.0))
         for needle in ("== steps ==", "steps logged      20", "p95",
                        "throughput", "loss", "grad_norm",
+                       "mfu               mean", "malformed lines   1",
                        "== ft events ==", "skip", "rollback", "preempt",
                        "lr scale          0.5 after 1 rollback",
+                       "== goodput ==", "goodput", "badput/nan_skip",
+                       "badput/rollback_discard",
+                       "== bench ==", "stale", "last good",
                        "== devices ==", "device 0", "device 1",
                        "== heartbeats ==", "STRAGGLER", "step lag",
                        "beat age"):
@@ -237,6 +406,26 @@ def _selftest() -> int:
         # pid 0 must NOT be flagged
         line0 = [ln for ln in out.splitlines() if "process 0" in ln]
         assert line0 and "STRAGGLER" not in line0[0], out
+
+        # ---- diff fences: identical runs PASS, a slowed run REGRESSes ----
+        fast = os.path.join(d, "fast.jsonl")
+        slow = os.path.join(d, "slow.jsonl")
+        for path, st in ((fast, 0.010), (slow, 0.015)):
+            with MetricsLogger(path, flush_every=50) as log:
+                for i in range(30):
+                    log.log_step(i, step_time=st, n_items=128, lr=0.1,
+                                 extra={"mfu": 40.0 * 0.010 / st,
+                                        "hfu": 44.0 * 0.010 / st})
+        a_recs, _ = load_metrics(fast)
+        b_recs, _ = load_metrics(slow)
+        text, regressed = diff_report(a_recs, b_recs)
+        assert regressed, f"selftest: slowed run must REGRESS:\n{text}"
+        for needle in ("== diff ==", "step_time_p50", "REGRESS",
+                       "overall: REGRESS", "throughput", "mfu"):
+            assert needle in text, f"selftest: {needle!r} missing from:\n{text}"
+        text2, regressed2 = diff_report(a_recs, a_recs)
+        assert not regressed2 and "overall: PASS" in text2, (
+            f"selftest: identical runs must PASS:\n{text2}")
     print("obs_report selftest: OK")
     return 0
 
@@ -256,11 +445,26 @@ def main(argv=None) -> int:
                     help="flag processes whose newest beat is older (seconds)")
     ap.add_argument("--now", type=float, default=None,
                     help=argparse.SUPPRESS)  # fixed clock for tests
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="compare two metrics JSONL runs (A = baseline, "
+                    "B = candidate): step-time p50/p95, throughput, MFU, "
+                    "goodput with PASS/REGRESS verdicts; exit 1 on REGRESS")
+    ap.add_argument("--threshold-pct", type=float, default=10.0,
+                    dest="threshold_pct",
+                    help="relative regression threshold for --diff "
+                    "(default 10%%)")
+    ap.add_argument("--goodput-threshold-pp", type=float, default=5.0,
+                    dest="goodput_threshold_pp",
+                    help="absolute goodput regression threshold for --diff "
+                    "in percentage points (default 5)")
     ap.add_argument("--selftest", action="store_true",
                     help="synthesize artifacts, run the report, verify it")
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()
+    if args.diff:
+        return run_diff(args.diff[0], args.diff[1], args.threshold_pct,
+                        args.goodput_threshold_pp)
     print(report(args))
     return 0
 
