@@ -54,6 +54,7 @@ Donation audit record (why the sweep's expectations are what they are):
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from collections import Counter, OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -96,6 +97,63 @@ def _leaf_bytes(leaf) -> int:
         return 0
 
 
+@dataclasses.dataclass
+class Lowering:
+    """One recipe's lowered + compiled step, cached for reuse.
+
+    Lowering and compiling the 12 step builders dominates shardlint's
+    (and the test suite's) wall clock on the 1-core CI host; every
+    analysis downstream of compilation — hazard detectors, collective
+    budgets, the comm ledger — is pure text/jaxpr walking over this
+    record, so one sweep can feed them all (``get_lowering``)."""
+
+    name: str
+    jitted: Any
+    args: Tuple[Any, ...]
+    donate: Optional[Tuple[int, ...]]
+    mesh: Any
+    text: str          # post-optimization HLO
+    compiled: Any
+    closed: Any        # closed jaxpr
+
+    @property
+    def mesh_shape(self) -> Dict[str, int]:
+        return dict(self.mesh.shape) if self.mesh is not None else {}
+
+
+def lower_jitted(jitted, args: Sequence[Any], *, name: str, mesh=None,
+                 donate: Optional[Sequence[int]] = None) -> Lowering:
+    """The expensive half of the analysis: lower + compile + jaxpr."""
+    import jax
+
+    compiled = jitted.lower(*args).compile()
+    return Lowering(
+        name=name, jitted=jitted, args=tuple(args),
+        donate=None if donate is None else tuple(donate), mesh=mesh,
+        text=compiled.as_text(), compiled=compiled,
+        closed=jax.make_jaxpr(jitted)(*args))
+
+
+_LOWERING_CACHE: Dict[str, Lowering] = {}
+
+
+def get_lowering(name: str) -> Lowering:
+    """Session-memoized lowering for one recipe.  The detectors and the
+    comm ledger are pure functions of this record, so repeated
+    ``analyze_recipe`` calls (tests probing different thresholds, the
+    comms sweep, the baseline diff) share one compile."""
+    low = _LOWERING_CACHE.get(name)
+    if low is None:
+        jitted, args, donate, mesh = RECIPES[name]()
+        low = lower_jitted(jitted, args, name=name, mesh=mesh, donate=donate)
+        _LOWERING_CACHE[name] = low
+    return low
+
+
+def clear_lowering_cache() -> None:
+    _LOWERING_CACHE.clear()
+
+
 def analyze_jitted(
     jitted,
     args: Sequence[Any],
@@ -103,9 +161,7 @@ def analyze_jitted(
     name: str,
     mesh=None,
     donate: Optional[Sequence[int]] = None,
-    min_replicated_bytes: int = DEFAULT_MIN_REPLICATED_BYTES,
-    min_promotion_bytes: int = DEFAULT_MIN_PROMOTION_BYTES,
-    min_donation_bytes: int = DEFAULT_MIN_DONATION_BYTES,
+    **thresholds,
 ) -> StepReport:
     """Lower + compile one jitted step and emit its StepReport.
 
@@ -113,14 +169,23 @@ def analyze_jitted(
     triggers the lost-donation check, ``()`` the no-donation opportunity
     probe, ``None`` skips donation accounting entirely (single-purpose
     kernels with no state)."""
-    import jax
+    return analyze_lowering(
+        lower_jitted(jitted, args, name=name, mesh=mesh, donate=donate),
+        **thresholds)
 
-    lowered = jitted.lower(*args)
-    compiled = lowered.compile()
-    text = compiled.as_text()
-    closed = jax.make_jaxpr(jitted)(*args)
 
-    mesh_shape = dict(mesh.shape) if mesh is not None else {}
+def analyze_lowering(
+    low: Lowering,
+    *,
+    min_replicated_bytes: int = DEFAULT_MIN_REPLICATED_BYTES,
+    min_promotion_bytes: int = DEFAULT_MIN_PROMOTION_BYTES,
+    min_donation_bytes: int = DEFAULT_MIN_DONATION_BYTES,
+) -> StepReport:
+    """The cheap half: run every detector over an existing Lowering."""
+    name, text, closed = low.name, low.text, low.closed
+    args, donate = low.args, low.donate
+
+    mesh_shape = low.mesh_shape
     n_devices = 1
     for v in mesh_shape.values():
         n_devices *= v
@@ -129,7 +194,7 @@ def analyze_jitted(
     instrs = hlo_mod.parse_instructions(text)
     report.collectives = hlo_mod.collect_collectives(instrs)
     try:
-        ma = compiled.memory_analysis()
+        ma = low.compiled.memory_analysis()
         report.memory = {
             k: int(getattr(ma, k))
             for k in ("temp_size_in_bytes", "argument_size_in_bytes",
@@ -523,9 +588,29 @@ RECIPES: "OrderedDict[str, Callable[[], tuple]]" = OrderedDict([
 
 
 def analyze_recipe(name: str, **thresholds) -> StepReport:
-    jitted, args, donate, mesh = RECIPES[name]()
-    return analyze_jitted(jitted, args, name=name, mesh=mesh, donate=donate,
-                          **thresholds)
+    """Analyze one recipe, reusing the session's cached lowering: only the
+    first call per step pays the compile; threshold variations re-run just
+    the detectors."""
+    return analyze_lowering(get_lowering(name), **thresholds)
+
+
+def comm_ledger_for(name: str):
+    """The itemized comm ledger (obs/comms.py) for one recipe, off the
+    shared lowering cache."""
+    from pytorch_distributed_tpu.obs import comms
+
+    low = get_lowering(name)
+    return comms.ledger_from_hlo_text(low.text, step=name,
+                                      mesh_shape=low.mesh_shape)
+
+
+def sweep_comm_ledgers(names: Optional[Sequence[str]] = None):
+    """Ledgers for every (or the named subset of) recipe step builders —
+    what ``scripts/shardlint.py --comm-ledger`` serializes to
+    ``comm_ledger.json``."""
+    selected = list(RECIPES) if names is None else [
+        n for n in names if n in RECIPES]
+    return [comm_ledger_for(n) for n in selected]
 
 
 def analyze_all(names: Optional[Sequence[str]] = None,

@@ -153,6 +153,124 @@ def collect_collectives(
     return out
 
 
+# ------------------------------------------------- per-collective details
+
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[([0-9,]+)\]<=\[")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{")
+_METADATA_RE = re.compile(
+    r'metadata=\{[^}]*?op_name="(?P<op_name>[^"]*)"'
+    r'(?:[^}]*?source_file="(?P<file>[^"]*)")?'
+    r'(?:[^}]*?source_line=(?P<line>\d+))?')
+
+
+def _balanced_braces(text: str, start: int) -> str:
+    """Contents of the ``{...}`` block opening at ``text[start] == '{'``."""
+    depth, i = 0, start
+    while i < len(text):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start + 1:i]
+        i += 1
+    return text[start + 1:]
+
+
+def parse_replica_groups(line: str) -> Tuple[int, int]:
+    """``(n_groups, group_size)`` of a collective instruction line.
+
+    Handles both encodings XLA emits: the iota form
+    ``replica_groups=[G,S]<=[N]`` (G groups of S devices — leading dims
+    multiply into the group count) and the explicit nested-brace form
+    ``replica_groups={{0,1},{2,3}}``.  ``collective-permute`` carries
+    ``source_target_pairs={{s,t},...}`` instead: each pair is reported as
+    a 2-device "group".  Returns ``(1, 1)`` when no group annotation is
+    present (a single-device module)."""
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        dims = [int(d) for d in m.group(1).split(",")]
+        size = dims[-1] if dims else 1
+        groups = 1
+        for d in dims[:-1]:
+            groups *= d
+        return (max(1, groups), max(1, size))
+    key = "replica_groups={"
+    start = line.find(key)
+    if start >= 0:
+        block = _balanced_braces(line, start + len(key) - 1)
+        groups = [g for g in re.findall(r"\{([0-9,\s]*)\}", block)]
+        if groups:
+            sizes = [len([t for t in g.split(",") if t.strip()])
+                     for g in groups]
+            return (len(groups), max(sizes))
+        # replica_groups={} — all devices in one group, size unknown here
+        return (1, 1)
+    m = _PAIRS_RE.search(line)
+    if m:
+        block = _balanced_braces(line, m.end() - 1)
+        pairs = re.findall(r"\{[0-9,\s]*\}", block)
+        return (max(1, len(pairs)), 2)
+    return (1, 1)
+
+
+def parse_op_metadata(line: str) -> Tuple[str, str]:
+    """``(op_name, "file:line")`` from an instruction's ``metadata={...}``
+    annotation; empty strings when absent.  ``op_name`` is the full jax
+    scope path (``jit(step)/jit(main)/.../grad_sync/...``) — the hook that
+    lets the comm ledger attribute a collective to the ``trace.scope`` /
+    ``named_scope`` phase it lowered under."""
+    m = _METADATA_RE.search(line)
+    if not m:
+        return ("", "")
+    src = ""
+    if m.group("file"):
+        src = m.group("file")
+        if m.group("line"):
+            src += f":{m.group('line')}"
+    return (m.group("op_name"), src)
+
+
+@dataclasses.dataclass
+class CollectiveDetail:
+    """One collective instruction with its attribution fields."""
+
+    name: str              # HLO instruction name (all-reduce.13)
+    kind: str              # normalized opcode (-start folded in)
+    bytes: int             # per-device result payload bytes
+    shapes: List[Shape]
+    n_groups: int
+    group_size: int        # replica-group fan-out (devices per group)
+    op_name: str           # full jax scope path from metadata
+    source: str            # "file:line" from metadata
+    computation: str
+
+    def to_dict(self) -> Dict[str, object]:
+        d = dataclasses.asdict(self)
+        d["shapes"] = [[dt, list(dims)] for dt, dims in self.shapes]
+        return d
+
+
+def collect_collective_details(hlo_text: str) -> List[CollectiveDetail]:
+    """Every collective in the module as an attributed record, in program
+    order.  Async pairs count once (the ``-start`` op carries the payload;
+    ``-done`` is bookkeeping, skipped)."""
+    out: List[CollectiveDetail] = []
+    for ins in parse_instructions(hlo_text):
+        if ins.opcode not in _COLLECTIVE_SET:
+            continue
+        kind = ins.opcode[:-len("-start")] \
+            if ins.opcode.endswith("-start") else ins.opcode
+        n_groups, group_size = parse_replica_groups(ins.line)
+        op_name, source = parse_op_metadata(ins.line)
+        out.append(CollectiveDetail(
+            name=ins.name, kind=kind, bytes=ins.result_bytes(),
+            shapes=list(ins.shapes), n_groups=n_groups,
+            group_size=group_size, op_name=op_name, source=source,
+            computation=ins.computation))
+    return out
+
+
 # ------------------------------------------------------------ module header
 
 _ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}\s*[,)]")

@@ -111,11 +111,18 @@ class StepReport:
 
 def baseline_entry(report: StepReport) -> Dict[str, Any]:
     """The part of a report that is pinned against CI: the collective
-    budget.  Findings are gated directly by severity, not baselined."""
-    return {"collectives": {
-        k: {"count": v["count"], "bytes": v["bytes"]}
-        for k, v in sorted(report.collectives.items())
-    }}
+    budget.  Findings are gated directly by severity, not baselined.
+
+    ``total_bytes`` pins the cross-kind sum so a reshuffle that trades,
+    say, all-gathers for a bigger all-reduce while raising the wire total
+    still fails, even when no single kind exceeds its own line."""
+    return {
+        "collectives": {
+            k: {"count": v["count"], "bytes": v["bytes"]}
+            for k, v in sorted(report.collectives.items())
+        },
+        "total_bytes": sum(v["bytes"] for v in report.collectives.values()),
+    }
 
 
 def diff_against_baseline(report: StepReport,
@@ -154,6 +161,19 @@ def diff_against_baseline(report: StepReport,
                 message=(f"{kind} below baseline ({now['count']} ops / "
                          f"{now['bytes']} B vs {ref['count']} / "
                          f"{ref['bytes']}): refresh with --update-baseline"),
+            ))
+    # the per-step total budget (absent from pre-comm-ledger baselines:
+    # skipped until --update-baseline refreshes the pin)
+    ref_total = entry.get("total_bytes")
+    if ref_total is not None:
+        now_total = sum(v["bytes"] for v in report.collectives.values())
+        if now_total > ref_total:
+            findings.append(Finding(
+                kind="collective-regression", severity="error",
+                where=f"{report.name}:total",
+                bytes=now_total - ref_total,
+                message=(f"per-step collective bytes budget exceeded: "
+                         f"{now_total} B total vs baseline {ref_total} B"),
             ))
     return findings
 

@@ -18,20 +18,53 @@ is three ``.item()`` calls per batch plus a 500 ms nvidia-smi CSV).
   (nan-skips, rollback discards, preemption gaps, recompiles, stalls).
 - ``watchdog``  — ``RecompileWatchdog``: jax.monitoring-hooked counter
   that flags any post-warmup recompilation of a jitted step-fn.
+- ``comms``     — the static communication ledger: every collective in a
+  compiled step with payload/wire bytes, replica-group fan-out, and jax
+  scope attribution (``CommLedger``), emitted per run as
+  ``comm_ledger.json`` and stamped into the metrics JSONL.
+- ``timeline``  — the runtime side: a pure-python XPlane decoder turning
+  profiler captures into per-stream spans, per-step comm/compute/overlap
+  accounting (exposed-comm), heartbeat-based cross-rank clock alignment,
+  and Chrome-trace/Perfetto export (``scripts/obs_timeline.py``).
 
 ``scripts/obs_report.py`` folds a run's JSONL + heartbeats + telemetry CSV
-into one human-readable summary, and ``--diff A B`` fences two runs
-against each other with PASS/REGRESS verdicts.
+into one human-readable summary (``--format json`` for machines), and
+``--diff A B`` fences two runs against each other with PASS/REGRESS
+verdicts — step time, throughput, MFU, goodput, exposed comm, wire bytes.
 """
 
+from pytorch_distributed_tpu.obs.comms import (
+    CommEntry,
+    CommLedger,
+    ledger_from_hlo_text,
+    ledger_from_jitted,
+    load_ledgers,
+    wire_bytes,
+    write_ledgers,
+)
 from pytorch_distributed_tpu.obs.flops import (
+    CommCost,
     MFUReporter,
     StepCost,
+    comm_residual_pct,
     device_peak_flops,
+    image_comm_bytes,
     image_step_cost,
+    lm_comm_bytes,
     lm_step_cost,
     lm_step_cost_for,
     xla_step_flops,
+)
+from pytorch_distributed_tpu.obs.timeline import (
+    Span,
+    StepComm,
+    Timeline,
+    aggregate_steps,
+    analyze_steps,
+    clock_offsets_from_heartbeats,
+    marry_ledger,
+    parse_xspace,
+    to_chrome_trace,
 )
 from pytorch_distributed_tpu.obs.goodput import (
     GoodputTracker,
@@ -51,6 +84,7 @@ from pytorch_distributed_tpu.obs.metrics import (
 from pytorch_distributed_tpu.obs.trace import (
     ProfileWindow,
     annotate,
+    capture,
     parse_span,
     scope,
 )
@@ -65,6 +99,7 @@ __all__ = [
     "find_stragglers",
     "scope",
     "annotate",
+    "capture",
     "parse_span",
     "ProfileWindow",
     "StepCost",
@@ -78,4 +113,24 @@ __all__ = [
     "compute_goodput",
     "summarize_goodput",
     "RecompileWatchdog",
+    "CommEntry",
+    "CommLedger",
+    "ledger_from_hlo_text",
+    "ledger_from_jitted",
+    "load_ledgers",
+    "wire_bytes",
+    "write_ledgers",
+    "CommCost",
+    "comm_residual_pct",
+    "image_comm_bytes",
+    "lm_comm_bytes",
+    "Span",
+    "StepComm",
+    "Timeline",
+    "aggregate_steps",
+    "analyze_steps",
+    "clock_offsets_from_heartbeats",
+    "marry_ledger",
+    "parse_xspace",
+    "to_chrome_trace",
 ]

@@ -1,0 +1,211 @@
+"""Static communication ledger: per-collective bytes/fan-out attribution.
+
+The shardlint baseline (analysis/baseline.json) pins per-kind collective
+*totals*; this module keeps the itemized receipt.  From one compiled
+step's post-optimization HLO it extracts every collective instruction —
+kind, per-device payload bytes, estimated wire bytes, replica-group
+fan-out, and the jax scope path it lowered under (``metadata={op_name}``,
+fed by ``trace.scope`` / ``named_scope`` annotations like ``grad_sync``
+or ``pp_hop``) — so a regression report can say *which* collective grew
+and *whose* code emitted it, not just that totals moved.
+
+Ledgers serialize to ``comm_ledger.json`` (one entry per step builder)
+and fold into the metrics JSONL as ``model_comm_bytes`` /
+``collective_count`` fields; scripts/obs_timeline.py marries them to
+measured XPlane collective spans to turn bytes into bus bandwidth.
+
+Wire-byte convention (per participating device, EQuARX-style accounting,
+arxiv 2506.17615): for a ``b``-byte per-device payload in a group of
+``n`` devices —
+
+- all-reduce:          ``2*(n-1)/n * b``   (ring reduce-scatter+all-gather)
+- all-gather:          ``(n-1)/n * b``     (``b`` = gathered result size)
+- reduce-scatter:      ``(n-1) * b``       (``b`` = scattered shard size)
+- all-to-all:          ``(n-1)/n * b``
+- collective-permute:  ``b``               (one hop sends the buffer once)
+- collective-broadcast:``b``
+
+Like the rest of ``analysis/hlo.py`` this is pure text parsing — no jax
+import — so ledgers can be built (and unit-tested) from HLO fixtures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+from pytorch_distributed_tpu.analysis import hlo as hlo_mod
+
+# Multiplier on per-device payload bytes -> wire bytes, as (numerator-of-
+# (n-1) term, divide-by-n).  See module docstring for the derivations.
+_WIRE_FACTORS = {
+    "all-reduce": (2.0, True),
+    "all-gather": (1.0, True),
+    "reduce-scatter": (1.0, False),
+    "all-to-all": (1.0, True),
+}
+
+
+def wire_bytes(kind: str, payload_bytes: int, group_size: int) -> float:
+    """Estimated bytes each participant puts on the wire for one op."""
+    n = max(1, int(group_size))
+    factor = _WIRE_FACTORS.get(kind)
+    if factor is None:  # permute / broadcast: the buffer crosses once
+        return float(payload_bytes) if n > 1 else 0.0
+    num, div = factor
+    if n == 1:
+        return 0.0
+    return num * (n - 1) * payload_bytes / (n if div else 1)
+
+
+def phase_of_op_name(op_name: str) -> str:
+    """Coarse step phase of a jax scope path.
+
+    ``transpose(jvp(...))`` components mark autodiff-transposed (backward)
+    ops; an ``optimizer``/``grad_sync``/``grad_clip`` scope marks the
+    update; everything else under ``jvp`` or the plain forward trace is
+    ``forward``.  Pipeline-schedule scopes (``pp_hop``, ``pp*_fwd`` …)
+    win over the autodiff classification: a hop is a hop whichever
+    direction lowered it."""
+    if not op_name:
+        return "unknown"
+    parts = op_name.split("/")
+    for p in parts:
+        if p in ("pp_hop", "pp_stage_fwd", "pp1f1b_fwd", "pp1f1b_bwd",
+                 "pp1f1b_head", "ppint_fwd", "ppint_bwd", "ppint_head"):
+            return p
+    for p in parts:
+        if p in ("optimizer", "grad_sync", "grad_clip"):
+            return p
+    if any(p.startswith("transpose(") for p in parts):
+        return "backward"
+    return "forward"
+
+
+@dataclasses.dataclass
+class CommEntry:
+    """One collective in the ledger (the attributed receipt line)."""
+
+    name: str
+    kind: str
+    bytes: int            # per-device payload (matches baseline accounting)
+    wire_bytes: float     # estimated per-participant wire traffic
+    n_groups: int
+    group_size: int
+    phase: str            # coarse scope phase (phase_of_op_name)
+    op_name: str          # full jax scope path
+    source: str           # "file:line"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CommLedger:
+    """Everything the comm ledger knows about one compiled step."""
+
+    step: str
+    mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
+    entries: List[CommEntry] = dataclasses.field(default_factory=list)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(e.bytes for e in self.entries)
+
+    @property
+    def total_wire_bytes(self) -> float:
+        return sum(e.wire_bytes for e in self.entries)
+
+    @property
+    def count(self) -> int:
+        return len(self.entries)
+
+    def by_kind(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for e in self.entries:
+            slot = out.setdefault(
+                e.kind, {"count": 0, "bytes": 0, "wire_bytes": 0.0})
+            slot["count"] += 1
+            slot["bytes"] += e.bytes
+            slot["wire_bytes"] += e.wire_bytes
+        return out
+
+    def by_phase(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for e in self.entries:
+            slot = out.setdefault(
+                e.phase, {"count": 0, "bytes": 0, "wire_bytes": 0.0})
+            slot["count"] += 1
+            slot["bytes"] += e.bytes
+            slot["wire_bytes"] += e.wire_bytes
+        return out
+
+    def metrics_fields(self) -> Dict[str, float]:
+        """The per-step fields the trainers stamp into the metrics JSONL."""
+        return {
+            "model_comm_bytes": float(self.total_bytes),
+            "comm_wire_bytes": float(self.total_wire_bytes),
+            "collective_count": float(self.count),
+        }
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "step": self.step,
+            "mesh_shape": dict(self.mesh_shape),
+            "total_bytes": self.total_bytes,
+            "total_wire_bytes": self.total_wire_bytes,
+            "count": self.count,
+            "by_kind": self.by_kind(),
+            "by_phase": self.by_phase(),
+            "entries": [e.to_dict() for e in self.entries],
+        }
+
+
+def ledger_from_hlo_text(
+    hlo_text: str,
+    step: str = "step",
+    mesh_shape: Optional[Dict[str, int]] = None,
+) -> CommLedger:
+    """Build the ledger for one compiled module's text."""
+    entries = []
+    for d in hlo_mod.collect_collective_details(hlo_text):
+        entries.append(CommEntry(
+            name=d.name, kind=d.kind, bytes=d.bytes,
+            wire_bytes=wire_bytes(d.kind, d.bytes, d.group_size),
+            n_groups=d.n_groups, group_size=d.group_size,
+            phase=phase_of_op_name(d.op_name), op_name=d.op_name,
+            source=d.source))
+    return CommLedger(step=step, mesh_shape=dict(mesh_shape or {}),
+                      entries=entries)
+
+
+def ledger_from_jitted(jitted, args: Sequence[Any], *, step: str = "step",
+                       mesh=None) -> CommLedger:
+    """Lower + compile a jitted step and build its ledger.  NOTE: in jax
+    0.4.x the AOT ``.lower().compile()`` path does NOT share the jit call
+    cache, so calling this on a step the trainer also executes costs one
+    extra compile — the trainers gate it behind an opt-in flag."""
+    text = jitted.lower(*args).compile().as_text()
+    mesh_shape = dict(mesh.shape) if mesh is not None else {}
+    return ledger_from_hlo_text(text, step=step, mesh_shape=mesh_shape)
+
+
+def write_ledgers(path: str, ledgers: Sequence[CommLedger]) -> None:
+    """``comm_ledger.json``: ``{step_name: ledger_dict}``."""
+    data = {lg.step: lg.to_dict() for lg in ledgers}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_ledgers(path: str) -> Dict[str, CommLedger]:
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[str, CommLedger] = {}
+    for step, d in data.items():
+        entries = [CommEntry(**e) for e in d.get("entries", [])]
+        out[step] = CommLedger(step=step,
+                               mesh_shape=d.get("mesh_shape", {}),
+                               entries=entries)
+    return out

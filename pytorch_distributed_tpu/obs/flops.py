@@ -347,6 +347,97 @@ def xla_step_flops(jitted, *args) -> float:
     return float(analysis["flops"])
 
 
+# ----------------------------------------------------------- comm estimates
+@dataclasses.dataclass(frozen=True)
+class CommCost:
+    """Analytic per-step collective payload bytes (per device), by kind.
+
+    The comm-side twin of ``StepCost``: what the parallelism layout
+    *should* move per optimizer step, cross-checked against the measured
+    ledger (obs/comms.py) the same way FLOPs are fenced against
+    ``cost_analysis()`` — tests/test_comms.py pins the residual at ±15%.
+    """
+
+    by_kind: Dict[str, float]
+    breakdown: Dict[str, float]
+
+    @property
+    def total_bytes(self) -> float:
+        return sum(self.by_kind.values())
+
+
+def comm_residual_pct(predicted: float, measured: float) -> float:
+    """Relative prediction error in percent (against the measurement)."""
+    if not measured:
+        return 0.0 if not predicted else float("inf")
+    return 100.0 * abs(predicted - measured) / measured
+
+
+def image_comm_bytes(params: int, dp: int = 4,
+                     metric_scalars: int = 5) -> CommCost:
+    """Pure-DP image train step: one gradient all-reduce per parameter
+    leaf (f32) plus the handful of scalar loss/metric psums
+    (train/steps.py's loss_and_metrics reductions).  ``dp == 1`` lowers
+    no collectives at all."""
+    if dp <= 1:
+        return CommCost(by_kind={}, breakdown={})
+    grad = 4.0 * params
+    scalars = 4.0 * metric_scalars
+    return CommCost(by_kind={"all-reduce": grad + scalars},
+                    breakdown={"grad_sync": grad, "scalars": scalars})
+
+
+def lm_comm_bytes(vocab_size: int, d_model: int, n_layers: int, batch: int,
+                  seq_len: int, dp: int = 4, tp: int = 1,
+                  fused_ce: bool = False, params: Optional[int] = None,
+                  loss_scalars: int = 2) -> CommCost:
+    """Transformer-LM train-step collective payload bytes per device.
+
+    DP (``tp == 1``): the gradient all-reduce covers every parameter
+    *plus one extra tied-embedding block* — the tied embed's gradient
+    arrives as two separately-reduced pieces (the input-embedding
+    scatter-add and the output-head ``embed.attend`` matmul transpose),
+    so ``V*D`` is counted twice — plus ``loss_scalars`` scalar psums.
+
+    TP (Megatron-style tensor parallelism over a ``dp x tp`` mesh, with
+    ``act = (batch/dp) * seq * d_model * 4`` bytes — the per-data-shard
+    activation block):
+
+    - 2 forward psums per layer (attn proj out, fc2 out) and 2 backward
+      psums per layer (qkv input grad, fc1 input grad): ``4*L*act``;
+    - head-sharded attention boundary: 2 permutes of ``act`` forward +
+      2 of ``act/2`` backward = ``3*act`` collective-permute bytes;
+    - vocab-sharded tied embedding: gather psum ``act`` forward +
+      scatter-add psum ``act/2`` backward;
+    - gradient sync over the data axis at the *sharded* parameter size:
+      ``4*(params + V*D)/tp``.
+
+    The fused-CE chunk loop's per-chunk scalar pmax/psum/pmin carries are
+    a few hundred bytes and not modeled.  ``params`` defaults to the
+    analytic ``lm_step_cost`` count for the same config."""
+    if params is None:
+        params = lm_step_cost(vocab_size, d_model, n_layers, batch,
+                              seq_len).params
+    grad_synced = 4.0 * (params + vocab_size * d_model)
+    scalars = 4.0 * loss_scalars
+    if tp <= 1:
+        if dp <= 1:
+            return CommCost(by_kind={}, breakdown={})
+        return CommCost(
+            by_kind={"all-reduce": grad_synced + scalars},
+            breakdown={"grad_sync": grad_synced, "scalars": scalars})
+    act = (batch / max(1, dp)) * seq_len * d_model * 4.0
+    tp_psums = 4.0 * n_layers * act
+    embed = 1.5 * act
+    permutes = 3.0 * n_layers * act
+    grad = grad_synced / tp
+    allreduce = grad + tp_psums + embed + scalars
+    return CommCost(
+        by_kind={"all-reduce": allreduce, "collective-permute": permutes},
+        breakdown={"grad_sync": grad, "tp_psums": tp_psums, "embed": embed,
+                   "head_permutes": permutes, "scalars": scalars})
+
+
 # ------------------------------------------------------------------ reporter
 class MFUReporter:
     """Turns host-measured step seconds into per-step MFU/HFU fields for

@@ -1,0 +1,619 @@
+"""Runtime comm/compute timeline from the profiler's XPlane captures.
+
+``jax.profiler`` writes each capture as an ``*.xplane.pb`` protobuf (the
+XSpace schema TensorBoard's profile plugin consumes).  Importing the
+TensorFlow converter stack to read ~10 KB of spans is a multi-second tax
+on the 1-core CI host, so this module decodes the wire format directly —
+a few hundred lines of varint scanning, no proto/TF/jax imports — into
+plain ``Span`` records, then answers the questions the static comm
+ledger (obs/comms.py) cannot:
+
+- how long each collective *actually took* per step window,
+- how much collective time hid under compute (**overlap %**) vs stalled
+  the device (**exposed comm** — the number EQuARX-style quantized
+  collectives must shrink for the win to be real),
+- what the cross-rank picture looks like: per-process captures merged on
+  a common clock (heartbeat wall-times estimate per-rank skew) and
+  exported as Chrome-trace JSON for Perfetto.
+
+Schema note: field numbers below mirror tensorflow/tsl's xplane.proto
+(XSpace{planes=1,hostnames=4}; XPlane{id=1,name=2,lines=3,
+event_metadata=4,stat_metadata=5,stats=6}; XLine{id=1,name=2,
+timestamp_ns=3,events=4,display_name=11}; XEvent{metadata_id=1,
+offset_ps=2,duration_ps=3,stats=4}; XStat{metadata_id=1,double=2,
+uint64=3,int64=4,str=5,bytes=6,ref=7}; XEventMetadata{id=1,name=2};
+XStatMetadata{id=1,name=2}).  ``encode_xspace`` is the inverse — enough
+of an encoder to build test fixtures and the obs_timeline selftest
+capture without a live profiler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.analysis.hlo import COLLECTIVE_OPS
+
+# Host-side / executor bookkeeping spans: never counted as device compute.
+_INFRA_PREFIXES = (
+    "ThreadpoolListener", "ThunkExecutor", "TfrtCpuExecutable",
+    "ParseArguments", "PjitFunction", "$", "Execute", "TransferTo",
+    "TransferFrom", "BufferFromHost", "copy_start", "copy_done",
+    "infeed", "outfeed",
+)
+
+
+def is_collective_name(name: str) -> bool:
+    """``all-reduce`` / ``all-reduce.13`` / ``all-gather-start.2`` ..."""
+    base = name.split(".", 1)[0]
+    if base.endswith("-start") or base.endswith("-done"):
+        base = base.rsplit("-", 1)[0]
+    return base in COLLECTIVE_OPS or any(
+        name.startswith(op) for op in COLLECTIVE_OPS)
+
+
+def collective_kind(name: str) -> str:
+    for op in COLLECTIVE_OPS:
+        if name.startswith(op):
+            return op
+    return name.split(".", 1)[0]
+
+
+# ------------------------------------------------------- wire-format decode
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return val, i
+        shift += 7
+
+
+def _iter_fields(buf: bytes) -> Iterator[Tuple[int, int, Any]]:
+    """Yield ``(field_number, wire_type, value)`` over one message."""
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        fnum, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _read_varint(buf, i)
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt} at offset {i}")
+        yield fnum, wt, v
+
+
+def _to_signed64(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _decode_metadata_map(entry: bytes) -> Tuple[int, str]:
+    """One ``map<int64, X*Metadata>`` entry -> (id, name)."""
+    meta_id, name = 0, ""
+    for fnum, _wt, v in _iter_fields(entry):
+        if fnum == 1:
+            meta_id = v
+        elif fnum == 2:
+            for f2, _w2, v2 in _iter_fields(v):
+                if f2 == 1:
+                    meta_id = v2
+                elif f2 == 2:
+                    name = v2.decode("utf-8", "replace")
+    return meta_id, name
+
+
+def _decode_stat(buf: bytes, stat_names: Dict[int, str]) -> Tuple[str, Any]:
+    key, val = "", None
+    for fnum, _wt, v in _iter_fields(buf):
+        if fnum == 1:
+            key = stat_names.get(v, str(v))
+        elif fnum == 2:
+            val = struct.unpack("<d", v)[0]
+        elif fnum == 3:
+            val = v
+        elif fnum == 4:
+            val = _to_signed64(v)
+        elif fnum == 5:
+            val = v.decode("utf-8", "replace")
+        elif fnum == 6:
+            val = v
+        elif fnum == 7:
+            val = stat_names.get(v, str(v))
+    return key, val
+
+
+# ---------------------------------------------------------------- the model
+
+@dataclasses.dataclass
+class Span:
+    """One timed event, absolute-clocked within its capture."""
+
+    name: str
+    start_ns: float
+    dur_ns: float
+    plane: str
+    line: str
+    stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def end_ns(self) -> float:
+        return self.start_ns + self.dur_ns
+
+    def is_collective(self) -> bool:
+        return is_collective_name(self.name)
+
+    def is_xla_op(self) -> bool:
+        """Device-executed HLO op (vs host/python/bookkeeping span)."""
+        if any(k in self.stats for k in ("hlo_op", "hlo_module",
+                                         "program_id", "hlo_category")):
+            return True
+        return False
+
+
+@dataclasses.dataclass
+class Timeline:
+    """One rank's parsed capture."""
+
+    source: str
+    hostname: str = ""
+    spans: List[Span] = dataclasses.field(default_factory=list)
+
+    def device_lines(self) -> List[Tuple[str, str]]:
+        """(plane, line) pairs that carry XLA op spans."""
+        seen: Dict[Tuple[str, str], bool] = {}
+        for s in self.spans:
+            key = (s.plane, s.line)
+            if s.is_xla_op() or s.is_collective():
+                seen[key] = True
+            else:
+                seen.setdefault(key, False)
+        return [k for k, has_ops in seen.items() if has_ops]
+
+    def annotations(self, name: str) -> List[Span]:
+        """Host TraceAnnotation spans with exactly this name (the step
+        markers ``trace.scope`` wrote)."""
+        return sorted((s for s in self.spans
+                       if s.name == name and not s.is_xla_op()),
+                      key=lambda s: s.start_ns)
+
+
+def parse_xspace_bytes(data: bytes, source: str = "<bytes>") -> Timeline:
+    tl = Timeline(source=source)
+    for fnum, _wt, v in _iter_fields(data):
+        if fnum == 4 and isinstance(v, bytes):
+            tl.hostname = v.decode("utf-8", "replace")
+        elif fnum == 1:
+            _parse_plane(v, tl)
+    tl.spans.sort(key=lambda s: s.start_ns)
+    return tl
+
+
+def parse_xspace(path: str) -> Timeline:
+    with open(path, "rb") as f:
+        return parse_xspace_bytes(f.read(), source=path)
+
+
+def _parse_plane(buf: bytes, tl: Timeline) -> None:
+    plane_name = ""
+    lines: List[bytes] = []
+    event_names: Dict[int, str] = {}
+    stat_names: Dict[int, str] = {}
+    for fnum, _wt, v in _iter_fields(buf):
+        if fnum == 2:
+            plane_name = v.decode("utf-8", "replace")
+        elif fnum == 3:
+            lines.append(v)
+        elif fnum == 4:
+            mid, name = _decode_metadata_map(v)
+            event_names[mid] = name
+        elif fnum == 5:
+            mid, name = _decode_metadata_map(v)
+            stat_names[mid] = name
+    for line_buf in lines:
+        _parse_line(line_buf, plane_name, event_names, stat_names, tl)
+
+
+def _parse_line(buf: bytes, plane: str, event_names: Dict[int, str],
+                stat_names: Dict[int, str], tl: Timeline) -> None:
+    line_name, ts_ns = "", 0
+    events: List[bytes] = []
+    for fnum, _wt, v in _iter_fields(buf):
+        if fnum == 2 and not line_name:
+            line_name = v.decode("utf-8", "replace")
+        elif fnum == 11:
+            line_name = v.decode("utf-8", "replace")
+        elif fnum == 3:
+            ts_ns = v
+        elif fnum == 4:
+            events.append(v)
+    for ev in events:
+        meta_id = offset_ps = dur_ps = 0
+        stats: Dict[str, Any] = {}
+        for fnum, _wt, v in _iter_fields(ev):
+            if fnum == 1:
+                meta_id = v
+            elif fnum == 2:
+                offset_ps = v
+            elif fnum == 3:
+                dur_ps = v
+            elif fnum == 4:
+                k, sv = _decode_stat(v, stat_names)
+                if k:
+                    stats[k] = sv
+        tl.spans.append(Span(
+            name=event_names.get(meta_id, str(meta_id)),
+            start_ns=ts_ns + offset_ps / 1000.0,
+            dur_ns=dur_ps / 1000.0,
+            plane=plane, line=line_name, stats=stats))
+
+
+def find_xplane_files(trace_dir: str) -> List[str]:
+    return sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
+
+
+# ------------------------------------------------------------ interval math
+
+def _union(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    if not intervals:
+        return []
+    intervals = sorted(intervals)
+    out = [list(intervals[0])]
+    for lo, hi in intervals[1:]:
+        if lo <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], hi)
+        else:
+            out.append([lo, hi])
+    return [(lo, hi) for lo, hi in out]
+
+
+def _measure(union: List[Tuple[float, float]]) -> float:
+    return sum(hi - lo for lo, hi in union)
+
+
+def _intersection_measure(a: List[Tuple[float, float]],
+                          b: List[Tuple[float, float]]) -> float:
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _clip(intervals: List[Tuple[float, float]],
+          lo: float, hi: float) -> List[Tuple[float, float]]:
+    return [(max(a, lo), min(b, hi))
+            for a, b in intervals if b > lo and a < hi]
+
+
+# ------------------------------------------------------------ step analysis
+
+@dataclasses.dataclass
+class StepComm:
+    """Comm/compute accounting for one step window on one rank stream."""
+
+    step: int
+    rank: str                  # "plane/line" stream key
+    window_ns: float
+    comm_ns: float = 0.0
+    compute_ns: float = 0.0
+    overlap_ns: float = 0.0
+    by_kind: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def exposed_ns(self) -> float:
+        return max(0.0, self.comm_ns - self.overlap_ns)
+
+    @property
+    def overlap_pct(self) -> float:
+        return 100.0 * self.overlap_ns / self.comm_ns if self.comm_ns else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["exposed_ns"] = self.exposed_ns
+        d["overlap_pct"] = self.overlap_pct
+        return d
+
+
+def analyze_steps(tl: Timeline, annotation: Optional[str] = None,
+                  annotations: Sequence[str] = ("lm_step", "train_step",
+                                                "profile_step"),
+                  ) -> List[StepComm]:
+    """Per-(step-window, device-stream) comm/compute/overlap accounting.
+
+    Step windows come from the host TraceAnnotation spans ``trace.scope``
+    wrote around each step call (``lm_step`` / ``train_step``); with no
+    markers in the capture, the whole capture is one window (step -1).
+
+    Per stream: ``comm`` is the union of collective spans, ``compute``
+    the union of non-collective XLA op spans, ``overlap`` their
+    intersection — so ``exposed = comm - overlap`` is device time where
+    communication ran with *no* concurrent compute on that stream: the
+    stall a faster (or quantized) collective would actually recover."""
+    names = [annotation] if annotation else list(annotations)
+    markers: List[Span] = []
+    for name in names:
+        markers = tl.annotations(name)
+        if markers:
+            break
+    if markers:
+        windows = [(i, m.start_ns, m.end_ns) for i, m in enumerate(markers)]
+    else:
+        ops = [s for s in tl.spans if s.is_xla_op() or s.is_collective()]
+        if not ops:
+            return []
+        windows = [(-1, min(s.start_ns for s in ops),
+                    max(s.end_ns for s in ops))]
+
+    out: List[StepComm] = []
+    streams = tl.device_lines()
+    for plane, line in streams:
+        spans = [s for s in tl.spans if s.plane == plane and s.line == line]
+        comm = [s for s in spans if s.is_collective() and s.dur_ns > 0]
+        comp = [s for s in spans
+                if s.is_xla_op() and not s.is_collective() and s.dur_ns > 0]
+        comm_iv = _union([(s.start_ns, s.end_ns) for s in comm])
+        comp_iv = _union([(s.start_ns, s.end_ns) for s in comp])
+        for step, lo, hi in windows:
+            c_iv = _clip(comm_iv, lo, hi)
+            p_iv = _clip(comp_iv, lo, hi)
+            sc = StepComm(step=step, rank=f"{plane}/{line}",
+                          window_ns=hi - lo,
+                          comm_ns=_measure(c_iv),
+                          compute_ns=_measure(p_iv),
+                          overlap_ns=_intersection_measure(c_iv, p_iv))
+            for s in comm:
+                if s.end_ns <= lo or s.start_ns >= hi:
+                    continue
+                kind = collective_kind(s.name)
+                slot = sc.by_kind.setdefault(
+                    kind, {"count": 0, "time_ns": 0.0})
+                slot["count"] += 1
+                slot["time_ns"] += (min(s.end_ns, hi) - max(s.start_ns, lo))
+            if sc.comm_ns or sc.compute_ns:
+                out.append(sc)
+    return out
+
+
+def aggregate_steps(stats: Sequence[StepComm]) -> Dict[str, Any]:
+    """Fold per-(step, stream) records into capture-level numbers: mean
+    per-step comm/exposed time (averaged across streams, summed across
+    nothing — a step's exposed time is a per-rank stall)."""
+    if not stats:
+        return {"steps": 0, "streams": 0}
+    steps = sorted({s.step for s in stats})
+    streams = sorted({s.rank for s in stats})
+    comm = [s.comm_ns for s in stats]
+    exposed = [s.exposed_ns for s in stats]
+    overlap_pct = [s.overlap_pct for s in stats if s.comm_ns]
+    by_kind: Dict[str, Dict[str, float]] = {}
+    for s in stats:
+        for kind, slot in s.by_kind.items():
+            agg = by_kind.setdefault(kind, {"count": 0, "time_ns": 0.0})
+            agg["count"] += slot["count"]
+            agg["time_ns"] += slot["time_ns"]
+    return {
+        "steps": len(steps),
+        "streams": len(streams),
+        "comm_ms_mean": sum(comm) / len(comm) / 1e6,
+        "exposed_ms_mean": sum(exposed) / len(exposed) / 1e6,
+        "overlap_pct_mean": (sum(overlap_pct) / len(overlap_pct)
+                             if overlap_pct else 0.0),
+        "by_kind": by_kind,
+    }
+
+
+def marry_ledger(stats: Sequence[StepComm], ledger) -> Dict[str, Any]:
+    """Join measured per-kind collective time with the static ledger's
+    per-kind bytes: effective per-kind bus bandwidth and the count match
+    (a measured-count / ledger-count mismatch means the capture windows
+    don't line up with whole steps).  ``ledger`` is an obs.comms
+    CommLedger."""
+    agg = aggregate_steps(stats)
+    n_steps = max(1, agg.get("steps", 1))
+    n_streams = max(1, agg.get("streams", 1))
+    out: Dict[str, Any] = {}
+    measured = agg.get("by_kind", {})
+    for kind, slot in ledger.by_kind().items():
+        m = measured.get(kind, {"count": 0, "time_ns": 0.0})
+        # measured counts accumulate over steps AND streams; the ledger is
+        # per-step per-device
+        per_step_count = m["count"] / (n_steps * n_streams)
+        time_s = m["time_ns"] / 1e9 / (n_steps * n_streams)
+        bus_gbps = (slot["wire_bytes"] / time_s / 1e9) if time_s else 0.0
+        out[kind] = {
+            "ledger_count": slot["count"],
+            "ledger_bytes": slot["bytes"],
+            "wire_bytes": slot["wire_bytes"],
+            "measured_count_per_step": per_step_count,
+            "measured_ms_per_step": time_s * 1e3,
+            "bus_gbps": bus_gbps,
+            "count_match": abs(per_step_count - slot["count"]) < 0.5,
+        }
+    return out
+
+
+# -------------------------------------------------------- cross-rank merge
+
+def read_heartbeat_steps(hb_dir: str) -> Dict[int, Dict[int, float]]:
+    """``{pid: {step: wall_time}}`` from every beat line in a heartbeat
+    dir (unlike ``obs.heartbeat.read_heartbeats``, keeps the full per-step
+    history — the alignment signal, not just liveness)."""
+    out: Dict[int, Dict[int, float]] = {}
+    for path in sorted(glob.glob(os.path.join(hb_dir, "heartbeat-*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    pid, step, t = int(rec["pid"]), int(rec["step"]), \
+                        float(rec["t"])
+                except (ValueError, KeyError):
+                    continue  # torn tail
+                out.setdefault(pid, {})[step] = t
+    return out
+
+
+def clock_offsets_from_heartbeats(hb_dir: str) -> Dict[int, float]:
+    """Per-process clock offset (seconds) relative to the lowest pid.
+
+    Ranks beat at the same step at (approximately) the same true time;
+    the median per-common-step delta between a rank's beat wall-clock and
+    the reference rank's estimates the skew between their captures.
+    Subtracting the offset aligns the merged timeline."""
+    beats = read_heartbeat_steps(hb_dir)
+    if not beats:
+        return {}
+    ref_pid = min(beats)
+    ref = beats[ref_pid]
+    offsets = {ref_pid: 0.0}
+    for pid, steps in beats.items():
+        if pid == ref_pid:
+            continue
+        deltas = sorted(steps[s] - ref[s] for s in steps if s in ref)
+        offsets[pid] = deltas[len(deltas) // 2] if deltas else 0.0
+    return offsets
+
+
+def to_chrome_trace(timelines: Sequence[Tuple[int, Timeline]],
+                    offsets_s: Optional[Dict[int, float]] = None,
+                    ) -> Dict[str, Any]:
+    """Merge per-rank timelines into one Chrome-trace/Perfetto JSON dict.
+
+    ``timelines``: ``(rank, Timeline)`` pairs; ``offsets_s``: per-rank
+    clock offsets (``clock_offsets_from_heartbeats``) subtracted before
+    merging.  pid = rank, tid = one per (plane, line) stream; times in
+    microseconds as the trace-event format requires."""
+    offsets_s = offsets_s or {}
+    events: List[Dict[str, Any]] = []
+    for rank, tl in timelines:
+        off_us = offsets_s.get(rank, 0.0) * 1e6
+        events.append({
+            "ph": "M", "pid": rank, "name": "process_name",
+            "args": {"name": f"rank {rank}"
+                     + (f" ({tl.hostname})" if tl.hostname else "")},
+        })
+        tids: Dict[Tuple[str, str], int] = {}
+        for s in tl.spans:
+            key = (s.plane, s.line)
+            tid = tids.get(key)
+            if tid is None:
+                tid = tids[key] = len(tids)
+                events.append({
+                    "ph": "M", "pid": rank, "tid": tid,
+                    "name": "thread_name",
+                    "args": {"name": f"{s.plane} · {s.line}"},
+                })
+            if s.dur_ns <= 0:
+                continue
+            ev: Dict[str, Any] = {
+                "ph": "X", "pid": rank, "tid": tid, "name": s.name,
+                "ts": s.start_ns / 1e3 - off_us, "dur": s.dur_ns / 1e3,
+            }
+            if s.is_collective():
+                ev["cat"] = "collective"
+            args = {k: v for k, v in s.stats.items()
+                    if isinstance(v, (int, float, str))}
+            if args:
+                ev["args"] = args
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# --------------------------------------------------------- fixture encoder
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(fnum: int, wt: int) -> bytes:
+    return _varint((fnum << 3) | wt)
+
+
+def _len_field(fnum: int, payload: bytes) -> bytes:
+    return _tag(fnum, 2) + _varint(len(payload)) + payload
+
+
+def _stat_msg(meta_id: int, value: Any) -> bytes:
+    msg = _tag(1, 0) + _varint(meta_id)
+    if isinstance(value, float):
+        msg += _tag(2, 1) + struct.pack("<d", value)
+    elif isinstance(value, int):
+        msg += _tag(3, 0) + _varint(value)
+    else:
+        msg += _len_field(5, str(value).encode())
+    return msg
+
+
+def encode_xspace(planes: Sequence[Dict[str, Any]],
+                  hostname: str = "synthetic") -> bytes:
+    """Encode a minimal XSpace: ``planes`` is a list of
+    ``{"name", "lines": [{"name", "timestamp_ns", "events": [
+    {"name", "offset_ps", "duration_ps", "stats": {key: value}}]}]}``.
+    Event/stat metadata tables are built automatically.  The inverse of
+    ``parse_xspace_bytes`` for everything this module reads — used for
+    checked-in test fixtures and the obs_timeline selftest."""
+    space = _len_field(4, hostname.encode())
+    for plane in planes:
+        event_ids: Dict[str, int] = {}
+        stat_ids: Dict[str, int] = {}
+        lines_payload = b""
+        for line in plane.get("lines", []):
+            lp = _len_field(2, line["name"].encode())
+            lp += _tag(3, 0) + _varint(int(line.get("timestamp_ns", 0)))
+            for ev in line.get("events", []):
+                eid = event_ids.setdefault(ev["name"], len(event_ids) + 1)
+                ep = _tag(1, 0) + _varint(eid)
+                ep += _tag(2, 0) + _varint(int(ev.get("offset_ps", 0)))
+                ep += _tag(3, 0) + _varint(int(ev.get("duration_ps", 0)))
+                for k, v in (ev.get("stats") or {}).items():
+                    sid = stat_ids.setdefault(k, len(stat_ids) + 1)
+                    ep += _len_field(4, _stat_msg(sid, v))
+                lp += _len_field(4, ep)
+            lines_payload += _len_field(3, lp)
+        pp = _len_field(2, plane["name"].encode())
+        pp += lines_payload
+        for name, mid in event_ids.items():
+            meta = _tag(1, 0) + _varint(mid) + _len_field(2, name.encode())
+            entry = _tag(1, 0) + _varint(mid) + _len_field(2, meta)
+            pp += _len_field(4, entry)
+        for name, sid in stat_ids.items():
+            meta = _tag(1, 0) + _varint(sid) + _len_field(2, name.encode())
+            entry = _tag(1, 0) + _varint(sid) + _len_field(2, meta)
+            pp += _len_field(5, entry)
+        space += _len_field(1, pp)
+    return space

@@ -12,7 +12,10 @@ into per-stage evidence.
 
 ``ProfileWindow`` drives ``jax.profiler.start_trace``/``stop_trace`` from
 epoch/step windows so a trace can capture steady state, not just the
-warm-up epoch the seed hard-coded.
+warm-up epoch the seed hard-coded.  ``capture(dir)`` is its one-shot
+contextmanager form for scripts that just want "trace this block" —
+the ``scripts/profile_*.py`` family all funnel through it so there is
+exactly one start/stop_trace call site outside the trainers.
 """
 
 from __future__ import annotations
@@ -28,6 +31,22 @@ def scope(name: str):
     """Host TraceAnnotation + in-graph named_scope under one name."""
     with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
         yield
+
+
+@contextlib.contextmanager
+def capture(trace_dir: str):
+    """One-shot profiler capture: trace everything inside the ``with``.
+
+    The single-segment form of ``ProfileWindow`` — same start/stop pairing,
+    no epoch/step bookkeeping.  XPlane files land under ``trace_dir`` and
+    can be decoded with ``obs.timeline.find_xplane_files``/``parse_xspace``
+    or ``scripts/obs_timeline.py``.
+    """
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield trace_dir
+    finally:
+        jax.profiler.stop_trace()
 
 
 def annotate(name: str):

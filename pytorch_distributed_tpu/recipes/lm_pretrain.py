@@ -187,6 +187,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="recompile watchdog (obs/watchdog.py): flag any "
                         "post-warmup recompilation of the jitted step as "
                         "an anomaly event via jax.monitoring")
+    p.add_argument("--comm-ledger", type=str, default=None,
+                   dest="comm_ledger", metavar="PATH",
+                   help="write the step's itemized communication ledger "
+                        "(per-collective bytes/fan-out/scope, obs/comms.py) "
+                        "to PATH and stamp model_comm_bytes/comm_wire_bytes/"
+                        "collective_count into each metrics record; costs "
+                        "one extra AOT compile of the step")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -426,6 +433,7 @@ def main(argv=None) -> float:
             hb_interval_s=args.hb_interval_s,
             mfu=args.mfu, goodput=args.goodput,
             watch_recompiles=args.watch_recompiles,
+            comm_ledger=args.comm_ledger,
             save_steps=args.save_steps, resume=args.resume,
             nan_guard=args.nan_guard, ft_rollback_k=args.ft_rollback_k,
             ft_check_every=args.ft_check_every,

@@ -110,6 +110,13 @@ class Config:
     mfu: bool = False
     goodput: bool = False
     watch_recompiles: bool = False
+    # Communication ledger (obs/comms.py): AOT-compile the step once at
+    # fit() start, itemize every collective (bytes/fan-out/scope), write
+    # the ledger JSON next to the run, and stamp model_comm_bytes /
+    # comm_wire_bytes / collective_count into each metrics record.
+    # Opt-in because the AOT lowering does not share the jit call cache
+    # in jax 0.4.x — it costs one extra compile of the step.
+    comm_ledger: Optional[str] = None
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -264,6 +271,14 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "compilations per jitted step-fn via jax.monitoring and "
                    "flag any recompilation after warmup as an anomaly "
                    "event in the metrics JSONL")
+    p.add_argument("--comm-ledger", default=d.comm_ledger, type=str,
+                   dest="comm_ledger", metavar="PATH",
+                   help="write the step's itemized communication ledger "
+                   "(per-collective bytes, replica-group fan-out, scope "
+                   "attribution; obs/comms.py) to PATH and stamp "
+                   "model_comm_bytes/comm_wire_bytes/collective_count "
+                   "into each metrics record; costs one extra AOT compile "
+                   "of the step")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

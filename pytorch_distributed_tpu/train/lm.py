@@ -481,6 +481,7 @@ class LMTrainer:
         mfu: bool = False,
         goodput: bool = False,
         watch_recompiles: bool = False,
+        comm_ledger: Optional[str] = None,
         save_steps: int = 0,
         resume: Optional[str] = None,
         nan_guard: bool = False,
@@ -606,6 +607,11 @@ class LMTrainer:
             )
 
             self.watchdog = RecompileWatchdog(obs=self.obs).install()
+        # Communication ledger (obs/comms.py): emitted lazily on the first
+        # fit() batch; opt-in — the AOT lowering does not share the jit
+        # call cache in jax 0.4.x, so it costs one extra step compile.
+        self._comm_ledger_path = comm_ledger
+        self._comm_fields: Optional[dict] = None
 
         # ---- fault tolerance (ft/) ----
         self.save_steps = int(save_steps)
@@ -775,6 +781,22 @@ class LMTrainer:
         print(f"=> divergence rollback at step {step}: restored state from "
               f"step {restored_step}, lr scale now {scale:g}", flush=True)
 
+    def _emit_comm_ledger(self, tokens, lr) -> None:
+        """AOT-compile the live LM step against the first batch's real
+        shardings, write the itemized collective ledger, and cache the
+        per-step metrics fields for every subsequent record."""
+        from pytorch_distributed_tpu.obs import comms
+
+        ledger = comms.ledger_from_jitted(
+            self.step_fn, (self.state, tokens, lr),
+            step="lm_step", mesh=self.mesh)
+        self._comm_fields = ledger.metrics_fields()
+        if self.is_primary:
+            comms.write_ledgers(self._comm_ledger_path, [ledger])
+            print(f"=> wrote comm ledger ({ledger.count} collectives, "
+                  f"{ledger.total_bytes} B/step payload) to "
+                  f"{self._comm_ledger_path}", flush=True)
+
     def fit(self, steps: int, print_freq: int = 10) -> float:
         from pytorch_distributed_tpu.obs import scope
 
@@ -838,15 +860,21 @@ class LMTrainer:
                     val = val * self.ft_guard.lr_scale
                 if val != lr_val:
                     lr_val, lr = val, jnp.float32(val)
+                if (self._comm_ledger_path is not None
+                        and self._comm_fields is None):
+                    self._emit_comm_ledger(tokens, lr)
                 with scope("lm_step"), self._wd_watch("lm_step", i):
                     self.state, metrics = self.step_fn(self.state, tokens, lr)
                 completed = i + 1
                 dt = meters.update(metrics, self.batch_size)
+                extra = (dict(self._mfu.fields(dt))
+                         if self._mfu is not None else {})
+                if self._comm_fields:
+                    extra.update(self._comm_fields)
                 self.obs.log_step(
                     i, step_time=dt, n_items=tokens_per_step, lr=lr,
                     scalars=dict(metrics),  # incl. norms when log_norms on
-                    extra=(self._mfu.fields(dt)
-                           if self._mfu is not None else None),
+                    extra=extra or None,
                 )
                 if self.hb is not None:
                     self.hb.beat(i, step_time_ema=self.obs.ema,

@@ -274,6 +274,10 @@ class Trainer:
             )
 
             self.watchdog = RecompileWatchdog(obs=self.obs).install()
+        # Communication ledger (obs/comms.py): emitted lazily on the first
+        # train batch (real shardings in hand), opt-in because the AOT
+        # lowering does not share the jit call cache — one extra compile.
+        self._comm_fields: Optional[dict] = None
         # Monotonic logged-train-step counter; a resume restores it so the
         # metrics JSONL step axis continues instead of restarting at 0.
         self._global_step = self._resume_global
@@ -442,6 +446,23 @@ class Trainer:
               f"{restored}, lr scale now {scale:g}", flush=True)
         return scale
 
+    def _emit_comm_ledger(self, batch, lr_arr) -> None:
+        """AOT-compile the live train step against the first batch's real
+        shardings, itemize every collective, write the ledger JSON, and
+        cache the per-step metrics fields every subsequent ``log_step``
+        record carries (``--comm-ledger``)."""
+        from pytorch_distributed_tpu.obs import comms
+
+        ledger = comms.ledger_from_jitted(
+            self.train_step, (self.state, batch, lr_arr),
+            step="train_step", mesh=self.mesh)
+        self._comm_fields = ledger.metrics_fields()
+        if self.ctx.process_index == 0:
+            comms.write_ledgers(self.cfg.comm_ledger, [ledger])
+            print(f"=> wrote comm ledger ({ledger.count} collectives, "
+                  f"{ledger.total_bytes} B/step payload) to "
+                  f"{self.cfg.comm_ledger}", flush=True)
+
     def train_epoch(
         self, epoch: int, profiler: Optional[ProfileWindow] = None,
         start_step: int = 0,
@@ -489,6 +510,9 @@ class Trainer:
                 self.chaos.on_step(self, i)
                 batch = self.chaos.on_batch(i, batch)
             n = self.cfg.batch_size
+            if (getattr(cfg, "comm_ledger", None)
+                    and self._comm_fields is None):
+                self._emit_comm_ledger(batch, lr_arr)
             with scope("train_step"), self._wd_watch("train_step",
                                                      self._global_step):
                 self.state, metrics = self.train_step(self.state, batch, lr_arr)
@@ -499,6 +523,8 @@ class Trainer:
             extra = {"epoch": epoch}
             if self._mfu is not None:
                 extra.update(self._mfu.fields(dt))
+            if self._comm_fields:
+                extra.update(self._comm_fields)
             self.obs.log_step(
                 self._global_step, step_time=dt, n_items=n, lr=lr,
                 scalars=dict(metrics),  # incl. norms when --metrics-jsonl

@@ -23,6 +23,43 @@ def timed_scalar(fn, *args, iters=5, warmup=2):
     return (time.perf_counter() - t0) / iters
 
 
+def timed_tree(fn, *args, iters=5, warmup=2):
+    """Mean seconds/call of ``fn(*args)`` whose output is a pytree: syncs
+    by value-fetching one element of the first leaf (same barrier rationale
+    as ``timed_scalar`` — see module docstring).  Use when the benchmarked
+    fn can't reduce to a scalar (grad trees, optimizer updates)."""
+    import jax
+    import numpy as np
+
+    def _sync(out):
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def timed_step_loop(step, state, batch, lr, iters=20, warmup=3,
+                    scalar_key="loss"):
+    """Warmup + timed loop over a stateful train step
+    ``state, met = step(state, batch, lr)``, syncing via a value fetch of
+    ``met[scalar_key]``.  Threads the state (donated steps consume it),
+    so returns ``(mean_seconds, final_state)``."""
+    for _ in range(warmup):
+        state, met = step(state, batch, lr)
+    float(met[scalar_key])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, met = step(state, batch, lr)
+    float(met[scalar_key])
+    return (time.perf_counter() - t0) / iters, state
+
+
 def bench_event(kind, path=None, **fields):
     """Append one structured ``bench_event`` record to a JSONL file in the
     metrics-stream schema (``{"bench_event": kind, "t": ..., ...}``) —

@@ -165,9 +165,84 @@ def summarize_bench(records: List[dict]) -> List[str]:
     return lines
 
 
-def summarize_telemetry(path: str) -> List[str]:
-    """Per-device peak/limit from the ``timestamp,index,bytes_limit,
-    bytes_in_use,peak_bytes`` CSV (no header in the statistics.sh contract)."""
+_COMM_FIELDS = ("model_comm_bytes", "comm_wire_bytes", "collective_count",
+                "exposed_comm_ms", "overlap_pct")
+
+
+def comm_stats(records: List[dict]) -> Dict[str, Optional[float]]:
+    """Per-run means of the comm fields the trainers stamp from the static
+    ledger (``model_comm_bytes``/``comm_wire_bytes``/``collective_count``,
+    obs/comms.py) and the timeline analyzer measures
+    (``exposed_comm_ms``/``overlap_pct``, obs/timeline.py)."""
+    steps = [r for r in records
+             if "ft_event" not in r and "bench_event" not in r]
+    out: Dict[str, Optional[float]] = {}
+    for key in _COMM_FIELDS:
+        vals = [float(r[key]) for r in steps if key in r]
+        out[key] = sum(vals) / len(vals) if vals else None
+    return out
+
+
+def _comm_residual(predicted: Optional[float],
+                   measured: Optional[float]) -> Optional[float]:
+    from pytorch_distributed_tpu.obs.flops import comm_residual_pct
+
+    if predicted is None or measured is None or not predicted:
+        return None
+    return comm_residual_pct(predicted, measured)
+
+
+def summarize_comms(records: List[dict], ledger_path: Optional[str] = None,
+                    predicted_bytes: Optional[float] = None) -> List[str]:
+    """The ``== comms ==`` section: per-step collective traffic from the
+    metrics stream, the itemized ledger breakdown when one is on disk, and
+    the predicted-vs-measured residual fence (obs/flops.py analytic comm
+    model vs the compiled ledger; >15% means the model and the lowering
+    disagree about what the step communicates)."""
+    cs = comm_stats(records)
+    if not any(v is not None for v in cs.values()) and not ledger_path:
+        return []
+    lines = ["== comms =="]
+    if cs["model_comm_bytes"] is not None:
+        wire = (f", {cs['comm_wire_bytes']:.0f} B wire"
+                if cs["comm_wire_bytes"] is not None else "")
+        cnt = (f", {cs['collective_count']:.0f} collectives"
+               if cs["collective_count"] is not None else "")
+        lines.append(f"  per-step payload  {cs['model_comm_bytes']:.0f} B"
+                     f"{wire}{cnt}")
+    if cs["exposed_comm_ms"] is not None:
+        ov = (f"  (overlap {cs['overlap_pct']:.1f}%)"
+              if cs["overlap_pct"] is not None else "")
+        lines.append(f"  exposed comm      "
+                     f"{cs['exposed_comm_ms']:.3f} ms/step mean{ov}")
+    residual = _comm_residual(predicted_bytes, cs["model_comm_bytes"])
+    if residual is not None:
+        verdict = "ok" if abs(residual) <= 15.0 else "EXCEEDS ±15%"
+        lines.append(f"  predicted model   {predicted_bytes:.0f} B -> "
+                     f"residual {residual:+.1f}% [{verdict}]")
+    if ledger_path:
+        from pytorch_distributed_tpu.obs.comms import load_ledgers
+
+        for step, lg in sorted(load_ledgers(ledger_path).items()):
+            kinds = ", ".join(
+                f"{k}×{v['count']} {v['bytes']:.0f}B"
+                for k, v in sorted(lg.by_kind().items()))
+            lines.append(f"  ledger {step}: {kinds or 'no collectives'}")
+            phases = ", ".join(
+                f"{p} {v['bytes']:.0f}B"
+                for p, v in sorted(lg.by_phase().items(),
+                                   key=lambda kv: -kv[1]["bytes"]))
+            if phases:
+                lines.append(f"    by phase: {phases}")
+    if len(lines) == 1:
+        return []
+    return lines
+
+
+def telemetry_stats(path: str) -> Tuple[int, Dict[int, float], Dict[int, float]]:
+    """``(n_rows, peak_by_device, limit_by_device)`` from the ``timestamp,
+    index,bytes_limit,bytes_in_use,peak_bytes`` CSV (no header in the
+    statistics.sh contract)."""
     peak: Dict[int, float] = {}
     limit: Dict[int, float] = {}
     n_rows = 0
@@ -183,6 +258,11 @@ def summarize_telemetry(path: str) -> List[str]:
             n_rows += 1
             peak[idx] = max(peak.get(idx, 0.0), pk)
             limit[idx] = max(limit.get(idx, 0.0), lim)
+    return n_rows, peak, limit
+
+
+def summarize_telemetry(path: str) -> List[str]:
+    n_rows, peak, limit = telemetry_stats(path)
     if not peak:
         return ["  (no samples)"]
     lines = [f"  samples           {n_rows}"]
@@ -192,20 +272,29 @@ def summarize_telemetry(path: str) -> List[str]:
     return lines
 
 
-def summarize_heartbeats(hb_dir: str, now: Optional[float],
-                         max_step_lag: int, max_age_s: float) -> List[str]:
+def heartbeat_stats(hb_dir: str, now: Optional[float], max_step_lag: int,
+                    max_age_s: float) -> Tuple[Dict, Dict, float]:
+    """``(beats, flagged, now)`` — the parsed heartbeat state the text and
+    JSON renderings share."""
     from pytorch_distributed_tpu.obs.heartbeat import (
         find_stragglers,
         read_heartbeats,
     )
 
     beats = read_heartbeats(hb_dir)
-    if not beats:
-        return ["  (no heartbeats)"]
     if now is None:
         now = time.time()
     flagged = find_stragglers(beats, now=now, max_step_lag=max_step_lag,
-                              max_age_s=max_age_s)
+                              max_age_s=max_age_s) if beats else {}
+    return beats, flagged, now
+
+
+def summarize_heartbeats(hb_dir: str, now: Optional[float],
+                         max_step_lag: int, max_age_s: float) -> List[str]:
+    beats, flagged, now = heartbeat_stats(hb_dir, now, max_step_lag,
+                                          max_age_s)
+    if not beats:
+        return ["  (no heartbeats)"]
     lines = []
     for pid in sorted(beats):
         b = beats[pid]
@@ -229,7 +318,12 @@ def report(args) -> str:
         from pytorch_distributed_tpu.obs.goodput import summarize_goodput
 
         sections += summarize_goodput(records)
+        sections += summarize_comms(records, getattr(args, "comm_ledger", None),
+                                    getattr(args, "comm_predicted", None))
         sections += summarize_bench(records)
+    elif getattr(args, "comm_ledger", None):
+        sections += summarize_comms([], args.comm_ledger,
+                                    getattr(args, "comm_predicted", None))
     if args.telemetry_csv:
         sections.append("== devices ==")
         sections += summarize_telemetry(args.telemetry_csv)
@@ -241,6 +335,71 @@ def report(args) -> str:
         sections.append("nothing to report: pass --metrics-jsonl, "
                         "--hb-dir, and/or --telemetry-csv")
     return "\n".join(sections)
+
+
+def report_json(args) -> Dict:
+    """Machine-readable twin of ``report()``: every section as structured
+    data (``--format json``)."""
+    out: Dict = {}
+    if args.metrics_jsonl:
+        records, malformed = load_metrics(args.metrics_jsonl)
+        steps = [r for r in records
+                 if "ft_event" not in r and "bench_event" not in r]
+        stats = run_stats(records)
+        stats["malformed_lines"] = malformed
+        loss = [r["loss"] for r in steps if "loss" in r]
+        if loss:
+            stats["loss_first"], stats["loss_last"] = loss[0], loss[-1]
+        out["steps"] = stats
+        events: Dict[str, Dict] = {}
+        for e in (r for r in records if "ft_event" in r):
+            slot = events.setdefault(str(e["ft_event"]),
+                                     {"count": 0, "steps": []})
+            slot["count"] += 1
+            if "step" in e:
+                slot["steps"].append(e["step"])
+        out["ft_events"] = events
+        from pytorch_distributed_tpu.obs.goodput import compute_goodput
+
+        gp = compute_goodput(records)
+        out["goodput"] = {
+            "wall_s": gp.wall_s, "productive_s": gp.productive_s,
+            "badput_s": dict(gp.badput_s), "counts": dict(gp.counts),
+            "steps": gp.steps, "goodput_pct": gp.goodput_pct,
+            "untracked_s": gp.untracked_s,
+        }
+        out["bench"] = [r for r in records if "bench_event" in r]
+        comms = comm_stats(records)
+        comms["residual_pct"] = _comm_residual(
+            getattr(args, "comm_predicted", None),
+            comms["model_comm_bytes"])
+        comms["predicted_bytes"] = getattr(args, "comm_predicted", None)
+        out["comms"] = comms
+    if getattr(args, "comm_ledger", None):
+        from pytorch_distributed_tpu.obs.comms import load_ledgers
+
+        out.setdefault("comms", {})["ledger"] = {
+            step: {"total_bytes": lg.total_bytes,
+                   "total_wire_bytes": lg.total_wire_bytes,
+                   "count": lg.count, "by_kind": lg.by_kind(),
+                   "by_phase": lg.by_phase()}
+            for step, lg in load_ledgers(args.comm_ledger).items()}
+    if args.telemetry_csv:
+        n_rows, peak, limit = telemetry_stats(args.telemetry_csv)
+        out["devices"] = {
+            "samples": n_rows,
+            "per_device": {str(i): {"peak_bytes": peak[i],
+                                    "limit_bytes": limit.get(i, 0.0)}
+                           for i in sorted(peak)},
+        }
+    if args.hb_dir:
+        beats, flagged, now = heartbeat_stats(
+            args.hb_dir, args.now, args.max_step_lag, args.max_beat_age)
+        out["heartbeats"] = {
+            str(pid): {"step": b.get("step"), "beat_age_s": now - b["t"],
+                       "straggler": flagged.get(pid)}
+            for pid, b in sorted(beats.items())}
+    return out
 
 
 # ------------------------------------------------------------------ run diff
@@ -255,6 +414,7 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
     thr = [r["throughput"] for r in steps if "throughput" in r]
     mfu = [r["mfu"] for r in steps if "mfu" in r]
     gp = compute_goodput(records)
+    cs = comm_stats(records)
     return {
         "steps": float(len(steps)),
         "step_time_p50": _pct(times, .5) if times else None,
@@ -262,79 +422,123 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         "throughput": sum(thr) / len(thr) if thr else None,
         "mfu": sum(mfu) / len(mfu) if mfu else None,
         "goodput": gp.goodput_pct if gp.steps else None,
+        "model_comm_bytes": cs["model_comm_bytes"],
+        "comm_wire_bytes": cs["comm_wire_bytes"],
+        "exposed_comm_ms": cs["exposed_comm_ms"],
     }
 
 
 # (name, lower_is_better, absolute_pp) — goodput diffs in percentage
-# points, the rest in relative percent.
+# points, the rest in relative percent.  exposed_comm_ms fences the
+# overlap win (more un-overlapped collective time per step); wire bytes
+# fence the traffic itself (a sharding change that moves more data).
 _DIFF_METRICS = (
     ("step_time_p50", True, False),
     ("step_time_p95", True, False),
     ("throughput", False, False),
     ("mfu", False, False),
     ("goodput", False, True),
+    ("exposed_comm_ms", True, False),
+    ("comm_wire_bytes", True, False),
 )
+
+
+def diff_data(a_records: List[dict], b_records: List[dict],
+              threshold_pct: float = 10.0,
+              goodput_threshold_pp: float = 5.0,
+              label_a: str = "A", label_b: str = "B") -> Dict:
+    """Compare run B against baseline run A -> structured verdicts.
+
+    A metric REGRESSes when B is worse than A by more than
+    ``threshold_pct`` percent (relative), or ``goodput_threshold_pp``
+    percentage points for the absolute-pp metrics.  Metrics missing from
+    either run are skipped — a run without ``--mfu`` must not fail the
+    fence on MFU."""
+    sa, sb = run_stats(a_records), run_stats(b_records)
+    rows: List[Dict] = []
+    regressed = False
+    for name, lower_better, absolute_pp in _DIFF_METRICS:
+        va, vb = sa[name], sb[name]
+        row: Dict = {"metric": name, "a": va, "b": vb}
+        if va is None or vb is None:
+            row["verdict"] = "missing"
+        elif absolute_pp:
+            row["delta_pp"] = vb - va
+            worse = (va - vb) > goodput_threshold_pp
+            row["verdict"] = "REGRESS" if worse else "PASS"
+            regressed = regressed or worse
+        elif va == 0:
+            row["verdict"] = "zero-baseline"
+        else:
+            row["delta_pct"] = 100.0 * (vb - va) / va
+            worse = (row["delta_pct"] > threshold_pct if lower_better
+                     else row["delta_pct"] < -threshold_pct)
+            row["verdict"] = "REGRESS" if worse else "PASS"
+            regressed = regressed or worse
+        rows.append(row)
+    return {
+        "baseline": label_a, "candidate": label_b,
+        "steps_a": sa["steps"], "steps_b": sb["steps"],
+        "metrics": rows,
+        "overall": "REGRESS" if regressed else "PASS",
+        "regressed": regressed,
+    }
 
 
 def diff_report(a_records: List[dict], b_records: List[dict],
                 threshold_pct: float = 10.0,
                 goodput_threshold_pp: float = 5.0,
                 label_a: str = "A", label_b: str = "B") -> Tuple[str, bool]:
-    """Compare run B against baseline run A → (report text, regressed).
-
-    A metric REGRESSes when B is worse than A by more than
-    ``threshold_pct`` percent (relative), or ``goodput_threshold_pp``
-    percentage points for goodput.  Metrics missing from either run are
-    skipped (shown as ``--``) — a run without ``--mfu`` must not fail the
-    fence on MFU."""
-    sa, sb = run_stats(a_records), run_stats(b_records)
-    w = 14
+    """Text rendering of ``diff_data`` → (report text, regressed)."""
+    d = diff_data(a_records, b_records, threshold_pct=threshold_pct,
+                  goodput_threshold_pp=goodput_threshold_pp,
+                  label_a=label_a, label_b=label_b)
+    w = 16
     lines = [
         "== diff ==",
-        f"  baseline {label_a}: {sa['steps']:.0f} steps;  "
-        f"candidate {label_b}: {sb['steps']:.0f} steps",
+        f"  baseline {d['baseline']}: {d['steps_a']:.0f} steps;  "
+        f"candidate {d['candidate']}: {d['steps_b']:.0f} steps",
         f"  {'metric':<{w}} {'A':>10} {'B':>10} {'delta':>9}  verdict",
     ]
-    regressed = False
-    for name, lower_better, absolute_pp in _DIFF_METRICS:
-        va, vb = sa[name], sb[name]
-        if va is None or vb is None:
+    for row in d["metrics"]:
+        name, va, vb = row["metric"], row["a"], row["b"]
+        if row["verdict"] == "missing":
             lines.append(f"  {name:<{w}} {'--':>10} {'--':>10} {'--':>9}  "
                          "(missing)")
             continue
-        if absolute_pp:
-            delta = vb - va
-            worse = (va - vb) > goodput_threshold_pp
-            dtxt = f"{delta:+.1f}pp"
+        if row["verdict"] == "zero-baseline":
+            lines.append(f"  {name:<{w}} {va:>10.4g} {vb:>10.4g} "
+                         f"{'--':>9}  (zero baseline)")
+            continue
+        if "delta_pp" in row:
+            dtxt = f"{row['delta_pp']:+.1f}pp"
             fa, fb = f"{va:.1f}%", f"{vb:.1f}%"
         else:
-            if va == 0:
-                lines.append(f"  {name:<{w}} {va:>10.4g} {vb:>10.4g} "
-                             f"{'--':>9}  (zero baseline)")
-                continue
-            delta = 100.0 * (vb - va) / va
-            worse = (delta > threshold_pct if lower_better
-                     else delta < -threshold_pct)
-            dtxt = f"{delta:+.1f}%"
+            dtxt = f"{row['delta_pct']:+.1f}%"
             if name.startswith("step_time"):
                 fa, fb = f"{va * 1e3:.1f}ms", f"{vb * 1e3:.1f}ms"
             else:
                 fa, fb = f"{va:.4g}", f"{vb:.4g}"
-        verdict = "REGRESS" if worse else "PASS"
-        regressed = regressed or worse
-        lines.append(f"  {name:<{w}} {fa:>10} {fb:>10} {dtxt:>9}  {verdict}")
-    lines.append(f"overall: {'REGRESS' if regressed else 'PASS'}")
-    return "\n".join(lines), regressed
+        lines.append(f"  {name:<{w}} {fa:>10} {fb:>10} {dtxt:>9}  "
+                     f"{row['verdict']}")
+    lines.append(f"overall: {d['overall']}")
+    return "\n".join(lines), d["regressed"]
 
 
 def run_diff(path_a: str, path_b: str, threshold_pct: float,
-             goodput_threshold_pp: float) -> int:
+             goodput_threshold_pp: float, fmt: str = "text") -> int:
     a, mal_a = load_metrics(path_a)
     b, mal_b = load_metrics(path_b)
-    text, regressed = diff_report(
-        a, b, threshold_pct=threshold_pct,
-        goodput_threshold_pp=goodput_threshold_pp,
-        label_a=os.path.basename(path_a), label_b=os.path.basename(path_b))
+    kw = dict(threshold_pct=threshold_pct,
+              goodput_threshold_pp=goodput_threshold_pp,
+              label_a=os.path.basename(path_a),
+              label_b=os.path.basename(path_b))
+    if fmt == "json":
+        d = diff_data(a, b, **kw)
+        d["malformed_lines"] = {"a": mal_a, "b": mal_b}
+        print(json.dumps(d, indent=2))
+        return 1 if d["regressed"] else 0
+    text, regressed = diff_report(a, b, **kw)
     if mal_a or mal_b:
         text += f"\n(malformed lines: A {mal_a}, B {mal_b})"
     print(text)
@@ -358,7 +562,12 @@ def _selftest() -> int:
                              scalars={"loss": 2.0 - 0.05 * i,
                                       "grad_norm": 1.0 + 0.1 * i},
                              extra={"mfu": 40.0 + 0.1 * i,
-                                    "hfu": 45.0 + 0.1 * i})
+                                    "hfu": 45.0 + 0.1 * i,
+                                    "model_comm_bytes": 66952.0,
+                                    "comm_wire_bytes": 100428.0,
+                                    "collective_count": 16.0,
+                                    "exposed_comm_ms": 0.40,
+                                    "overlap_pct": 33.3})
             # ft_event records interleave in the same JSONL (ft/)
             log.log_event("skip", step=7, consecutive=1)
             log.log_event("skip", step=8, consecutive=2)
@@ -388,9 +597,24 @@ def _selftest() -> int:
                     wr.writerow([now + t, dev, 8 << 30,
                                  (1 + t) << 20, (2 + t) << 20])
 
-        out = report(argparse.Namespace(
+        # a one-entry comm ledger on disk for the comms section
+        from pytorch_distributed_tpu.obs import comms as comms_mod
+
+        lpath = os.path.join(d, "comm_ledger.json")
+        comms_mod.write_ledgers(lpath, [comms_mod.CommLedger(
+            step="lm_train_dp", mesh_shape={"data": 4},
+            entries=[comms_mod.CommEntry(
+                name="all-reduce.1", kind="all-reduce", bytes=66952,
+                wire_bytes=comms_mod.wire_bytes("all-reduce", 66952, 4),
+                n_groups=1, group_size=4, phase="backward",
+                op_name="jit(step)/transpose(jvp(lm_forward))/add",
+                source="lm.py:1")])])
+
+        ns = argparse.Namespace(
             metrics_jsonl=mpath, hb_dir=hb_dir, telemetry_csv=tpath,
-            now=now, max_step_lag=3, max_beat_age=60.0))
+            now=now, max_step_lag=3, max_beat_age=60.0,
+            comm_ledger=lpath, comm_predicted=66000.0)
+        out = report(ns)
         for needle in ("== steps ==", "steps logged      20", "p95",
                        "throughput", "loss", "grad_norm",
                        "mfu               mean", "malformed lines   1",
@@ -398,11 +622,28 @@ def _selftest() -> int:
                        "lr scale          0.5 after 1 rollback",
                        "== goodput ==", "goodput", "badput/nan_skip",
                        "badput/rollback_discard",
+                       "== comms ==", "per-step payload  66952 B",
+                       "16 collectives", "exposed comm      0.400 ms",
+                       "overlap 33.3%", "residual", "[ok]",
+                       "ledger lm_train_dp", "all-reduce×1",
+                       "by phase: backward",
                        "== bench ==", "stale", "last good",
                        "== devices ==", "device 0", "device 1",
                        "== heartbeats ==", "STRAGGLER", "step lag",
                        "beat age"):
             assert needle in out, f"selftest: {needle!r} missing from:\n{out}"
+
+        # json twin: every section present and structurally sane
+        js = report_json(ns)
+        for key in ("steps", "ft_events", "goodput", "bench", "comms",
+                    "devices", "heartbeats"):
+            assert key in js, f"selftest: {key!r} missing from json: {js}"
+        assert js["steps"]["model_comm_bytes"] == 66952.0, js["steps"]
+        assert abs(js["comms"]["residual_pct"]) < 15.0, js["comms"]
+        assert js["comms"]["ledger"]["lm_train_dp"]["total_bytes"] == 66952
+        assert js["heartbeats"]["1"]["straggler"], js["heartbeats"]
+        assert not js["heartbeats"]["0"]["straggler"], js["heartbeats"]
+        json.dumps(js)  # must be serializable end-to-end
         # pid 0 must NOT be flagged
         line0 = [ln for ln in out.splitlines() if "process 0" in ln]
         assert line0 and "STRAGGLER" not in line0[0], out
@@ -426,6 +667,35 @@ def _selftest() -> int:
         text2, regressed2 = diff_report(a_recs, a_recs)
         assert not regressed2 and "overall: PASS" in text2, (
             f"selftest: identical runs must PASS:\n{text2}")
+
+        # ---- planted exposed-comm regression: identical step time, but
+        # collectives stopped hiding under compute -> the comm fence (and
+        # only the comm fence) must REGRESS
+        base_c = os.path.join(d, "base_comm.jsonl")
+        bad_c = os.path.join(d, "bad_comm.jsonl")
+        for path, exposed in ((base_c, 0.20), (bad_c, 0.55)):
+            with MetricsLogger(path, flush_every=50) as log:
+                for i in range(30):
+                    log.log_step(i, step_time=0.010, n_items=128, lr=0.1,
+                                 extra={"model_comm_bytes": 66952.0,
+                                        "comm_wire_bytes": 100428.0,
+                                        "exposed_comm_ms": exposed,
+                                        "overlap_pct": 60.0})
+        c_recs, _ = load_metrics(base_c)
+        d_recs, _ = load_metrics(bad_c)
+        text3, regressed3 = diff_report(c_recs, d_recs)
+        assert regressed3, (
+            f"selftest: exposed-comm regression must REGRESS:\n{text3}")
+        row = [ln for ln in text3.splitlines() if "exposed_comm_ms" in ln]
+        assert row and "REGRESS" in row[0], text3
+        step_row = [ln for ln in text3.splitlines() if "step_time_p50" in ln]
+        assert step_row and "PASS" in step_row[0], text3
+        dd = diff_data(c_recs, d_recs)
+        assert dd["overall"] == "REGRESS" and dd["regressed"], dd
+        by_name = {r["metric"]: r for r in dd["metrics"]}
+        assert by_name["exposed_comm_ms"]["verdict"] == "REGRESS", dd
+        assert by_name["comm_wire_bytes"]["verdict"] == "PASS", dd
+        json.dumps(dd)
     print("obs_report selftest: OK")
     return 0
 
@@ -438,6 +708,18 @@ def main(argv=None) -> int:
     ap.add_argument("--hb-dir", type=str, default=None, dest="hb_dir")
     ap.add_argument("--telemetry-csv", type=str, default=None,
                     dest="telemetry_csv")
+    ap.add_argument("--comm-ledger", type=str, default=None,
+                    dest="comm_ledger",
+                    help="comm_ledger.json (scripts/shardlint.py "
+                    "--comm-ledger) to itemize in the comms section")
+    ap.add_argument("--comm-predicted", type=float, default=None,
+                    dest="comm_predicted", metavar="BYTES",
+                    help="analytic per-step comm bytes (obs.flops."
+                    "lm_comm_bytes/image_comm_bytes) to fence the measured "
+                    "ledger against (±15%% residual)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="output format; json emits every section (and "
+                    "--diff verdicts) as one machine-readable object")
     ap.add_argument("--max-step-lag", type=int, default=3, dest="max_step_lag",
                     help="flag processes more than N steps behind the lead")
     ap.add_argument("--max-beat-age", type=float, default=60.0,
@@ -464,8 +746,11 @@ def main(argv=None) -> int:
         return _selftest()
     if args.diff:
         return run_diff(args.diff[0], args.diff[1], args.threshold_pct,
-                        args.goodput_threshold_pp)
-    print(report(args))
+                        args.goodput_threshold_pp, fmt=args.format)
+    if args.format == "json":
+        print(json.dumps(report_json(args), indent=2))
+    else:
+        print(report(args))
     return 0
 
 

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""obs_timeline: comm/compute timeline analysis of profiler captures.
+
+Parses ``*.xplane.pb`` captures (jax.profiler / scripts/profile_trace.py
+output) with the pure-python decoder in obs/timeline.py — no TF, no jax —
+and reports per-step collective time, overlap with compute, and exposed
+(un-overlapped) communication per device stream.  Optionally marries the
+measured spans to a static comm ledger (scripts/shardlint.py
+--comm-ledger) to turn bytes into effective bus bandwidth, aligns
+multi-process captures on a common clock using heartbeat files, and
+exports the merged timeline as Chrome-trace JSON for Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.
+
+Usage:
+  python scripts/obs_timeline.py TRACE_DIR            # text report
+  python scripts/obs_timeline.py a.xplane.pb b.xplane.pb \\
+      --hb-dir runs/hb --out merged.trace.json        # cross-rank merge
+  python scripts/obs_timeline.py TRACE_DIR \\
+      --ledger comm_ledger.json --step lm_train_dp    # bytes -> GB/s
+  python scripts/obs_timeline.py TRACE_DIR --json report.json
+  python scripts/obs_timeline.py --selftest           # fixture round-trip
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Deliberately no jax import: timeline analysis must run anywhere,
+# including on a login host that only has the capture files.
+from pytorch_distributed_tpu.obs import timeline as tlmod  # noqa: E402
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "data", "synthetic.xplane.pb")
+
+
+def _collect_captures(paths):
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = tlmod.find_xplane_files(p)
+            if not found:
+                raise SystemExit(f"no *.xplane.pb under {p}")
+            files.extend(found)
+        else:
+            files.append(p)
+    return files
+
+
+def _report_text(rank, tl, stats, agg, marriage, step_name):
+    lines = [f"rank {rank}: {tl.source}"
+             f"  host={tl.hostname or '?'}  spans={len(tl.spans)}"
+             f"  streams={agg.get('streams', 0)}"]
+    if not stats:
+        lines.append("  no device op spans found")
+        return lines
+    lines.append(
+        f"  steps={agg['steps']}  comm {agg['comm_ms_mean']:.3f} ms/step"
+        f"  exposed {agg['exposed_ms_mean']:.3f} ms/step"
+        f"  overlap {agg['overlap_pct_mean']:.1f}%")
+    for kind, slot in sorted(agg.get("by_kind", {}).items()):
+        lines.append(f"    {kind:<22} ×{slot['count']:<4}"
+                     f" {slot['time_ns'] / 1e6:.3f} ms total")
+    if marriage:
+        lines.append(f"  vs ledger step '{step_name}':")
+        for kind, m in sorted(marriage.items()):
+            match = "ok" if m["count_match"] else "MISMATCH"
+            lines.append(
+                f"    {kind:<22} ledger {m['ledger_count']} ops /"
+                f" {m['wire_bytes']:.0f} wire B; measured"
+                f" {m['measured_count_per_step']:.1f} ops/step"
+                f" {m['measured_ms_per_step']:.3f} ms/step"
+                f" -> {m['bus_gbps']:.2f} GB/s  [count {match}]")
+    return lines
+
+
+def make_fixture(path: str) -> None:
+    """Deterministic 2-stream synthetic capture: two 100 us step windows,
+    each with 60 us of fusion compute and a 30 us all-reduce that overlaps
+    compute for 10 us (-> exposed 20 us, overlap 33.3%)."""
+    US = 1_000_000  # ps per microsecond
+    base = 1_000_000  # ns
+
+    def device_line(idx):
+        events = []
+        for step in range(2):
+            t0_ps = step * 100 * US
+            events.append({"name": "fusion.1", "offset_ps": t0_ps + 5 * US,
+                           "duration_ps": 60 * US,
+                           "stats": {"hlo_op": "fusion.1", "program_id": 7}})
+            # all-reduce starts 10 us before compute ends: 10 us overlap
+            events.append({"name": "all-reduce.3",
+                           "offset_ps": t0_ps + 55 * US,
+                           "duration_ps": 30 * US,
+                           "stats": {"hlo_op": "all-reduce.3",
+                                     "program_id": 7}})
+        return {"name": f"tf_XLATfrtCpuClient/{idx}",
+                "timestamp_ns": base, "events": events}
+
+    python_line = {
+        "name": "python", "timestamp_ns": base,
+        "events": [
+            {"name": "train_step", "offset_ps": 0, "duration_ps": 100 * US},
+            {"name": "train_step", "offset_ps": 100 * US,
+             "duration_ps": 100 * US},
+        ],
+    }
+    data = tlmod.encode_xspace(
+        [{"name": "/host:CPU",
+          "lines": [python_line, device_line(0), device_line(1)]}],
+        hostname="fixture")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def selftest() -> int:
+    """Round-trip the checked-in fixture and check every derived number.
+    Pure python + a tmp-dir heartbeat pair for the clock-offset path; no
+    jax, no profiler — the tier-1 fast fence for the whole decode/analyze
+    stack."""
+    import tempfile
+
+    path = FIXTURE
+    if not os.path.exists(path):  # regenerate if the fixture went missing
+        make_fixture(path)
+    tl = tlmod.parse_xspace(path)
+    assert tl.hostname == "fixture", tl.hostname
+    assert len(tl.spans) == 10, len(tl.spans)
+    assert len(tl.device_lines()) == 2, tl.device_lines()
+    assert len(tl.annotations("train_step")) == 2
+
+    stats = tlmod.analyze_steps(tl)
+    # 2 steps x 2 streams
+    assert len(stats) == 4, [s.to_dict() for s in stats]
+    for s in stats:
+        assert abs(s.comm_ns - 30_000) < 1, s
+        assert abs(s.overlap_ns - 10_000) < 1, s
+        assert abs(s.exposed_ns - 20_000) < 1, s
+        assert abs(s.overlap_pct - 100.0 / 3) < 0.1, s
+    agg = tlmod.aggregate_steps(stats)
+    assert agg["steps"] == 2 and agg["streams"] == 2, agg
+    assert abs(agg["comm_ms_mean"] - 0.03) < 1e-6, agg
+    assert abs(agg["exposed_ms_mean"] - 0.02) < 1e-6, agg
+    assert agg["by_kind"]["all-reduce"]["count"] == 4, agg
+
+    # ledger marriage: a synthetic 1-op ledger must report a count match
+    # (1 all-reduce per step per stream) and a finite bandwidth
+    from pytorch_distributed_tpu.obs import comms
+    ledger = comms.CommLedger(step="fixture", entries=[comms.CommEntry(
+        name="all-reduce.3", kind="all-reduce", bytes=4096,
+        wire_bytes=comms.wire_bytes("all-reduce", 4096, 2),
+        n_groups=1, group_size=2, phase="grad_sync",
+        op_name="jit(step)/grad_sync/add", source="steps.py:1")])
+    marriage = tlmod.marry_ledger(stats, ledger)
+    m = marriage["all-reduce"]
+    assert m["count_match"], marriage
+    assert m["bus_gbps"] > 0, marriage
+
+    # clock alignment: rank 1's beats written 2.5 ms late -> offset ~2.5 ms
+    with tempfile.TemporaryDirectory() as d:
+        for pid, skew in ((100, 0.0), (200, 0.0025)):
+            with open(os.path.join(d, f"heartbeat-{pid}.jsonl"), "w") as f:
+                for step in range(4):
+                    f.write(json.dumps(
+                        {"pid": pid, "step": step,
+                         "t": 1000.0 + step + skew}) + "\n")
+        offs = tlmod.clock_offsets_from_heartbeats(d)
+        assert abs(offs[100]) < 1e-9 and abs(offs[200] - 0.0025) < 1e-9, offs
+
+        trace = tlmod.to_chrome_trace([(0, tl), (1, tl)],
+                                      {0: offs[100], 1: offs[200]})
+    evs = trace["traceEvents"]
+    coll = [e for e in evs if e.get("cat") == "collective"]
+    assert len(coll) == 8, len(coll)  # 4 all-reduces x 2 ranks
+    r0 = [e for e in coll if e["pid"] == 0][0]
+    r1 = [e for e in coll if e["pid"] == 1][0]
+    # rank 1's identical span lands 2500 us earlier once the skew is removed
+    assert abs((r0["ts"] - r1["ts"]) - 2500.0) < 1e-6, (r0["ts"], r1["ts"])
+
+    print("obs_timeline selftest OK: parse/analyze/marry/align/export all "
+          "verified on the checked-in fixture")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("captures", nargs="*",
+                    help="trace dirs and/or *.xplane.pb files; each file "
+                         "becomes one rank (in argument order)")
+    ap.add_argument("--annotation", default=None,
+                    help="step-marker annotation name (default: first of "
+                         "lm_step/train_step/profile_step present)")
+    ap.add_argument("--ledger", default=None, metavar="PATH",
+                    help="comm_ledger.json to marry measured spans against")
+    ap.add_argument("--step", default=None,
+                    help="ledger step name (default: sole entry, else "
+                         "required)")
+    ap.add_argument("--hb-dir", default=None, metavar="DIR",
+                    help="heartbeat dir for cross-rank clock alignment")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write merged Chrome-trace JSON (Perfetto)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the analysis report as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the decoder/analyzer on the checked-in "
+                         "fixture and exit (no jax, no captures needed)")
+    ap.add_argument("--make-fixture", default=None, metavar="PATH",
+                    help="write the deterministic synthetic capture used "
+                         "by --selftest and the tests, then exit")
+    args = ap.parse_args(argv)
+
+    if args.make_fixture:
+        make_fixture(args.make_fixture)
+        print(f"wrote synthetic capture to {args.make_fixture}")
+        return 0
+    if args.selftest:
+        return selftest()
+    if not args.captures:
+        ap.error("no captures given (pass a trace dir or *.xplane.pb files)")
+
+    ledger = None
+    if args.ledger:
+        from pytorch_distributed_tpu.obs import comms
+        ledgers = comms.load_ledgers(args.ledger)
+        if args.step:
+            if args.step not in ledgers:
+                raise SystemExit(f"step {args.step!r} not in {args.ledger}; "
+                                 f"has: {sorted(ledgers)}")
+            ledger = ledgers[args.step]
+        elif len(ledgers) == 1:
+            ledger = next(iter(ledgers.values()))
+        else:
+            raise SystemExit(f"--ledger has {len(ledgers)} steps; pick one "
+                             f"with --step (has: {sorted(ledgers)})")
+
+    files = _collect_captures(args.captures)
+    timelines = [(rank, tlmod.parse_xspace(f)) for rank, f in
+                 enumerate(files)]
+
+    offsets = {}
+    if args.hb_dir:
+        by_pid = tlmod.clock_offsets_from_heartbeats(args.hb_dir)
+        # heartbeat pids map to capture ranks in sorted order
+        for rank, pid in enumerate(sorted(by_pid)):
+            if rank < len(timelines):
+                offsets[rank] = by_pid[pid]
+        if by_pid:
+            print(f"clock offsets from {args.hb_dir}: " + ", ".join(
+                f"rank{r}={offsets.get(r, 0.0) * 1e3:+.3f}ms"
+                for r, _ in enumerate(timelines)))
+
+    report = {"captures": [], "ledger": args.ledger,
+              "ledger_step": ledger.step if ledger else None}
+    for rank, tl in timelines:
+        stats = tlmod.analyze_steps(tl, annotation=args.annotation)
+        agg = tlmod.aggregate_steps(stats)
+        marriage = tlmod.marry_ledger(stats, ledger) if (
+            ledger and stats) else {}
+        print("\n".join(_report_text(
+            rank, tl, stats, agg, marriage,
+            ledger.step if ledger else "")))
+        report["captures"].append({
+            "rank": rank, "source": tl.source, "hostname": tl.hostname,
+            "clock_offset_s": offsets.get(rank, 0.0),
+            "aggregate": agg,
+            "per_step": [s.to_dict() for s in stats],
+            "ledger_marriage": marriage,
+        })
+
+    if args.out:
+        trace = tlmod.to_chrome_trace(timelines, offsets)
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+            f.write("\n")
+        print(f"wrote Chrome-trace JSON ({len(trace['traceEvents'])} events)"
+              f" to {args.out} — open in https://ui.perfetto.dev")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

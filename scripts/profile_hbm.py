@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-from benchlib import timed_scalar  # noqa: E402
+from benchlib import timed_scalar, timed_step_loop  # noqa: E402
 
 
 def hbm():
@@ -58,15 +58,7 @@ def step_bench(batch):
          "labels": jnp.asarray(rng.integers(0, 1000, size=batch).astype(np.int32)),
          "weights": jnp.ones((batch,), jnp.float32)}
     lr = jnp.float32(0.1)
-    for _ in range(3):
-        state, met = step(state, b, lr)
-    float(met["loss"])
-    iters = 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, met = step(state, b, lr)
-    float(met["loss"])
-    dt = (time.perf_counter() - t0) / iters
+    dt, _ = timed_step_loop(step, state, b, lr, iters=10, warmup=3)
     print(f"batch {batch}: {dt*1e3:.1f} ms/step -> {batch/dt:.0f} img/s")
 
 

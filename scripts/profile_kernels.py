@@ -3,24 +3,15 @@
 the tunnel transfer never pollutes timing.  Measures dispatch latency, MXU
 matmul ceiling, and representative ResNet conv fwd/bwd shapes."""
 
-import time
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from functools import partial
 
+from benchlib import timed_scalar as _timed_scalar  # noqa: E402
 
-def timed_scalar(fn, *args, iters=30, warmup=5):
-    """fn must return a scalar; sync by fetching its value."""
-    for _ in range(warmup):
-        out = fn(*args)
-    float(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    float(out)
-    return (time.perf_counter() - t0) / iters
+# microbenchmark sampling: more iters/warmup than benchlib's quick default
+timed_scalar = partial(_timed_scalar, iters=30, warmup=5)
 
 
 def main():

@@ -5,24 +5,15 @@ Finds where the ResNet-50 step time goes (VERDICT round-1: backward runs
 3.5x forward where ~2x is expected).  Run on TPU: ``python scripts/profile_step.py``.
 """
 
-import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from benchlib import timed_step_loop, timed_tree  # noqa: E402
 
-def timed(fn, *args, iters=20, warmup=3):
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.tree_util.tree_leaves(out)[0].block_until_ready()
-    # Value-fetch sync (axon block_until_ready returns early).
-    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
-    return (time.perf_counter() - t0) / iters
+timed = partial(timed_tree, iters=20, warmup=3)
 
 
 def main():
@@ -91,23 +82,8 @@ def main():
     b = {"images": images, "labels": labels,
          "weights": jnp.ones((batch,), jnp.float32)}
 
-    def run(s):
-        s2, m2 = step(s, b, jnp.float32(0.1))
-        return m2["loss"]
-
-    # can't donate in a timing loop with same state; rebuild step without donation
-    from pytorch_distributed_tpu.train import steps as steps_mod
-    t0 = time.perf_counter()
-    iters = 20
-    st = state
-    for _ in range(3):
-        st, met = step(st, b, jnp.float32(0.1))
-    float(met["loss"])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        st, met = step(st, b, jnp.float32(0.1))
-    float(met["loss"])
-    t_step = (time.perf_counter() - t0) / iters
+    t_step, _ = timed_step_loop(step, state, b, jnp.float32(0.1),
+                                iters=20, warmup=3)
     print(f"full step: {t_step*1e3:.2f} ms -> {batch/t_step:.0f} img/s")
 
 

@@ -1,21 +1,34 @@
 #!/usr/bin/env python
-"""Capture an XPlane trace of the ResNet-50 train step on the real chip and
-print the self-time op breakdown (tensorboard_plugin_profile converter)."""
+"""Capture an XPlane trace of the ResNet-50 train step and summarize it.
 
-import glob
+Capture goes through ``obs.trace.capture`` (the shared start/stop_trace
+path) with the step wrapped in ``obs.trace.scope("profile_step")`` so the
+in-repo timeline decoder can window per-step comm/compute/overlap.  The
+default summary uses ``obs.timeline``/``scripts/obs_timeline.py`` — pure
+stdlib, no tensorboard.  ``analyze <tool>`` keeps the old
+tensorboard_plugin_profile converter as an optional fallback for tools
+the in-repo decoder doesn't cover (memory_profile, op_profile, ...).
+
+Usage:
+    python scripts/profile_trace.py                # capture + timeline report
+    python scripts/profile_trace.py analyze [tool] # tensorboard converter
+"""
+
 import os
 import sys
-import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 TRACE_DIR = "/tmp/ptd_trace"
 
 
 def capture():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from pytorch_distributed_tpu import models
+    from pytorch_distributed_tpu.obs import trace
     from pytorch_distributed_tpu.parallel import data_parallel_mesh
     from pytorch_distributed_tpu.train.optim import sgd_init
     from pytorch_distributed_tpu.train.state import TrainState
@@ -38,18 +51,31 @@ def capture():
     for _ in range(3):
         state, met = step(state, b, lr)
     float(met["loss"])
-    jax.profiler.start_trace(TRACE_DIR)
-    for _ in range(5):
-        state, met = step(state, b, lr)
-    float(met["loss"])
-    jax.profiler.stop_trace()
-    print("trace captured")
+    with trace.capture(TRACE_DIR):
+        for _ in range(5):
+            with trace.scope("profile_step"):
+                state, met = step(state, b, lr)
+        float(met["loss"])
+    print(f"trace captured -> {TRACE_DIR}")
+    report()
+
+
+def report():
+    """Per-rank comm/compute/overlap summary via the in-repo decoder."""
+    import obs_timeline
+
+    rc = obs_timeline.main([TRACE_DIR, "--annotation", "profile_step"])
+    if rc:
+        print("timeline report failed; try: "
+              "python scripts/profile_trace.py analyze", file=sys.stderr)
 
 
 def analyze(tool="framework_op_stats"):
     from tensorboard_plugin_profile.convert import raw_to_tool_data
 
-    paths = sorted(glob.glob(TRACE_DIR + "/**/*.xplane.pb", recursive=True))
+    from pytorch_distributed_tpu.obs import timeline
+
+    paths = timeline.find_xplane_files(TRACE_DIR)
     if not paths:
         sys.exit("no xplane.pb found")
     data, _ = raw_to_tool_data.xspace_to_tool_data([paths[-1]], tool + "^", {})
@@ -63,5 +89,7 @@ def analyze(tool="framework_op_stats"):
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "analyze":
         analyze(sys.argv[2] if len(sys.argv) > 2 else "framework_op_stats")
+    elif len(sys.argv) > 1 and sys.argv[1] == "report":
+        report()
     else:
         capture()

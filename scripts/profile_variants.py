@@ -7,11 +7,12 @@ Prints ms/step and img/s for each; use to decide what bench.py should run.
 """
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from benchlib import timed_step_loop  # noqa: E402
 
 
 def bench(name, batch, stem):
@@ -40,15 +41,7 @@ def bench(name, batch, stem):
         "weights": jnp.ones((batch,), jnp.float32),
     }
     lr = jnp.float32(0.1)
-    for _ in range(3):
-        state, met = step(state, b, lr)
-    float(met["loss"])
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, met = step(state, b, lr)
-    float(met["loss"])
-    dt = (time.perf_counter() - t0) / iters
+    dt, _ = timed_step_loop(step, state, b, lr, iters=20, warmup=3)
     print(f"{name}: {dt*1e3:.1f} ms/step -> {batch/dt:.0f} img/s", flush=True)
 
 

@@ -25,6 +25,9 @@ Usage:
   python scripts/shardlint.py --json report.json # machine-readable output
   python scripts/shardlint.py --update-baseline  # pin current collective
                                                  # budgets as the new fence
+  python scripts/shardlint.py --comm-ledger comm_ledger.json
+                                                 # itemized per-collective
+                                                 # receipt (obs.comms)
   python scripts/shardlint.py --selftest         # planted-hazard checks
 """
 
@@ -74,6 +77,10 @@ def main() -> int:
                     help="write the current collective budgets to --baseline "
                          "instead of diffing (run after a reviewed change "
                          "that intentionally alters the budget)")
+    ap.add_argument("--comm-ledger", default=None, metavar="PATH",
+                    help="write the itemized communication ledger (every "
+                         "collective with bytes/fan-out/scope attribution) "
+                         "for the analyzed steps to PATH")
     ap.add_argument("--min-replicated-bytes", type=int,
                     default=core.DEFAULT_MIN_REPLICATED_BYTES)
     ap.add_argument("--min-promotion-bytes", type=int,
@@ -119,6 +126,15 @@ def main() -> int:
                 continue
             for f in diff_against_baseline(r, baseline.get(r.name)):
                 r.add(f)
+
+    if args.comm_ledger:
+        # Rides the same lowering cache as the analysis sweep above, so
+        # the itemized receipt costs no extra compiles.
+        from pytorch_distributed_tpu.obs import comms  # noqa: E402
+        ledgers = core.sweep_comm_ledgers(names)
+        comms.write_ledgers(args.comm_ledger, ledgers)
+        print(f"wrote comm ledger for {len(ledgers)} steps to "
+              f"{args.comm_ledger}")
 
     print(render_table(reports))
     if args.json:

@@ -36,3 +36,21 @@ jax.config.update("jax_threefry_partitionable", True)
 # Installs the jax API compat shims (jax.shard_map / lax.axis_size on
 # 0.4.x) before any test module does ``from jax import shard_map``.
 import pytorch_distributed_tpu  # noqa: E402,F401
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def get_lowering():
+    """Session-shared compiled recipe lowerings.
+
+    Hands back ``analysis.core.get_lowering`` — the memoized
+    lower+compile sweep over the shardlint RECIPES — so everything that
+    needs a recipe's HLO (test_shardlint's detector fences, test_comms'
+    ledger parity checks) pays one compile per step for the whole
+    session instead of one per test.  Threshold variations and ledger
+    extraction are pure functions of the cached Lowering record.
+    """
+    from pytorch_distributed_tpu.analysis import core
+
+    return core.get_lowering

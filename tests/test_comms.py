@@ -1,0 +1,253 @@
+"""Communication ledger + timeline (obs/comms.py, obs/timeline.py).
+
+Layers under test:
+- the wire-byte conventions (pure arithmetic, no jax);
+- static ledger vs analytic model parity: for the fenced DP/TP LM steps
+  and the GSPMD image step, the ledger extracted from the compiled HLO
+  must land within ±15% of ``obs.flops``'s analytic per-step byte
+  estimates (the ISSUE-7 acceptance fence) — lowerings come off the
+  session-shared ``get_lowering`` fixture, so this suite adds zero
+  compiles beyond test_shardlint's sweep;
+- XPlane round-trip on a *real* CPU profiler capture: ``trace.capture``
+  + ``trace.scope`` markers in, per-step spans and comm/compute windows
+  out of the stdlib decoder;
+- the ``obs_report --diff`` comm fence: a planted exposed-comm
+  regression (identical step time) must exit 1;
+- cross-rank merge: two synthetic skewed captures + heartbeat clocks
+  must align to sub-µs in the merged Chrome trace;
+- ``scripts/obs_timeline.py --selftest`` end to end (separate process).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_tpu.analysis import core
+from pytorch_distributed_tpu.obs import comms, flops, timeline, trace
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "scripts"))
+
+import obs_report  # noqa: E402
+
+_LM = core._LM
+
+
+# ------------------------------------------------------- wire conventions
+
+def test_wire_byte_conventions():
+    b = 1024
+    assert comms.wire_bytes("all-reduce", b, 4) == 2 * 3 / 4 * b
+    assert comms.wire_bytes("all-gather", b, 4) == 3 / 4 * b
+    assert comms.wire_bytes("reduce-scatter", b, 4) == 3 * b
+    assert comms.wire_bytes("all-to-all", b, 4) == 3 / 4 * b
+    assert comms.wire_bytes("collective-permute", b, 4) == b
+    # single-participant groups move nothing
+    for kind in ("all-reduce", "all-gather", "collective-permute"):
+        assert comms.wire_bytes(kind, b, 1) == 0
+
+
+# ------------------------------------------- ledger vs analytic model (±15%)
+
+def test_ledger_dp_parity(get_lowering):
+    low = get_lowering("lm_train_dp")
+    ledger = comms.ledger_from_hlo_text(low.text, step=low.name,
+                                        mesh_shape=low.mesh_shape)
+    assert ledger.count > 0 and ledger.total_bytes > 0
+    pred = flops.lm_comm_bytes(_LM["vocab"], _LM["d_model"], 1,
+                               _LM["batch"], _LM["seq"], dp=4, tp=1)
+    residual = flops.comm_residual_pct(pred.total_bytes, ledger.total_bytes)
+    assert residual <= 15.0, (pred.total_bytes, ledger.total_bytes, residual)
+    # scope attribution: the gradient sync must land in the backward phase
+    phases = ledger.by_phase()
+    assert "backward" in phases, phases
+    assert phases["backward"]["bytes"] > 0.9 * ledger.total_bytes, phases
+
+
+def test_ledger_tp_parity(get_lowering):
+    low = get_lowering("lm_fused_ce_tp")
+    ledger = comms.ledger_from_hlo_text(low.text, step=low.name,
+                                        mesh_shape=low.mesh_shape)
+    pred = flops.lm_comm_bytes(_LM["vocab"], _LM["d_model"], 1,
+                               _LM["batch"], _LM["seq"], dp=2, tp=2,
+                               fused_ce=True)
+    residual = flops.comm_residual_pct(pred.total_bytes, ledger.total_bytes)
+    assert residual <= 15.0, (pred.total_bytes, ledger.total_bytes, residual)
+    # Megatron-style TP must show the head-boundary permutes, not just
+    # psums — the kind mix is part of the fence
+    kinds = ledger.by_kind()
+    assert "collective-permute" in kinds, kinds
+    assert "all-reduce" in kinds, kinds
+
+
+def test_ledger_image_parity(get_lowering):
+    low = get_lowering("train_image_gspmd")
+    ledger = comms.ledger_from_hlo_text(low.text, step=low.name,
+                                        mesh_shape=low.mesh_shape)
+    state = low.args[0]
+    params = sum(int(x.size) for x in jax.tree_util.tree_leaves(state.params))
+    pred = flops.image_comm_bytes(params, dp=4)
+    residual = flops.comm_residual_pct(pred.total_bytes, ledger.total_bytes)
+    assert residual <= 15.0, (pred.total_bytes, ledger.total_bytes, residual)
+
+
+def test_ledger_roundtrips_through_json(get_lowering, tmp_path):
+    low = get_lowering("lm_train_dp")
+    ledger = comms.ledger_from_hlo_text(low.text, step=low.name,
+                                        mesh_shape=low.mesh_shape)
+    path = str(tmp_path / "comm_ledger.json")
+    comms.write_ledgers(path, [ledger])
+    back = comms.load_ledgers(path)[low.name]
+    assert back.total_bytes == ledger.total_bytes
+    assert back.count == ledger.count
+    assert back.by_kind() == ledger.by_kind()
+    fields = back.metrics_fields()
+    assert fields["model_comm_bytes"] == ledger.total_bytes
+    assert fields["collective_count"] == ledger.count
+
+
+# ----------------------------------------------- XPlane round-trip (real)
+
+def test_xplane_roundtrip_real_capture(tmp_path):
+    """Capture a real (CPU) profiler trace through the shared
+    ``trace.capture`` path and decode it with the stdlib XPlane parser:
+    the ``trace.scope`` step markers and device op spans must survive the
+    round trip and window into per-step stats."""
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((256, 256), jnp.float32)
+    float(f(x))  # compile outside the capture
+    d = str(tmp_path / "trace")
+    with trace.capture(d):
+        for _ in range(3):
+            with trace.scope("profile_step"):
+                float(f(x))
+    files = timeline.find_xplane_files(d)
+    assert files, f"no xplane.pb under {d}"
+    tl = timeline.parse_xspace(files[-1])
+    assert tl.spans, "decoder produced no spans from a real capture"
+    markers = tl.annotations("profile_step")
+    assert len(markers) == 3, [s.name for s in tl.spans][:40]
+    assert all(m.dur_ns > 0 for m in markers)
+    assert any(s.is_xla_op() for s in tl.spans)
+    assert tl.device_lines(), "no device stream carried XLA ops"
+    stats = timeline.analyze_steps(tl, annotation="profile_step")
+    assert stats, "no per-step windows"
+    assert {s.step for s in stats} <= {0, 1, 2}
+    for s in stats:
+        assert s.compute_ns > 0
+        assert 0 <= s.overlap_ns <= min(s.comm_ns, s.compute_ns) + 1e-9
+    agg = timeline.aggregate_steps(stats)
+    assert agg["steps"] >= 1 and agg["comm_ms_mean"] >= 0.0
+
+
+# ------------------------------------------------ diff fence (exit code 1)
+
+def _write_run(path, exposed_ms):
+    from pytorch_distributed_tpu.obs.metrics import MetricsLogger
+
+    with MetricsLogger(path, flush_every=50) as log:
+        for i in range(30):
+            log.log_step(i, step_time=0.010, n_items=128, lr=0.1,
+                         extra={"model_comm_bytes": 66952.0,
+                                "comm_wire_bytes": 100428.0,
+                                "exposed_comm_ms": exposed_ms,
+                                "overlap_pct": 60.0})
+
+
+def test_diff_exit_1_on_planted_exposed_comm_regression(tmp_path, capsys):
+    """The ISSUE-7 acceptance fence: identical step time, but collectives
+    stopped hiding under compute — ``obs_report --diff`` must exit 1."""
+    base = str(tmp_path / "base.jsonl")
+    bad = str(tmp_path / "bad.jsonl")
+    _write_run(base, exposed_ms=0.20)
+    _write_run(bad, exposed_ms=0.55)
+    rc = obs_report.main(["--diff", base, bad])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    assert "REGRESS" in out and "exposed_comm_ms" in out
+    # same run against itself is clean; json mode agrees with the rc
+    assert obs_report.main(["--diff", base, base]) == 0
+    capsys.readouterr()
+    rc_json = obs_report.main(["--diff", base, bad, "--format", "json"])
+    js = json.loads(capsys.readouterr().out)
+    assert rc_json == 1 and js["overall"] == "REGRESS"
+    by_name = {r["metric"]: r for r in js["metrics"]}
+    assert by_name["exposed_comm_ms"]["verdict"] == "REGRESS"
+    assert by_name["step_time_p50"]["verdict"] == "PASS"
+
+
+# ------------------------------------------- cross-rank clock alignment
+
+def _synthetic_capture(base_ns):
+    return [{
+        "name": "/host:CPU",
+        "lines": [{
+            "name": "tf_XLATfrtCpuClient/0",
+            "timestamp_ns": base_ns,
+            "events": [
+                {"name": "fusion.1", "offset_ps": 0,
+                 "duration_ps": 60_000_000, "stats": {"hlo_op": "fusion.1"}},
+                {"name": "all-reduce.3", "offset_ps": 55_000_000,
+                 "duration_ps": 30_000_000},
+            ],
+        }],
+    }]
+
+
+def test_merged_timeline_clock_alignment(tmp_path):
+    """Two ranks capture the same step with a 2.5 ms clock skew; their
+    heartbeat step clocks carry the same skew.  After the heartbeat-derived
+    offsets are applied, the merged Chrome trace must line the collectives
+    up to well under the skew."""
+    skew_s = 0.0025
+    t0 = 1_000_000
+    tl0 = timeline.parse_xspace_bytes(
+        timeline.encode_xspace(_synthetic_capture(t0), hostname="host0"),
+        source="rank0")
+    tl1 = timeline.parse_xspace_bytes(
+        timeline.encode_xspace(_synthetic_capture(t0 + int(skew_s * 1e9)),
+                               hostname="host1"),
+        source="rank1")
+
+    hb = tmp_path / "hb"
+    hb.mkdir()
+    for pid, off in ((100, 0.0), (200, skew_s)):
+        with open(hb / f"heartbeat-{pid}.jsonl", "w") as f:
+            for step in range(6):
+                f.write(json.dumps(
+                    {"pid": pid, "step": step, "t": 1000.0 + step + off})
+                    + "\n")
+    offs = timeline.clock_offsets_from_heartbeats(str(hb))
+    assert offs[100] == 0.0
+    assert abs(offs[200] - skew_s) < 1e-9
+
+    merged = timeline.to_chrome_trace(
+        [(0, tl0), (1, tl1)], {0: offs[100], 1: offs[200]})
+    coll = [e for e in merged["traceEvents"]
+            if e.get("cat") == "collective"]
+    assert len(coll) == 2
+    ts = {e["pid"]: e["ts"] for e in coll}
+    assert abs(ts[0] - ts[1]) < 1.0, ts  # µs — skew was 2500 µs
+    # without offsets the skew is visible — proves alignment did the work
+    raw = timeline.to_chrome_trace([(0, tl0), (1, tl1)])
+    ts_raw = {e["pid"]: e["ts"] for e in raw["traceEvents"]
+              if e.get("cat") == "collective"}
+    assert abs(ts_raw[0] - ts_raw[1]) == pytest.approx(2500.0)
+
+
+# --------------------------------------------------- CLI selftests (tier-1)
+
+def test_obs_timeline_selftest_subprocess():
+    """The decoder/analyzer CLI end to end on the checked-in fixture —
+    fast (no jax import on this path)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "obs_timeline.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "selftest OK" in out.stdout

@@ -174,8 +174,10 @@ def test_baseline_diff_regression_improvement_and_missing_entry():
         "all-reduce": {"count": 3, "bytes": 300},
         "all-gather": {"count": 1, "bytes": 64}})
     regress = diff_against_baseline(worse, base)
-    assert [f.severity for f in regress] == ["error"]
-    assert regress[0].kind == "collective-regression"
+    # the new kind trips the per-kind budget AND the per-step total budget
+    assert [f.severity for f in regress] == ["error", "error"]
+    assert {f.kind for f in regress} == {"collective-regression"}
+    assert {f.where for f in regress} == {"s:all-gather", "s:total"}
     better = StepReport(name="s", mesh_shape={"data": 4},
                         collectives={"all-reduce": {"count": 2, "bytes": 200}})
     assert [f.severity for f in diff_against_baseline(better, base)] == [
@@ -207,24 +209,25 @@ def test_synthetic_bad_step_trips_every_planted_hazard():
     assert rep.donation["missing"] == [0]
 
 
-def test_fused_ce_fence_replicated_flagged_dp_tp_clean():
+def test_fused_ce_fence_replicated_flagged_dp_tp_clean(get_lowering):
     """The PR-1 regression fence: the replicated fused-CE mode carries the
     full [V, D] dE accumulator on every device of the data mesh; the dp
     and tp shardings must eliminate it entirely."""
     V, D = core._LM["vocab"], core._LM["d_model"]
-    bad = core.analyze_recipe("lm_fused_ce_replicated",
-                              min_replicated_bytes=4096)
+    bad = core.analyze_lowering(get_lowering("lm_fused_ce_replicated"),
+                                min_replicated_bytes=4096)
     flagged = bad.by_kind("replicated-large-tensor")
     assert any(f.shape == (V, D) for f in flagged), bad.findings
     for mode in ("lm_fused_ce_dp", "lm_fused_ce_tp"):
-        good = core.analyze_recipe(mode, min_replicated_bytes=4096)
+        good = core.analyze_lowering(get_lowering(mode),
+                                     min_replicated_bytes=4096)
         assert good.by_kind("replicated-large-tensor") == [], (
             mode, good.findings)
 
 
-def test_train_step_donations_fully_aliased():
+def test_train_step_donations_fully_aliased(get_lowering):
     for name in ("lm_train_dp", "lm_pp_1f1b"):
-        rep = core.analyze_recipe(name)
+        rep = core.analyze_lowering(get_lowering(name))
         assert rep.donation["missing"] == [], (name, rep.donation)
         assert rep.by_kind("lost-donation") == []
         assert rep.donation["aliased"] == rep.donation["expected"]
