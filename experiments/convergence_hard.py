@@ -158,7 +158,7 @@ def run_config(data_root: str, tmpdir: str, name: str, precision: str,
         workers=2, sync_bn=sync_bn,
     )
     t = Trainer(cfg, explicit_collectives=explicit,
-                wire_dtype=jnp.bfloat16 if explicit else None)
+                grad_compress="bf16" if explicit else None)
     curve = []
     for epoch in range(EPOCHS):
         t.train_epoch(epoch)

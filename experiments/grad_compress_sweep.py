@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Gradient-compression A/B: loss parity + measured wire bytes for
+``--grad-compress none | bf16 | int8`` on the 4-way CPU mesh.
+
+The quantized gradient collective (ops/qcomm.py) claims two things at
+once, and both are checkable on CPU:
+
+1. **Convergence parity** — int8 block quantization *with error feedback*
+   must track the f32 run: same synthetic task, same seed, same schedule;
+   the final-loss delta is the oracle (the convergence.py spread-gate
+   methodology, applied to loss since this is a fixed-step run).
+2. **Wire reduction** — the compressed decomposition (all_to_all of int8
+   payload + f32 block scales, then all_gather of the re-quantized
+   shards) must move >= 3.5x fewer grad_sync wire bytes than the f32
+   all-reduce.  Measured from the compiled HLO via the comm ledger
+   (obs/comms.py), not asserted from the analytic formula — the fence is
+   on what XLA actually lowered.
+
+Every run uses the explicit-collectives shard_map step
+(train/steps.py local_step), where compression is real wire traffic.
+The model's parameter leaves are sized as multiples of
+``n_data * block`` so padding overhead reflects realistic layers, not a
+toy-bias worst case (a 10-element bias pads to its chunk boundary;
+a 49k kernel doesn't pad at all).
+
+Writes ``RESULTS_grad_compress.json``.  CPU-safe:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    PYTHONPATH=/root/repo python experiments/grad_compress_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+
+if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+DP = int(os.environ.get("GCS_DP", "4"))
+HIDDEN = int(os.environ.get("GCS_HIDDEN", "256"))
+CLASSES = int(os.environ.get("GCS_CLASSES", "8"))
+STEPS = int(os.environ.get("GCS_STEPS", "40"))
+BATCH = int(os.environ.get("GCS_BATCH", "32"))
+LR = float(os.environ.get("GCS_LR", "0.05"))
+SEED = int(os.environ.get("GCS_SEED", "0"))
+
+
+def _build(mode: str, mesh):
+    import warnings
+
+    import flax.linen as nn
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.ops import qcomm
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(HIDDEN)(x))
+            return nn.Dense(CLASSES)(x)
+
+    model = MLP()
+    variables = model.init(jax.random.PRNGKey(SEED),
+                           jnp.zeros((1, 8, 8, 3)), train=False)
+    residual = qcomm.init_residual(variables["params"], mode,
+                                   explicit=True, n_data=DP)
+    state = TrainState.create(variables, sgd_init(variables["params"]),
+                              residual=residual)
+    if mode in qcomm.QUANTIZED_MODES:
+        state = state.replace(residual=jax.device_put(
+            state.residual, NamedSharding(mesh, P("data"))))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # fp8 availability notes etc.
+        step = make_train_step(model, mesh, explicit_collectives=True,
+                               grad_compress=mode)
+    return step, state
+
+
+def _batches():
+    """Learnable synthetic task: labels from a fixed random linear map of
+    the flattened image — every mode sees the identical stream."""
+    rng = np.random.default_rng(SEED)
+    w_true = rng.normal(size=(8 * 8 * 3, CLASSES))
+    for _ in range(STEPS):
+        x = rng.normal(size=(BATCH, 8, 8, 3)).astype(np.float32)
+        y = np.argmax(x.reshape(BATCH, -1) @ w_true, axis=-1).astype(np.int32)
+        yield {
+            "images": x,
+            "labels": y,
+            "weights": np.ones((BATCH,), np.float32),
+        }
+
+
+def run_mode(mode: str, mesh) -> dict:
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.obs import comms
+
+    step, state = _build(mode, mesh)
+    lr = jnp.float32(LR)
+    first_batch = None
+    losses = []
+    for batch in _batches():
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        if first_batch is None:
+            first_batch = jb
+        state, metrics = step(state, jb, lr)
+        losses.append(float(metrics["loss"]))
+    ledger = comms.ledger_from_jitted(step, (state, first_batch, lr),
+                                      step=f"img_{mode}", mesh=mesh)
+    gs = ledger.by_phase().get("grad_sync",
+                               {"count": 0, "bytes": 0, "wire_bytes": 0.0})
+    return {
+        "first_loss": round(losses[0], 6),
+        "final_loss": round(losses[-1], 6),
+        "grad_sync_collectives": int(gs["count"]),
+        "grad_sync_payload_bytes": int(gs["bytes"]),
+        "grad_sync_wire_bytes": round(float(gs["wire_bytes"]), 1),
+        "grad_sync_encodings": {
+            k: int(v)
+            for k, v in ledger.phase_wire_encodings("grad_sync").items()},
+        "total_wire_bytes": round(ledger.total_wire_bytes, 1),
+    }
+
+
+def main() -> int:
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+
+    if len(jax.devices()) < DP:
+        print(f"SKIP: need {DP} devices, have {len(jax.devices())} "
+              f"(set XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+        return 0
+    mesh = build_mesh(MeshSpec(("data",), (DP,)), jax.devices()[:DP])
+
+    rows = {}
+    for mode in ("none", "bf16", "int8"):
+        rows[mode] = run_mode(mode, mesh)
+        print(f"{mode}: final loss {rows[mode]['final_loss']:.4f}, "
+              f"grad_sync wire {rows[mode]['grad_sync_wire_bytes']:.0f} B "
+              f"({rows[mode]['grad_sync_encodings']})", flush=True)
+
+    f32_loss = rows["none"]["final_loss"]
+    f32_wire = rows["none"]["grad_sync_wire_bytes"]
+    deltas = {m: round(abs(rows[m]["final_loss"] - f32_loss)
+                       / max(abs(f32_loss), 1e-9), 6)
+              for m in ("bf16", "int8")}
+    wire_ratio = {m: round(f32_wire / rows[m]["grad_sync_wire_bytes"], 3)
+                  for m in ("bf16", "int8")}
+
+    out = {
+        "bf16_cpu_note": (
+            "on the CPU backend XLA's float-normalization pass promotes "
+            "bf16 all-reduces back to f32 (convert-wrapped f32 collective "
+            "in the compiled HLO), so measured bf16 wire bytes equal f32 "
+            "here; on TPU the bf16 all-reduce is native and halves the "
+            "wire.  int8/fp8 payloads are integer/opaque to that pass — "
+            "their measured reduction is real on every backend."),
+        "meta": {
+            "dp": DP, "hidden": HIDDEN, "classes": CLASSES, "steps": STEPS,
+            "batch": BATCH, "lr": LR, "seed": SEED,
+            "platform": jax.default_backend(),
+            "what": "A/B of --grad-compress modes on the explicit-"
+                    "collectives image step (train/steps.py local_step, "
+                    "4-way data mesh): identical synthetic stream and "
+                    "seed per mode; final-loss delta vs f32 is the "
+                    "convergence oracle (convergence.py spread-gate "
+                    "methodology) and the comm ledger's grad_sync wire "
+                    "bytes (obs/comms.py, from the compiled HLO) are the "
+                    "wire-reduction oracle.  int8 rides the two-hop "
+                    "quantized decomposition with error feedback "
+                    "(ops/qcomm.py compressed_psum).",
+        },
+        "rows": rows,
+        "final_loss_rel_delta_vs_f32": deltas,
+        "grad_sync_wire_reduction_vs_f32": wire_ratio,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "..", "RESULTS_grad_compress.json"),
+              "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out), flush=True)
+    # Falsifiable claims: int8+EF tracks f32 within 2% relative final
+    # loss, and its measured grad_sync wire traffic shrinks >= 3.5x (the
+    # ISSUE-8 acceptance floor; analytic best is ~3.94x at block=256).
+    # bf16 is NOT asserted: CPU float normalization promotes bf16
+    # collectives to f32 (see bf16_cpu_note), so its measured ratio is
+    # 1.0 here and ~2x only on accelerators.
+    assert deltas["int8"] <= 0.02, deltas
+    assert wire_ratio["int8"] >= 3.5, wire_ratio
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,7 @@ ARCH = os.environ.get("RECIPE_BENCH_ARCH", "resnet50")
 ITERS = int(os.environ.get("RECIPE_BENCH_ITERS", "20"))
 
 
-def bench_config(name, dtype, explicit, wire_dtype):
+def bench_config(name, dtype, explicit, grad_compress):
     from pytorch_distributed_tpu import models
     from pytorch_distributed_tpu.parallel import data_parallel_mesh
     from pytorch_distributed_tpu.train.optim import sgd_init
@@ -46,7 +46,7 @@ def bench_config(name, dtype, explicit, wire_dtype):
                            jnp.zeros((1, IMAGE, IMAGE, 3)), train=False)
     state = TrainState.create(variables, sgd_init(variables["params"]))
     step = make_train_step(model, mesh, explicit_collectives=explicit,
-                           wire_dtype=wire_dtype)
+                           grad_compress=grad_compress)
     rng = np.random.default_rng(0)
     batch = {
         "images": jnp.asarray(
@@ -73,12 +73,12 @@ def bench_config(name, dtype, explicit, wire_dtype):
 
 def main() -> int:
     results = {}
-    for name, dtype, explicit, wire in (
+    for name, dtype, explicit, gc in (
         ("gspmd_f32", jnp.float32, False, None),
         ("gspmd_bf16", jnp.bfloat16, False, None),
-        ("explicit_bf16_wire", jnp.bfloat16, True, jnp.bfloat16),
+        ("explicit_bf16_wire", jnp.bfloat16, True, "bf16"),
     ):
-        results[name] = bench_config(name, dtype, explicit, wire)
+        results[name] = bench_config(name, dtype, explicit, gc)
 
     out = {
         "meta": {

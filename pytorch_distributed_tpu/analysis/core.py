@@ -418,27 +418,34 @@ def _tiny_image_model(classes=10):
     return TinyMLP(classes=classes)
 
 
-def _image_state(model):
+def _image_state(model, grad_compress: str = "none", explicit: bool = False,
+                 n_data: int = 4):
     import jax
     import jax.numpy as jnp
 
+    from pytorch_distributed_tpu.ops import qcomm
     from pytorch_distributed_tpu.train.optim import sgd_init
     from pytorch_distributed_tpu.train.state import TrainState
 
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 8, 8, 3)), train=False)
-    return TrainState.create(variables, sgd_init(variables["params"]))
+    residual = qcomm.init_residual(variables["params"], grad_compress,
+                                   explicit=explicit, n_data=n_data)
+    return TrainState.create(variables, sgd_init(variables["params"]),
+                             residual=residual)
 
 
-def _recipe_train_image(explicit: bool):
+def _recipe_train_image(explicit: bool, grad_compress: str = "none"):
     import jax.numpy as jnp
 
     from pytorch_distributed_tpu.train.steps import make_train_step
 
     mesh = _mesh(("data",), (4,))
     model = _tiny_image_model()
-    state = _image_state(model)
-    step = make_train_step(model, mesh, explicit_collectives=explicit)
+    state = _image_state(model, grad_compress=grad_compress,
+                         explicit=explicit)
+    step = make_train_step(model, mesh, explicit_collectives=explicit,
+                           grad_compress=grad_compress)
     return step, (state, _image_batch(), jnp.float32(0.1)), (0,), mesh
 
 
@@ -574,6 +581,11 @@ def _recipe_decode():
 RECIPES: "OrderedDict[str, Callable[[], tuple]]" = OrderedDict([
     ("train_image_gspmd", lambda: _recipe_train_image(False)),
     ("train_image_explicit", lambda: _recipe_train_image(True)),
+    # Compressed gradient sync (ops/qcomm.py) over the explicit shard_map
+    # path: the pinned per-kind byte budgets make an accidental f32
+    # fallback in grad_sync a hard collective-regression error.
+    ("train_image_bf16", lambda: _recipe_train_image(True, "bf16")),
+    ("train_image_int8", lambda: _recipe_train_image(True, "int8")),
     ("eval_image", _recipe_eval_image),
     ("lm_train_dp", lambda: _recipe_lm_train(None)),
     ("lm_fused_ce_replicated", lambda: _recipe_lm_train("replicated")),

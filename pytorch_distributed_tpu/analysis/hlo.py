@@ -27,10 +27,17 @@ DTYPE_BYTES: Dict[str, int] = {
     "s32": 4, "u32": 4, "f32": 4,
     "s64": 8, "u64": 8, "f64": 8, "c64": 8,
     "c128": 16,
+    # fp8 families (quantized gradient collectives, ops/qcomm.py)
+    "f8e4m3fn": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e4m3": 1,
+    "f8e5m2": 1, "f8e5m2fnuz": 1, "f8e3m4": 1,
 }
 
+# Longer alternatives first — the regex engine takes the first match, so
+# `f8e4m3fn` must not be eaten by a shorter `f8e4m3` alternative.
 _SHAPE_RE = re.compile(
-    r"\b(pred|bf16|f16|f32|f64|s4|s8|s16|s32|s64|u4|u8|u16|u32|u64|c64|c128)"
+    r"\b(pred|bf16|f16|f32|f64"
+    r"|f8e4m3b11fnuz|f8e4m3fnuz|f8e4m3fn|f8e4m3|f8e5m2fnuz|f8e5m2|f8e3m4"
+    r"|s4|s8|s16|s32|s64|u4|u8|u16|u32|u64|c64|c128)"
     r"\[([0-9,]*)\]"
 )
 

@@ -59,6 +59,25 @@ def wire_bytes(kind: str, payload_bytes: int, group_size: int) -> float:
     return num * (n - 1) * payload_bytes / (n if div else 1)
 
 
+def wire_encoding_of(shapes) -> str:
+    """Wire encoding label for one collective, from its HLO result shapes.
+
+    The narrowest payload dtype wins: quantized-collective ops
+    (ops/qcomm.py) ship an int8/fp8 payload with small f32 block-scale
+    side-cars, so the f32 scales must not relabel the op.  Plain f32
+    collectives — and anything unrecognized — report ``"f32"``."""
+    dtypes = {dt for dt, _ in shapes}
+    if any(dt.startswith("f8") for dt in dtypes):
+        return "fp8"
+    if "s8" in dtypes or "u8" in dtypes:
+        return "int8"
+    if "bf16" in dtypes:
+        return "bf16"
+    if "f16" in dtypes:
+        return "f16"
+    return "f32"
+
+
 def phase_of_op_name(op_name: str) -> str:
     """Coarse step phase of a jax scope path.
 
@@ -96,6 +115,9 @@ class CommEntry:
     phase: str            # coarse scope phase (phase_of_op_name)
     op_name: str          # full jax scope path
     source: str           # "file:line"
+    # Payload dtype label (wire_encoding_of); defaults keep pre-existing
+    # comm_ledger.json files loadable (load_ledgers does CommEntry(**e)).
+    wire_encoding: str = "f32"
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -141,6 +163,16 @@ class CommLedger:
             slot["wire_bytes"] += e.wire_bytes
         return out
 
+    def phase_wire_encodings(self, phase: str) -> Dict[str, float]:
+        """Per-encoding payload bytes within one phase — obs_report labels
+        the grad_sync row by compression mode from this (``{"int8": ...,
+        "f32": ...}`` for the quantized decomposition's payload + scales)."""
+        out: Dict[str, float] = {}
+        for e in self.entries:
+            if e.phase == phase:
+                out[e.wire_encoding] = out.get(e.wire_encoding, 0.0) + e.bytes
+        return out
+
     def metrics_fields(self) -> Dict[str, float]:
         """The per-step fields the trainers stamp into the metrics JSONL."""
         return {
@@ -175,7 +207,7 @@ def ledger_from_hlo_text(
             wire_bytes=wire_bytes(d.kind, d.bytes, d.group_size),
             n_groups=d.n_groups, group_size=d.group_size,
             phase=phase_of_op_name(d.op_name), op_name=d.op_name,
-            source=d.source))
+            source=d.source, wire_encoding=wire_encoding_of(d.shapes)))
     return CommLedger(step=step, mesh_shape=dict(mesh_shape or {}),
                       entries=entries)
 

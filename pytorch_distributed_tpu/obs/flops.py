@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence
 
 # --------------------------------------------------------------------- peaks
 # Dense-matmul peak per chip, FLOP/s, at the framework's bf16 compute
@@ -385,6 +385,49 @@ def image_comm_bytes(params: int, dp: int = 4,
     scalars = 4.0 * metric_scalars
     return CommCost(by_kind={"all-reduce": grad + scalars},
                     breakdown={"grad_sync": grad, "scalars": scalars})
+
+
+def image_comm_bytes_compressed(
+    leaf_sizes: Sequence[int],
+    dp: int = 4,
+    mode: str = "int8",
+    block: Optional[int] = None,
+    metric_scalars: int = 5,
+) -> CommCost:
+    """Explicit-collectives image step with compressed gradient sync
+    (ops/qcomm.py).  Quantized modes lower the two-hop decomposition per
+    parameter leaf: an all-to-all of the full padded int8/fp8 payload +
+    f32 block scales (the reduce-scatter stage), then an all-gather of
+    the re-quantized shards + scales.  Per-device result bytes per leaf,
+    with ``(padded, nb) = qcomm.chunk_layout(size, dp, block)``:
+
+    - all-to-all:  ``padded`` (1-byte payload) + ``4*dp*nb`` (scales)
+    - all-gather:  ``padded``                  + ``4*dp*nb``
+
+    so the per-kind totals need the *per-leaf* sizes — padding depends on
+    each leaf, not the parameter sum.  ``bf16`` keeps the single
+    all-reduce at 2 bytes/param; scalar count/metric psums stay f32."""
+    from pytorch_distributed_tpu.ops import qcomm
+
+    if dp <= 1:
+        return CommCost(by_kind={}, breakdown={})
+    scalars = 4.0 * metric_scalars
+    if mode == "bf16":
+        grad = 2.0 * sum(leaf_sizes)
+        return CommCost(by_kind={"all-reduce": grad + scalars},
+                        breakdown={"grad_sync": grad, "scalars": scalars})
+    if mode not in qcomm.QUANTIZED_MODES:
+        return image_comm_bytes(sum(leaf_sizes), dp=dp,
+                                metric_scalars=metric_scalars)
+    block = qcomm.DEFAULT_BLOCK if block is None else block
+    a2a = ag = 0.0
+    for size in leaf_sizes:
+        padded, nb = qcomm.chunk_layout(int(size), dp, block)
+        a2a += padded + 4.0 * dp * nb
+        ag += padded + 4.0 * dp * nb
+    return CommCost(
+        by_kind={"all-to-all": a2a, "all-gather": ag, "all-reduce": scalars},
+        breakdown={"grad_sync": a2a + ag, "scalars": scalars})
 
 
 def lm_comm_bytes(vocab_size: int, d_model: int, n_layers: int, batch: int,

@@ -1,0 +1,288 @@
+"""Quantized gradient collectives: int8/fp8 wire compression for DP sync.
+
+The reference's Horovod recipe compresses gradients to fp16 on the wire
+(horovod_distributed.py:159-164); our explicit-collectives step matched it
+with a bf16 cast.  This module goes further, following two results that map
+directly onto the ``shard_map`` grad_sync scope:
+
+- **EQuARX** (arXiv:2506.17615): a quantized all-reduce decomposed as
+  quantize -> reduce-scatter -> dequantize/accumulate in f32 -> all-gather
+  of re-quantized shards, so the wire carries ~1 byte/element on both hops
+  while every accumulation stays full precision.  One deliberate deviation:
+  a raw int8 ``psum_scatter`` would overflow (127 + 127 doesn't fit) and
+  cannot carry per-block scales through XLA's reduction, so the
+  reduce-scatter stage is realized as an ``all_to_all`` of the int8 payload
+  (+ f32 block scales) with shard-local f32 accumulation — byte-identical
+  on the wire ((n-1)/n of the payload), overflow-free by construction.
+- **DynamiQ** (arXiv:2602.08923): error feedback preserves convergence
+  under aggressive compression — each rank keeps the part of its gradient
+  the quantizer dropped and adds it back into the next step's gradient
+  before compressing again, so the error telescopes instead of
+  accumulating.
+
+Quantization is per-block symmetric: blocks of ``DEFAULT_BLOCK`` elements
+share one f32 absmax-derived scale (overhead 4/256 ~ 1.6%), int8 payload
+(or fp8-e4m3 where the jax build supports the dtype).  All helpers are
+pure jax and trace inside ``shard_map``/``jit``; nothing here talks to
+hardware directly — the collectives lower to whatever the backend provides.
+
+Error-feedback state layout (the subtle part):
+
+- the **explicit** (shard_map) path has genuinely per-rank residuals —
+  rank j's quantizer drops different bits than rank k's.  The residual is
+  therefore carried *stacked*: leaf shape ``(n_data, *param_shape)``,
+  sharded over the data axis, so each rank reads and writes only its own
+  slot and the error-feedback state costs zero extra collectives.
+- the **GSPMD / emulation** paths quantize the already-synced global
+  gradient, so the error is replicated by construction and the residual is
+  plain param-shaped.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+MODES = ("none", "bf16", "int8", "fp8")
+QUANTIZED_MODES = ("int8", "fp8")
+DEFAULT_BLOCK = 256
+
+# Largest finite magnitudes of the wire formats (int8 symmetric: -127..127,
+# keeping -128 unused so the range is sign-symmetric; e4m3fn: 448).
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+_FP8 = getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_supported() -> bool:
+    """True when this jax build ships the float8_e4m3fn dtype."""
+    return _FP8 is not None
+
+
+def resolve_mode(
+    grad_compress: Optional[str],
+    wire_dtype=None,
+) -> Tuple[str, Optional[Any]]:
+    """Canonical ``(mode, cast_dtype)`` from the new flag + the legacy knob.
+
+    ``--grad-compress`` subsumes the old ``wire_dtype`` argument:
+    ``wire_dtype=jnp.bfloat16`` (the only dtype the recipes ever passed)
+    maps to mode ``"bf16"``.  ``cast_dtype`` is only meaningful for the
+    cast modes — it preserves the legacy behavior of casting to an
+    arbitrary caller-supplied dtype.  Conflicting settings raise.
+    """
+    mode = grad_compress if grad_compress is not None else "none"
+    if mode not in MODES:
+        raise ValueError(
+            f"grad_compress must be one of {MODES}, got {mode!r}")
+    cast_dtype = None
+    if wire_dtype is not None:
+        if mode == "none":
+            import warnings
+
+            warnings.warn(
+                "wire_dtype is deprecated; use grad_compress='bf16' "
+                "(the wire_dtype=jnp.bfloat16 equivalent)",
+                DeprecationWarning, stacklevel=3,
+            )
+            mode = "bf16"
+            cast_dtype = wire_dtype
+        elif mode == "bf16":
+            cast_dtype = wire_dtype
+        else:
+            raise ValueError(
+                f"wire_dtype={wire_dtype} conflicts with "
+                f"grad_compress={mode!r}; drop the deprecated wire_dtype")
+    if mode == "bf16" and cast_dtype is None:
+        cast_dtype = jnp.bfloat16
+    if mode == "fp8" and not fp8_supported():
+        raise ValueError(
+            "grad_compress='fp8' requires a jax build with "
+            "jnp.float8_e4m3fn; use 'int8' on this install")
+    return mode, cast_dtype
+
+
+# ----------------------------------------------------------- quantize core
+
+def _quantize(xb: jnp.ndarray, mode: str):
+    """Per-block symmetric quantization along the last axis.
+
+    ``xb``: f32 ``(..., block)``.  Returns ``(q, scale)`` with ``q`` int8
+    or fp8-e4m3 of ``xb.shape`` and ``scale`` f32 of ``xb.shape[:-1]``.
+    All-zero blocks get scale 0 (dequantizes to exact zeros).
+    """
+    qmax = _QMAX[mode]
+    absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = absmax / qmax
+    inv = jnp.where(absmax > 0, qmax / absmax, 0.0)
+    y = jnp.clip(xb * inv, -qmax, qmax)
+    if mode == "int8":
+        q = jnp.round(y).astype(jnp.int8)
+    else:
+        if _FP8 is None:  # pragma: no cover - guarded by resolve_mode
+            raise ValueError("fp8 dtype unsupported by this jax build")
+        q = y.astype(_FP8)
+    return q, scale.squeeze(-1)
+
+
+def _dequantize(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def quantize_blockwise(x: jnp.ndarray, mode: str = "int8",
+                       block: int = DEFAULT_BLOCK):
+    """Quantize an arbitrary-shaped array: flatten, zero-pad to a block
+    multiple, quantize per block.  Returns ``(q, scale)`` with ``q`` of
+    shape ``(n_blocks, block)`` and ``scale`` of ``(n_blocks,)``."""
+    flat = x.astype(jnp.float32).ravel()
+    pad = (-flat.size) % block
+    xb = jnp.pad(flat, (0, pad)).reshape(-1, block)
+    return _quantize(xb, mode)
+
+
+def dequantize_blockwise(q: jnp.ndarray, scale: jnp.ndarray,
+                         shape: Tuple[int, ...]) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blockwise` (drops the zero padding)."""
+    flat = _dequantize(q, scale).ravel()
+    return flat[: math.prod(shape)].reshape(shape)
+
+
+def fake_quantize(x: jnp.ndarray, mode: str = "int8",
+                  block: int = DEFAULT_BLOCK) -> jnp.ndarray:
+    """Quantize-dequantize round trip — the numerics of the wire format
+    without any collective (the GSPMD emulation primitive)."""
+    q, s = quantize_blockwise(x, mode, block)
+    return dequantize_blockwise(q, s, x.shape)
+
+
+# -------------------------------------------------------- error feedback
+
+def _has_leaves(tree) -> bool:
+    return len(jax.tree_util.tree_leaves(tree)) > 0
+
+
+def init_residual(params: Pytree, mode: str, explicit: bool = False,
+                  n_data: int = 1) -> Pytree:
+    """Zero error-feedback residuals for ``mode`` (empty tree when the mode
+    carries no quantization error).  Explicit-collectives residuals are
+    stacked ``(n_data, *shape)`` — one slot per data-axis rank, sharded
+    over that axis (see the module docstring)."""
+    if mode not in QUANTIZED_MODES:
+        return {}
+    if explicit:
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros((n_data,) + p.shape, jnp.float32), params)
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def compress_emulated(grads: Pytree, residual: Pytree, mode: str,
+                      block: int = DEFAULT_BLOCK) -> Tuple[Pytree, Pytree]:
+    """Quantization *numerics* + error feedback on an already-synced
+    (replicated-semantics) gradient — the GSPMD-path analogue of the old
+    wire_dtype cast.  Does not move fewer bytes; see make_train_step's
+    NUMERICS-emulation warning."""
+    if _has_leaves(residual):
+        comp = jax.tree_util.tree_map(
+            lambda g, r: g.astype(jnp.float32) + r, grads, residual)
+        out = jax.tree_util.tree_map(
+            lambda g: fake_quantize(g, mode, block), comp)
+        new_res = jax.tree_util.tree_map(jnp.subtract, comp, out)
+        return out, new_res
+    out = jax.tree_util.tree_map(
+        lambda g: fake_quantize(g.astype(jnp.float32), mode, block), grads)
+    return out, residual
+
+
+# ---------------------------------------------- compressed all-reduce (EQuARX)
+
+def chunk_layout(size: int, n: int,
+                 block: int = DEFAULT_BLOCK) -> Tuple[int, int]:
+    """``(padded_total, blocks_per_chunk)`` of a ``size``-element leaf split
+    into ``n`` per-rank chunks of whole blocks.  Small leaves shrink the
+    block instead of ballooning the padding (a 10-element bias on a 4-way
+    mesh pads to 12 elements, not 1024).  Shared with the analytic
+    wire-byte model in ``obs/flops.py``."""
+    chunk = -(-size // n)
+    blk = min(block, chunk)
+    chunk = -(-chunk // blk) * blk
+    return n * chunk, chunk // blk
+
+
+def _compressed_leaf(g, r, axis_name, n, idx, mode, block):
+    """One leaf of the compressed all-reduce; runs per-rank in shard_map.
+
+    ``g``: this rank's local f32 gradient (sum-form).  ``r``: this rank's
+    residual slot ``(1, *g.shape)`` or None.  Returns the replicated f32
+    sum over ranks and the new residual slot.
+    """
+    shape, size = g.shape, g.size
+    p = g.astype(jnp.float32)
+    if r is not None:
+        p = p + r.reshape(shape)
+    total, nb = chunk_layout(size, n, block)
+    blk = (total // n) // nb
+    xb = jnp.pad(p.ravel(), (0, total - size)).reshape(n, nb, blk)
+
+    # Stage 1: quantize the whole local gradient; exchange chunks so rank i
+    # ends up with every rank's chunk i (the reduce-scatter stage, realized
+    # as an all_to_all of int8 payload + f32 scales — overflow-safe).
+    q1, s1 = _quantize(xb, mode)
+    q_t = jax.lax.all_to_all(q1, axis_name, split_axis=0, concat_axis=0)
+    s_t = jax.lax.all_to_all(s1, axis_name, split_axis=0, concat_axis=0)
+
+    # Stage 2: accumulate the owned chunk in f32, re-quantize, all-gather.
+    owned = jnp.sum(_dequantize(q_t, s_t), axis=0)          # (nb, blk) f32
+    q2, s2 = _quantize(owned, mode)
+    qg = jax.lax.all_gather(q2, axis_name)                   # (n, nb, blk)
+    sg = jax.lax.all_gather(s2, axis_name)                   # (n, nb)
+    summed = _dequantize(qg, sg).reshape(total)[:size].reshape(shape)
+
+    r_new = None
+    if r is not None:
+        # Stage-1 error is local; the owner also folds in its chunk's
+        # stage-2 (re-quantization) error, so the residuals summed over
+        # ranks equal exactly (true sum - wire sum): perfect telescoping.
+        e1 = xb - _dequantize(q1, s1)
+        e2 = owned - _dequantize(q2, s2)
+        own = jax.lax.dynamic_slice(e1, (idx, 0, 0), (1, nb, blk))
+        e1 = jax.lax.dynamic_update_slice(e1, own + e2[None], (idx, 0, 0))
+        r_new = e1.reshape(total)[:size].reshape((1,) + shape)
+    return summed, r_new
+
+
+def compressed_psum(grads: Pytree, residual: Pytree, axis_name: str,
+                    mode: str = "int8",
+                    block: int = DEFAULT_BLOCK) -> Tuple[Pytree, Pytree]:
+    """Quantized all-reduce of a gradient pytree inside ``shard_map``.
+
+    Wire cost per leaf vs an f32 psum (ring conventions, n ranks,
+    L elements): f32 moves ``2(n-1)/n * 4L`` bytes; this moves
+    ``2(n-1)/n * (L + 4L/block)`` — a ~3.9x reduction at block=256.
+    Accumulation is f32 throughout; only the wire is narrow.
+    """
+    if mode not in QUANTIZED_MODES:
+        raise ValueError(f"compressed_psum: mode must be one of "
+                         f"{QUANTIZED_MODES}, got {mode!r}")
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    use_ef = _has_leaves(residual)
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    r_leaves = (jax.tree_util.tree_leaves(residual) if use_ef
+                else [None] * len(g_leaves))
+    if use_ef and len(r_leaves) != len(g_leaves):
+        raise ValueError("residual tree does not match the gradient tree")
+    out_g, out_r = [], []
+    for g, r in zip(g_leaves, r_leaves):
+        summed, r_new = _compressed_leaf(g, r, axis_name, n, idx, mode, block)
+        out_g.append(summed)
+        out_r.append(r_new)
+    synced = jax.tree_util.tree_unflatten(treedef, out_g)
+    new_res = (jax.tree_util.tree_unflatten(treedef, out_r) if use_ef
+               else residual)
+    return synced, new_res

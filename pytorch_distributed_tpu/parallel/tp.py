@@ -74,17 +74,25 @@ def replicated_like(tree: Pytree) -> Pytree:
     return jax.tree_util.tree_map(lambda _: P(), tree)
 
 
-def state_specs(param_specs: Pytree):
+def state_specs(param_specs: Pytree, residual: bool = False):
     """TrainState-shaped PartitionSpec tree: params and momentum share
     ``param_specs``; step and (empty) batch_stats are replicated.  The single
     source for jit in_shardings and device placement — keep them identical
-    or XLA silently reshards every step."""
+    or XLA silently reshards every step.
+
+    ``residual=True``: the state carries error-feedback residuals for
+    quantized gradient sync (ops/qcomm.py) — param-shaped under the GSPMD
+    emulation, so they shard exactly like the params."""
     from pytorch_distributed_tpu.train.state import TrainState
 
     return TrainState(step=P(), params=param_specs, batch_stats={},
-                      momentum=param_specs)
+                      momentum=param_specs,
+                      residual=param_specs if residual else {})
 
 
 def shard_state(state, param_specs: Pytree, mesh: Mesh):
     """Place a TrainState on ``mesh`` per ``state_specs(param_specs)``."""
-    return shard_pytree(state, state_specs(param_specs), mesh)
+    specs = state_specs(
+        param_specs,
+        residual=bool(jax.tree_util.tree_leaves(state.residual)))
+    return shard_pytree(state, specs, mesh)

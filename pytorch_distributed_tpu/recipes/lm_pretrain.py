@@ -123,6 +123,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "at the pipeline boundary, grads reduce-scatter "
                         "back)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
+    p.add_argument("--grad-compress", choices=("none", "bf16", "int8", "fp8"),
+                   default="none", dest="grad_compress",
+                   help="gradient-sync compression (ops/qcomm.py): bf16 "
+                        "round-trip cast, or int8/fp8 block quantization "
+                        "with error feedback.  The LM step is GSPMD, so "
+                        "quantized modes run as a numerics emulation "
+                        "(wire bytes unchanged; convergence effects real)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
     p.add_argument("--checkpoint-dir", type=str, default=None)
@@ -439,6 +446,7 @@ def main(argv=None) -> float:
             ft_check_every=args.ft_check_every,
             ft_lr_backoff=args.ft_lr_backoff,
             preempt=guard,
+            grad_compress=args.grad_compress,
         )
         try:
             final_loss = trainer.fit(args.steps, print_freq=args.print_freq)

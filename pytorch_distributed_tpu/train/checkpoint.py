@@ -154,6 +154,12 @@ def _save_orbax(
         "batch_stats": state.batch_stats,
         "momentum": state.momentum,
     }
+    has_residual = bool(jax.tree_util.tree_leaves(state.residual))
+    if has_residual:
+        # Error-feedback residuals (quantized grad sync, ops/qcomm.py) —
+        # only written when carried, so uncompressed runs keep the legacy
+        # payload layout.
+        tree["residual"] = state.residual
     mgr.save(
         int(epoch),
         args=ocp.args.Composite(
@@ -161,6 +167,7 @@ def _save_orbax(
             meta=ocp.args.JsonSave(
                 {"epoch": int(epoch), "arch": arch,
                  "best_acc1": float(best_acc1), "is_best": bool(is_best),
+                 "has_residual": has_residual,
                  "ft": _ft_record(ft)}
             ),
         ),
@@ -202,6 +209,16 @@ def _load_orbax(path: str, state_template: TrainState):
         "batch_stats": state_template.batch_stats,
         "momentum": state_template.momentum,
     }
+    # The residual is only restorable when both sides carry it (same
+    # compression mode); otherwise the template's (possibly zero) residuals
+    # stand — a mode switch across resume resets error feedback, it does
+    # not fail the load.  The saved meta's has_residual flag (absent on
+    # legacy checkpoints) says which payload layout is on disk.
+    want_residual = bool(jax.tree_util.tree_leaves(state_template.residual))
+    pre_meta = mgr.restore(
+        step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
+    if want_residual and pre_meta.get("has_residual"):
+        template["residual"] = state_template.residual
     restored = mgr.restore(
         step,
         args=ocp.args.Composite(
@@ -215,6 +232,7 @@ def _load_orbax(path: str, state_template: TrainState):
         params=st["params"],
         batch_stats=st["batch_stats"],
         momentum=st["momentum"],
+        residual=st.get("residual", state_template.residual),
     )
     meta = {k: restored["meta"][k] for k in ("epoch", "arch", "best_acc1")}
     meta["ft"] = _ft_record(restored["meta"].get("ft"))
@@ -259,15 +277,17 @@ def save_checkpoint(
                            metric=metric, ft=ft)
     if backend != "msgpack":
         raise ValueError(f"unknown checkpoint backend '{backend}'")
-    host_state = _to_host(
-        {
-            "step": state.step,
-            "params": state.params,
-            "batch_stats": state.batch_stats,
-            "momentum": state.momentum,
-        },
-        want_value=is_primary,
-    )
+    host_tree = {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "momentum": state.momentum,
+    }
+    if jax.tree_util.tree_leaves(state.residual):
+        # Error-feedback residuals (quantized grad sync, ops/qcomm.py);
+        # omitted when empty so uncompressed runs keep the legacy layout.
+        host_tree["residual"] = state.residual
+    host_state = _to_host(host_tree, want_value=is_primary)
     if not is_primary:
         return None
     payload = {
@@ -329,15 +349,26 @@ def _load_msgpack(
         # from_state_dict (not from_bytes-with-template): tolerates the
         # pre-FT payload layout — a missing 'ft' key defaults instead of
         # failing the whole-template key match.
-        st = serialization.from_state_dict(
-            {
-                "step": state_template.step,
-                "params": state_template.params,
-                "batch_stats": state_template.batch_stats,
-                "momentum": state_template.momentum,
-            },
-            tree["state"],
-        )
+        template = {
+            "step": state_template.step,
+            "params": state_template.params,
+            "batch_stats": state_template.batch_stats,
+            "momentum": state_template.momentum,
+        }
+        saved = dict(tree["state"])
+        saved_res = saved.pop("residual", None)
+        t_res = serialization.to_state_dict(state_template.residual)
+        if t_res:
+            # This run carries error-feedback residuals: restore the saved
+            # ones when they exist with matching shapes (same compression
+            # mode and mesh), else start from the template's zeros — a mode
+            # or mesh switch resets error feedback, it never fails resume.
+            template["residual"] = state_template.residual
+            same_shape = saved_res is not None and [
+                np.shape(x) for x in jax.tree_util.tree_leaves(saved_res)
+            ] == [np.shape(x) for x in jax.tree_util.tree_leaves(t_res)]
+            saved["residual"] = saved_res if same_shape else t_res
+        st = serialization.from_state_dict(template, saved)
         meta = {
             "epoch": int(tree["epoch"]),
             "arch": str(tree["arch"]),
@@ -358,6 +389,7 @@ def _load_msgpack(
         params=st["params"],
         batch_stats=st["batch_stats"],
         momentum=st["momentum"],
+        residual=st.get("residual", {}),
     )
     return state, meta
 

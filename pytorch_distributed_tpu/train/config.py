@@ -53,6 +53,11 @@ class Config:
     synthetic: bool = False
     synthetic_length: int = 1280
     wire: str = "f32"
+    # Gradient wire format for the DP sync (ops/qcomm.py): bf16 casts the
+    # psum operand (the old wire_dtype knob); int8/fp8 run the per-block
+    # quantized all-reduce with error feedback.  None = "recipe decides"
+    # (horovod defaults to bf16), mirroring the precision convention.
+    grad_compress: Optional[str] = None
     accum_steps: int = 1
     local_rank: int = -1  # launch-line parity only; unused on TPU
     image_size: int = 224
@@ -190,6 +195,15 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "flip+normalize; u8 = uint8 over the wire, normalize on "
                    "device (4x fewer host->device bytes); native = C++ JPEG "
                    "decode+crop+resize AND uint8 wire (full native path)")
+    p.add_argument("--grad-compress", default=d.grad_compress,
+                   choices=("none", "bf16", "int8", "fp8"),
+                   dest="grad_compress",
+                   help="gradient wire format for the DP sync: bf16 casts "
+                   "the all-reduce operand (Horovod fp16-compression "
+                   "analogue); int8/fp8 = per-block quantized all-reduce "
+                   "with error feedback (ops/qcomm.py) — true wire "
+                   "compression on the explicit-collectives step, numerics "
+                   "emulation under GSPMD; unset = recipe default")
     p.add_argument("--resume", default=d.resume, type=str, metavar="PATH",
                    help="path to checkpoint to resume from")
     p.add_argument("--checkpoint-dir", default=d.checkpoint_dir, type=str,

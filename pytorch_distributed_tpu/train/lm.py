@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pytorch_distributed_tpu.ops import cross_entropy
+from pytorch_distributed_tpu.ops import cross_entropy, qcomm
 from pytorch_distributed_tpu.train.meters import StepMeters
 from pytorch_distributed_tpu.train.optim import sgd_init, sgd_update
 from pytorch_distributed_tpu.train.state import TrainState
@@ -209,6 +209,7 @@ def make_lm_train_step(
     fused_ce_mode: str = "auto",
     log_norms: bool = False,
     guard_nonfinite: bool = False,
+    grad_compress: Optional[str] = None,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
@@ -236,8 +237,25 @@ def make_lm_train_step(
     ``guard_nonfinite``: gate the whole update on an in-graph
     loss/grad-norm finiteness check and emit the ``nonfinite`` flag as a
     lazy metric — the divergence guard's detection half (train/steps.py
-    ``nonfinite_flag``/``gate_update``; policy in ft/divergence.py)."""
+    ``nonfinite_flag``/``gate_update``; policy in ft/divergence.py).
+
+    ``grad_compress``: gradient-sync compression mode (ops/qcomm.py,
+    ``none | bf16 | int8 | fp8``).  The LM step is pure GSPMD — XLA owns
+    the gradient psum — so quantized modes run as a *numerics emulation*
+    (fake-quantize + error feedback applied to the already-synced global
+    gradient; wire bytes unchanged).  True wire compression lives in the
+    explicit-collectives image path (train/steps.py)."""
     manual = getattr(model, "has_manual_grads", lambda: False)()
+    gc_mode, gc_cast = qcomm.resolve_mode(grad_compress, None)
+    if gc_mode != "none":
+        import warnings
+
+        warnings.warn(
+            f"make_lm_train_step: grad_compress={gc_mode!r} under GSPMD is "
+            "a NUMERICS emulation only — the gradient psum stays f32 on the "
+            "wire (XLA owns the collective). Use the explicit-collectives "
+            "image path for true wire compression.",
+            UserWarning, stacklevel=2)
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if accum_steps > 1 and manual:
@@ -380,6 +398,16 @@ def make_lm_train_step(
                     lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
                     grads,
                 )
+        new_residual = state.residual
+        if gc_mode in qcomm.QUANTIZED_MODES:
+            # GSPMD numerics emulation: fake-quantize the (already synced)
+            # global gradient with error feedback — see module warning.
+            with jax.named_scope("grad_sync"):
+                grads, new_residual = qcomm.compress_emulated(
+                    grads, state.residual, gc_mode)
+        elif gc_cast is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(gc_cast).astype(jnp.float32), grads)
         with jax.named_scope("optimizer"):
             new_params, new_momentum = sgd_update(
                 grads, state.momentum, state.params, lr,
@@ -390,9 +418,10 @@ def make_lm_train_step(
             bad = nonfinite_flag(loss, gnorm)
             new_params = gate_update(bad, state.params, new_params)
             new_momentum = gate_update(bad, state.momentum, new_momentum)
+            new_residual = gate_update(bad, state.residual, new_residual)
             metrics["nonfinite"] = bad
         new_state = TrainState(state.step + 1, new_params, state.batch_stats,
-                               new_momentum)
+                               new_momentum, new_residual)
         if log_norms:
             metrics["grad_norm"] = gnorm
             metrics["param_norm"] = tree_l2_norm(new_params)
@@ -401,7 +430,8 @@ def make_lm_train_step(
     from pytorch_distributed_tpu.parallel.tp import state_specs
 
     state_shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), state_specs(param_specs)
+        lambda s: NamedSharding(mesh, s),
+        state_specs(param_specs, residual=gc_mode in qcomm.QUANTIZED_MODES),
     )
     token_sharding = NamedSharding(mesh, P(data_axis, None))
     return jax.jit(
@@ -413,12 +443,15 @@ def make_lm_train_step(
     )
 
 
-def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data"):
+def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data",
+                      has_residual: bool = False):
     """Jitted held-out eval step returning exact token-weighted *sums*
     (loss·count, correct, count) — the LM counterpart of the image harness's
     ``make_eval_step`` (reference validate() pattern,
     reference distributed.py:279-324): aggregation is exact on the host,
-    reductions live inside the compiled program."""
+    reductions live inside the compiled program.  ``has_residual``: the
+    caller's TrainState carries error-feedback residuals (quantized
+    ``grad_compress``), so in_shardings must cover that subtree too."""
 
     def step(state: TrainState, tokens: jnp.ndarray):
         # mutable=["losses"]: MoE models sow the router aux loss even in
@@ -438,7 +471,8 @@ def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data"):
     from pytorch_distributed_tpu.parallel.tp import state_specs
 
     state_shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), state_specs(param_specs)
+        lambda s: NamedSharding(mesh, s),
+        state_specs(param_specs, residual=has_residual)
     )
     token_sharding = NamedSharding(mesh, P(data_axis, None))
     return jax.jit(
@@ -489,6 +523,7 @@ class LMTrainer:
         ft_check_every: int = 10,
         ft_lr_backoff: float = 0.5,
         chaos=None,
+        grad_compress: Optional[str] = None,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -523,7 +558,9 @@ class LMTrainer:
         ``ft_check_every``, ``ft_lr_backoff`` — see
         ``ft.divergence.DivergenceGuard``); ``chaos``: an optional
         ``ft.chaos`` injector schedule driven once per loop step (tests
-        and drills only)."""
+        and drills only); ``grad_compress``: gradient-sync compression
+        mode (``none | bf16 | int8 | fp8`` — numerics emulation under the
+        LM GSPMD step, see ``make_lm_train_step``)."""
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -547,7 +584,11 @@ class LMTrainer:
         self.param_specs = (
             param_specs if param_specs is not None else replicated_like(params)
         )
-        state = TrainState.create({"params": params}, sgd_init(params))
+        self.grad_compress, _ = qcomm.resolve_mode(grad_compress, None)
+        residual = qcomm.init_residual(params, self.grad_compress,
+                                       explicit=False)
+        state = TrainState.create({"params": params}, sgd_init(params),
+                                  residual=residual)
         self.state = shard_state(state, self.param_specs, mesh)
         self.lr_schedule = lr_schedule
         self.step_fn = make_lm_train_step(model, mesh, self.param_specs,
@@ -558,7 +599,8 @@ class LMTrainer:
                                           # in-graph norms only when a
                                           # metrics sink will consume them
                                           log_norms=bool(metrics_jsonl),
-                                          guard_nonfinite=nan_guard)
+                                          guard_nonfinite=nan_guard,
+                                          grad_compress=self.grad_compress)
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every
@@ -569,7 +611,9 @@ class LMTrainer:
         self._span = None  # this process's batch-row range, computed once
         self._agree = None  # lazy PreemptionAgreement (see utils/preempt.py)
         self._eval_fn = (
-            make_lm_eval_step(model, mesh, self.param_specs)
+            make_lm_eval_step(
+                model, mesh, self.param_specs,
+                has_residual=self.grad_compress in qcomm.QUANTIZED_MODES)
             if eval_dataset is not None
             else None
         )

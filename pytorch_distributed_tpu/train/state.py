@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Any
 
+import dataclasses
+
 import flax.struct
 import jax.numpy as jnp
 
@@ -22,12 +24,20 @@ class TrainState:
     params: Pytree             # f32 master weights
     batch_stats: Pytree        # BatchNorm running mean/var (f32)
     momentum: Pytree           # SGD momentum buffers (f32, params-shaped)
+    # Error-feedback residuals for quantized gradient sync (ops/qcomm.py):
+    # empty for grad_compress none/bf16; params-shaped f32 under GSPMD
+    # emulation; stacked (n_data, *shape) sharded over the data axis under
+    # explicit collectives.  Defaulted so positional construction and old
+    # checkpoints keep working.
+    residual: Pytree = dataclasses.field(default_factory=dict)
 
     @classmethod
-    def create(cls, variables: Pytree, momentum: Pytree) -> "TrainState":
+    def create(cls, variables: Pytree, momentum: Pytree,
+               residual: Pytree = None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=variables["params"],
             batch_stats=variables.get("batch_stats", {}),
             momentum=momentum,
+            residual={} if residual is None else residual,
         )

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-from pytorch_distributed_tpu.ops import cross_entropy, topk_correct
+from pytorch_distributed_tpu.ops import cross_entropy, qcomm, topk_correct
 from pytorch_distributed_tpu.train.optim import sgd_update
 from pytorch_distributed_tpu.train.state import TrainState
 
@@ -101,6 +101,7 @@ def make_train_step(
     weight_decay: float = 1e-4,
     data_axis: str = "data",
     wire_dtype: Optional[jnp.dtype] = None,
+    grad_compress: Optional[str] = None,
     explicit_collectives: bool = False,
     seed: int = 0,
     tx=None,
@@ -116,9 +117,20 @@ def make_train_step(
     - GSPMD (default): shardings in, XLA inserts the gradient all-reduce.
       ≙ DDP's fused bucketed allreduce (reference distributed.py:147-148).
     - ``explicit_collectives=True``: ``shard_map`` over the data axis with a
-      hand-written ``psum`` — the Horovod-analogue; ``wire_dtype=bf16``
+      hand-written ``psum`` — the Horovod-analogue; ``grad_compress="bf16"``
       reproduces fp16 gradient wire compression
-      (horovod_distributed.py:159-164) as bf16-compressed collectives.
+      (horovod_distributed.py:159-164) as bf16-compressed collectives, and
+      ``grad_compress="int8"``/``"fp8"`` goes further: a per-block
+      quantized all-reduce (ops/qcomm.py, the EQuARX decomposition) with
+      DynamiQ-style error feedback — the residual rides in
+      ``TrainState.residual``, stacked over the data axis.
+
+    ``grad_compress``: ``none | bf16 | int8 | fp8`` — the gradient wire
+    format for the DP sync.  Under GSPMD every non-``none`` mode is a
+    NUMERICS emulation only (XLA owns the collective; see the warning);
+    real wire compression requires ``explicit_collectives=True``.  The
+    legacy ``wire_dtype`` argument is a deprecated alias for the ``bf16``
+    mode.
 
     ``accum_steps``: gradient accumulation — the batch is split into that
     many microbatches (strided, so each microbatch stays evenly spread over
@@ -157,17 +169,23 @@ def make_train_step(
     behavior).  Running stats are pmean'd in both so replicas stay consistent.
     """
 
-    def sync_grads(grads, count):
-        # grads arrive as *local weighted sums*; psum then normalize.
+    mode, cast_dtype = qcomm.resolve_mode(grad_compress, wire_dtype)
+
+    def sync_grads(grads, count, residual):
+        # grads arrive as *local weighted sums*; sync then normalize.
         with jax.named_scope("grad_sync"):
-            if wire_dtype is not None:
-                grads = jax.tree_util.tree_map(
-                    lambda g: g.astype(wire_dtype), grads)
-            grads = jax.lax.psum(grads, data_axis)
+            if mode in qcomm.QUANTIZED_MODES:
+                grads, residual = qcomm.compressed_psum(
+                    grads, residual, data_axis, mode=mode)
+            else:
+                if cast_dtype is not None:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g.astype(cast_dtype), grads)
+                grads = jax.lax.psum(grads, data_axis)
             gcount = jax.lax.psum(count, data_axis)
             return jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32) / gcount, grads
-            ), gcount
+            ), gcount, residual
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -182,15 +200,16 @@ def make_train_step(
             "inside the optax transformation.",
             stacklevel=2,
         )
-    if wire_dtype is not None and not explicit_collectives:
+    if mode != "none" and not explicit_collectives:
         import warnings
 
         warnings.warn(
-            "make_train_step: wire_dtype under GSPMD is a NUMERICS emulation "
-            "only — XLA places the gradient all-reduce from the shardings, so "
-            "the cast rounds already-synced values and does not compress the "
-            "collective wire format. Use explicit_collectives=True for true "
-            "bf16-wire gradient sync (the Horovod-compression analogue).",
+            f"make_train_step: grad_compress={mode!r} under GSPMD is a "
+            "NUMERICS emulation only — XLA places the gradient all-reduce "
+            "from the shardings, so the quantize/cast rounds already-synced "
+            "values and does not compress the collective wire format. Use "
+            "explicit_collectives=True for true compressed-wire gradient "
+            "sync (the Horovod-compression analogue).",
             stacklevel=2,
         )
 
@@ -277,7 +296,7 @@ def make_train_step(
         grads, new_stats, (loss_sum, c1, c5, count) = accumulated_grads(
             state.params, state.batch_stats, batch, rng
         )
-        grads, gcount = sync_grads(grads, count)
+        grads, gcount, new_residual = sync_grads(grads, count, state.residual)
         new_params, new_momentum = apply_updates(state, grads, lr)
         # BN running stats: average local EMAs across shards so replicas agree.
         new_stats = jax.lax.pmean(new_stats, data_axis)
@@ -295,12 +314,14 @@ def make_train_step(
             new_params = gate_update(bad, state.params, new_params)
             new_momentum = gate_update(bad, state.momentum, new_momentum)
             new_stats = gate_update(bad, state.batch_stats, new_stats)
+            new_residual = gate_update(bad, state.residual, new_residual)
             metrics["nonfinite"] = bad
         if log_norms:
             metrics["grad_norm"] = gnorm
             metrics["param_norm"] = tree_l2_norm(new_params)
         return (
-            TrainState(state.step + 1, new_params, new_stats, new_momentum),
+            TrainState(state.step + 1, new_params, new_stats, new_momentum,
+                       new_residual),
             metrics,
         )
 
@@ -312,9 +333,14 @@ def make_train_step(
         )
         count = jnp.maximum(count, 1.0)
         grads = jax.tree_util.tree_map(lambda g: g / count, grads)
-        if wire_dtype is not None:
+        new_residual = state.residual
+        if mode in qcomm.QUANTIZED_MODES:
+            with jax.named_scope("grad_sync"):
+                grads, new_residual = qcomm.compress_emulated(
+                    grads, state.residual, mode)
+        elif cast_dtype is not None:
             grads = jax.tree_util.tree_map(
-                lambda g: g.astype(wire_dtype).astype(jnp.float32), grads
+                lambda g: g.astype(cast_dtype).astype(jnp.float32), grads
             )
         new_params, new_momentum = apply_updates(state, grads, lr)
         metrics = {
@@ -329,26 +355,40 @@ def make_train_step(
             new_params = gate_update(bad, state.params, new_params)
             new_momentum = gate_update(bad, state.momentum, new_momentum)
             new_stats = gate_update(bad, state.batch_stats, new_stats)
+            new_residual = gate_update(bad, state.residual, new_residual)
             metrics["nonfinite"] = bad
         if log_norms:
             metrics["grad_norm"] = gnorm
             metrics["param_norm"] = tree_l2_norm(new_params)
         return (
-            TrainState(state.step + 1, new_params, new_stats, new_momentum),
+            TrainState(state.step + 1, new_params, new_stats, new_momentum,
+                       new_residual),
             metrics,
         )
 
     replicated = NamedSharding(mesh, P())
     sharded = NamedSharding(mesh, P(data_axis))
     batch_shardings = {"images": sharded, "labels": sharded, "weights": sharded}
+    # The error-feedback residual of the explicit quantized path is per-rank
+    # state: stacked (n_data, *shape) leaves sharded over the data axis so
+    # each rank owns exactly its slot (a TrainState-shaped prefix tree; the
+    # other fields stay replicated).
+    state_sharding = replicated
+    state_spec = P()
+    if explicit_collectives and mode in qcomm.QUANTIZED_MODES:
+        state_sharding = TrainState(
+            step=replicated, params=replicated, batch_stats=replicated,
+            momentum=replicated, residual=NamedSharding(mesh, P(data_axis)))
+        state_spec = TrainState(step=P(), params=P(), batch_stats=P(),
+                                momentum=P(), residual=P(data_axis))
 
     if explicit_collectives:
         batch_specs = {k: P(data_axis) for k in ("images", "labels", "weights")}
         stepped = shard_map(
             local_step,
             mesh=mesh,
-            in_specs=(P(), batch_specs, P()),
-            out_specs=(P(), P()),
+            in_specs=(state_spec, batch_specs, P()),
+            out_specs=(state_spec, P()),
             check_vma=False,
         )
     else:
@@ -356,8 +396,8 @@ def make_train_step(
 
     return jax.jit(
         stepped,
-        in_shardings=(replicated, batch_shardings, replicated),
-        out_shardings=(replicated, replicated),
+        in_shardings=(state_sharding, batch_shardings, replicated),
+        out_shardings=(state_sharding, replicated),
         donate_argnums=(0,),
     )
 
@@ -366,6 +406,7 @@ def make_eval_step(
     model,
     mesh: Mesh,
     data_axis: str = "data",
+    residual_sharded: bool = False,
 ) -> Callable[[TrainState, Batch], Metrics]:
     """Distributed evaluation step (reference validate(),
     distributed.py:279-324 + the README's distributed-eval chapter).
@@ -373,6 +414,12 @@ def make_eval_step(
     Returns weighted *sums* (loss·w, correct@1, correct@5, count) so the host
     can aggregate exactly over an epoch — the all-reduce lives inside the
     compiled program; no ``barrier()`` + 3 ``all_reduce`` calls per batch.
+
+    ``residual_sharded``: the explicit quantized grad-sync path
+    (``grad_compress=int8|fp8``) carries stacked error-feedback residuals
+    sharded over ``data_axis`` in ``TrainState.residual``; eval never reads
+    them, but the in_shardings must still describe them or pjit rejects the
+    state.
     """
 
     def step(state: TrainState, batch: Batch) -> Metrics:
@@ -383,9 +430,16 @@ def make_eval_step(
 
     replicated = NamedSharding(mesh, P())
     sharded = NamedSharding(mesh, P(data_axis))
+    state_shardings = TrainState(
+        step=replicated,
+        params=replicated,
+        batch_stats=replicated,
+        momentum=replicated,
+        residual=sharded if residual_sharded else replicated,
+    )
     batch_shardings = {"images": sharded, "labels": sharded, "weights": sharded}
     return jax.jit(
         step,
-        in_shardings=(replicated, batch_shardings),
+        in_shardings=(state_shardings, batch_shardings),
         out_shardings=replicated,
     )

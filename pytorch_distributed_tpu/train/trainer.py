@@ -51,6 +51,7 @@ class Trainer:
         ctx: Optional[DistContext] = None,
         explicit_collectives: bool = False,
         wire_dtype=None,
+        grad_compress: Optional[str] = None,
         data_axis: str = "data",
         tx=None,
         preempt=None,
@@ -58,6 +59,11 @@ class Trainer:
     ):
         """``tx``: optional optax GradientTransformation replacing the
         default torch-parity SGD (see train/steps.py docstring).
+
+        ``grad_compress``: gradient wire format for the DP sync
+        (none|bf16|int8|fp8, ops/qcomm.py); falls back to
+        ``cfg.grad_compress``.  The legacy ``wire_dtype`` argument is the
+        deprecated bf16-mode alias.
 
         ``preempt``: optional ``utils.preempt.PreemptionGuard`` (already
         installed) polled between steps; ``fit()`` installs a guard for
@@ -140,6 +146,15 @@ class Trainer:
             cfg.arch, num_classes=cfg.num_classes, dtype=dtype, **extra
         )
 
+        # Resolve the gradient wire format once (kwarg > cfg; wire_dtype is
+        # the deprecated bf16 alias) — the mode decides the error-feedback
+        # residual layout carried in TrainState.
+        from pytorch_distributed_tpu.ops import qcomm
+
+        gc = grad_compress if grad_compress is not None else cfg.grad_compress
+        self.grad_compress, self._grad_cast = qcomm.resolve_mode(
+            gc, wire_dtype)
+
         seed = cfg.seed if cfg.seed is not None else 0
         rng = jax.random.PRNGKey(seed)
         sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
@@ -147,7 +162,11 @@ class Trainer:
         opt0 = tx.init(variables["params"]) if tx is not None else sgd_init(
             variables["params"]
         )
-        self.state = TrainState.create(variables, opt0)
+        residual = qcomm.init_residual(
+            variables["params"], self.grad_compress,
+            explicit=explicit_collectives,
+            n_data=dict(self.mesh.shape)[self.data_axis])
+        self.state = TrainState.create(variables, opt0, residual=residual)
         del variables
 
         if cfg.pretrained:
@@ -222,7 +241,9 @@ class Trainer:
             momentum=cfg.momentum,
             weight_decay=cfg.weight_decay,
             data_axis=data_axis,
-            wire_dtype=wire_dtype,
+            wire_dtype=(self._grad_cast
+                        if self.grad_compress == "bf16" else None),
+            grad_compress=self.grad_compress,
             explicit_collectives=explicit_collectives,
             seed=seed,
             tx=tx,
@@ -233,7 +254,10 @@ class Trainer:
             log_norms=bool(cfg.metrics_jsonl),
             guard_nonfinite=bool(getattr(cfg, "nan_guard", False)),
         )
-        self.eval_step = make_eval_step(self.model, self.mesh, data_axis=data_axis)
+        self.eval_step = make_eval_step(
+            self.model, self.mesh, data_axis=data_axis,
+            residual_sharded=(explicit_collectives
+                              and self.grad_compress in qcomm.QUANTIZED_MODES))
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
         # One observability entry point (obs/): the epoch CSV registers as
         # an epoch sink, a --telemetry-csv sampler registers in fit(), and

@@ -234,6 +234,15 @@ def summarize_comms(records: List[dict], ledger_path: Optional[str] = None,
                                    key=lambda kv: -kv[1]["bytes"]))
             if phases:
                 lines.append(f"    by phase: {phases}")
+            # grad_sync wire encodings: label compressed-collective traffic
+            # by payload dtype (ops/qcomm.py modes) so an accidental f32
+            # fallback is visible in the report, not just in shardlint.
+            enc = lg.phase_wire_encodings("grad_sync")
+            if enc and (len(enc) > 1 or "f32" not in enc):
+                encs = ", ".join(f"{k} {v:.0f}B"
+                                 for k, v in sorted(enc.items(),
+                                                    key=lambda kv: -kv[1]))
+                lines.append(f"    grad_sync encoding: {encs}")
     if len(lines) == 1:
         return []
     return lines
