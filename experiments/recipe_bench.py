@@ -9,6 +9,8 @@ under each of this framework's recipe formulations on one configuration:
 - ``gspmd_f32``      — GSPMD gradient sync, f32 (the `distributed` recipes)
 - ``gspmd_bf16``     — GSPMD, bf16 compute policy (`apex`/`tpu_native` slot)
 - ``explicit_bf16w`` — shard_map + psum with bf16 wire grads (`horovod` slot)
+- ``explicit_bf16_zero`` — the horovod slot + ``--zero wus`` weight-update
+  sharding (ZeRO-1): same wire bytes, 1/N optimizer state per chip
 - ``dataparallel``   — single-process GSPMD (same compiled program: the
   README §3 claim that DP is NOT 3.5× slower here becomes a measured fact)
 
@@ -33,9 +35,10 @@ ARCH = os.environ.get("RECIPE_BENCH_ARCH", "resnet50")
 ITERS = int(os.environ.get("RECIPE_BENCH_ITERS", "20"))
 
 
-def bench_config(name, dtype, explicit, grad_compress):
+def bench_config(name, dtype, explicit, grad_compress, zero="none"):
     from pytorch_distributed_tpu import models
     from pytorch_distributed_tpu.parallel import data_parallel_mesh
+    from pytorch_distributed_tpu.parallel import zero as zero_lib
     from pytorch_distributed_tpu.train.optim import sgd_init
     from pytorch_distributed_tpu.train.state import TrainState
     from pytorch_distributed_tpu.train.steps import make_train_step
@@ -44,9 +47,16 @@ def bench_config(name, dtype, explicit, grad_compress):
     model = models.create_model(ARCH, num_classes=1000, dtype=dtype)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, IMAGE, IMAGE, 3)), train=False)
-    state = TrainState.create(variables, sgd_init(variables["params"]))
+    if zero == "wus" and explicit:
+        momentum0 = zero_lib.init_wus_momentum(
+            variables["params"], mesh.shape["data"],
+            quantized=grad_compress in ("int8", "fp8"))
+    else:
+        momentum0 = sgd_init(variables["params"])
+    state = TrainState.create(variables, momentum0)
     step = make_train_step(model, mesh, explicit_collectives=explicit,
-                           grad_compress=grad_compress)
+                           grad_compress=grad_compress, zero=zero,
+                           params=variables["params"])
     rng = np.random.default_rng(0)
     batch = {
         "images": jnp.asarray(
@@ -73,12 +83,17 @@ def bench_config(name, dtype, explicit, grad_compress):
 
 def main() -> int:
     results = {}
-    for name, dtype, explicit, gc in (
-        ("gspmd_f32", jnp.float32, False, None),
-        ("gspmd_bf16", jnp.bfloat16, False, None),
-        ("explicit_bf16_wire", jnp.bfloat16, True, "bf16"),
+    for name, dtype, explicit, gc, zero in (
+        ("gspmd_f32", jnp.float32, False, None, "none"),
+        ("gspmd_bf16", jnp.bfloat16, False, None, "none"),
+        ("explicit_bf16_wire", jnp.bfloat16, True, "bf16", "none"),
+        # --zero wus on the explicit step: reduce-scatter + sharded update
+        # + delta all-gather; wire-parity with the ring all-reduce, so
+        # step time should match explicit_bf16_wire within noise while
+        # holding 1/N of the optimizer state (experiments/zero_memory.py).
+        ("explicit_bf16_zero", jnp.bfloat16, True, "bf16", "wus"),
     ):
-        results[name] = bench_config(name, dtype, explicit, gc)
+        results[name] = bench_config(name, dtype, explicit, gc, zero)
 
     out = {
         "meta": {

@@ -180,8 +180,15 @@ def analyze_lowering(
     min_replicated_bytes: int = DEFAULT_MIN_REPLICATED_BYTES,
     min_promotion_bytes: int = DEFAULT_MIN_PROMOTION_BYTES,
     min_donation_bytes: int = DEFAULT_MIN_DONATION_BYTES,
+    declared_zero: bool = False,
 ) -> StepReport:
-    """The cheap half: run every detector over an existing Lowering."""
+    """The cheap half: run every detector over an existing Lowering.
+
+    ``declared_zero``: the step claims ``--zero wus`` weight-update
+    sharding (parallel/zero.py), so replicated param-shaped optimizer
+    state is no longer the *declared* layout — the ``replicated-state``
+    info finding promotes to a hard error (the WUS sharding silently
+    fell back to replicated DP)."""
     name, text, closed = low.name, low.text, low.closed
     args, donate = low.args, low.donate
 
@@ -233,15 +240,30 @@ def analyze_lowering(
                         " — shard the carry (the PR-1 fused-CE dE class)"),
                 ))
             elif param_shaped:
-                report.add(Finding(
-                    kind="replicated-state", severity="info",
-                    where=name, bytes=meta["bytes"], shape=dims, dtype=dtype,
-                    message=(
-                        f"param-shaped intermediate ({meta['primitive']} at "
-                        f"{meta['source']}) updated at full size per device "
-                        "— the declared replicated (pure-DP) state layout; "
-                        "standing FSDP/ZeRO opportunity"),
-                ))
+                if declared_zero:
+                    report.add(Finding(
+                        kind="replicated-state", severity="error",
+                        where=name, bytes=meta["bytes"], shape=dims,
+                        dtype=dtype,
+                        message=(
+                            f"param-shaped intermediate ({meta['primitive']}"
+                            f" at {meta['source']}) updated at full size per "
+                            "device under a step declared --zero wus — the "
+                            "weight-update sharding fell back to replicated "
+                            "DP (check the momentum shardings reach the jit "
+                            "in_shardings)"),
+                    ))
+                else:
+                    report.add(Finding(
+                        kind="replicated-state", severity="info",
+                        where=name, bytes=meta["bytes"], shape=dims,
+                        dtype=dtype,
+                        message=(
+                            f"param-shaped intermediate ({meta['primitive']}"
+                            f" at {meta['source']}) updated at full size per "
+                            "device — the declared replicated (pure-DP) "
+                            "state layout; standing FSDP/ZeRO opportunity"),
+                    ))
             else:
                 report.add(Finding(
                     kind="replicated-large-tensor", severity="error",
@@ -449,6 +471,45 @@ def _recipe_train_image(explicit: bool, grad_compress: str = "none"):
     return step, (state, _image_batch(), jnp.float32(0.1)), (0,), mesh
 
 
+def _recipe_train_image_zero(grad_compress: str = "none"):
+    """Explicit-collectives image step under ``--zero wus`` (parallel/
+    zero.py): the hand-written grad allreduce becomes a reduce-scatter +
+    delta all-gather and momentum lives as stacked 1/N chunks."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.ops import qcomm
+    from pytorch_distributed_tpu.parallel import zero as zero_lib
+    from pytorch_distributed_tpu.train.state import TrainState
+    from pytorch_distributed_tpu.train.steps import make_train_step
+
+    mesh = _mesh(("data",), (4,))
+    model = _tiny_image_model()
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8, 8, 3)), train=False)
+    quantized = grad_compress in qcomm.QUANTIZED_MODES
+    residual = qcomm.init_residual(variables["params"], grad_compress,
+                                   explicit=True, n_data=4)
+    state = TrainState.create(
+        variables,
+        zero_lib.init_wus_momentum(variables["params"], 4,
+                                   quantized=quantized),
+        residual=residual)
+    step = make_train_step(model, mesh, explicit_collectives=True,
+                           grad_compress=grad_compress, zero="wus")
+    return step, (state, _image_batch(), jnp.float32(0.1)), (0,), mesh
+
+
+def _recipe_train_lm_zero():
+    """GSPMD LM step with ``zero='wus'``: momentum leaves take fsdp_specs
+    data-axis shardings, XLA derives the weight-update collectives."""
+    import jax.numpy as jnp
+
+    mesh = _mesh(("data",), (4,))
+    _, _, state, tokens, step = _lm_setup(mesh, zero="wus")
+    return step, (state, tokens, jnp.float32(0.1)), (0,), mesh
+
+
 def _recipe_eval_image():
     from pytorch_distributed_tpu.train.steps import make_eval_step
 
@@ -479,6 +540,8 @@ def _lm_setup(mesh, specs=None, **step_kw):
     elif callable(specs):
         specs = specs(params)
     state = TrainState.create({"params": params}, sgd_init(params))
+    if step_kw.get("zero") == "wus":
+        step_kw["params"] = params  # wus sizes its momentum specs from these
     step = make_lm_train_step(model, mesh, specs, **step_kw)
     return model, specs, state, tokens, step
 
@@ -586,6 +649,11 @@ RECIPES: "OrderedDict[str, Callable[[], tuple]]" = OrderedDict([
     # fallback in grad_sync a hard collective-regression error.
     ("train_image_bf16", lambda: _recipe_train_image(True, "bf16")),
     ("train_image_int8", lambda: _recipe_train_image(True, "int8")),
+    # Weight-update sharding (parallel/zero.py): the pinned reduce-scatter
+    # / all-gather budgets make an accidental allreduce fallback (or a
+    # momentum layout regression) a hard collective-regression error.
+    ("train_image_zero", _recipe_train_image_zero),
+    ("train_lm_zero", _recipe_train_lm_zero),
     ("eval_image", _recipe_eval_image),
     ("lm_train_dp", lambda: _recipe_lm_train(None)),
     ("lm_fused_ce_replicated", lambda: _recipe_lm_train("replicated")),
@@ -599,10 +667,17 @@ RECIPES: "OrderedDict[str, Callable[[], tuple]]" = OrderedDict([
 ])
 
 
+# Recipes that declare --zero wus: analyze_recipe promotes their
+# replicated-state finding from info to error (the declared layout IS
+# sharded optimizer state, so a replicated fallback is a regression).
+ZERO_RECIPES = frozenset({"train_image_zero", "train_lm_zero"})
+
+
 def analyze_recipe(name: str, **thresholds) -> StepReport:
     """Analyze one recipe, reusing the session's cached lowering: only the
     first call per step pays the compile; threshold variations re-run just
     the detectors."""
+    thresholds.setdefault("declared_zero", name in ZERO_RECIPES)
     return analyze_lowering(get_lowering(name), **thresholds)
 
 

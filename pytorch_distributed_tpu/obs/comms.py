@@ -130,6 +130,9 @@ class CommLedger:
     step: str
     mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
     entries: List[CommEntry] = dataclasses.field(default_factory=list)
+    # Compiled per-device peak bytes (temp + argument + output from
+    # memory_analysis()); 0.0 = unknown (old ledgers, HLO-text fixtures).
+    peak_hbm_bytes: float = 0.0
 
     @property
     def total_bytes(self) -> int:
@@ -175,11 +178,14 @@ class CommLedger:
 
     def metrics_fields(self) -> Dict[str, float]:
         """The per-step fields the trainers stamp into the metrics JSONL."""
-        return {
+        fields = {
             "model_comm_bytes": float(self.total_bytes),
             "comm_wire_bytes": float(self.total_wire_bytes),
             "collective_count": float(self.count),
         }
+        if self.peak_hbm_bytes:
+            fields["peak_hbm_bytes"] = float(self.peak_hbm_bytes)
+        return fields
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -188,6 +194,7 @@ class CommLedger:
             "total_bytes": self.total_bytes,
             "total_wire_bytes": self.total_wire_bytes,
             "count": self.count,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
             "by_kind": self.by_kind(),
             "by_phase": self.by_phase(),
             "entries": [e.to_dict() for e in self.entries],
@@ -212,15 +219,36 @@ def ledger_from_hlo_text(
                       entries=entries)
 
 
+def compiled_peak_bytes(compiled) -> float:
+    """Per-device compiled peak bytes (temp + argument + output) from a
+    ``Compiled.memory_analysis()`` — 0.0 when the backend exposes none.
+    The same accounting experiments/fused_ce_memory.py and zero_memory.py
+    A/B against; surfaced per step in ``obs_report --diff``."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return 0.0
+    if ma is None:
+        return 0.0
+    total = 0.0
+    for field in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes"):
+        total += float(getattr(ma, field, 0) or 0)
+    return total
+
+
 def ledger_from_jitted(jitted, args: Sequence[Any], *, step: str = "step",
                        mesh=None) -> CommLedger:
     """Lower + compile a jitted step and build its ledger.  NOTE: in jax
     0.4.x the AOT ``.lower().compile()`` path does NOT share the jit call
     cache, so calling this on a step the trainer also executes costs one
     extra compile — the trainers gate it behind an opt-in flag."""
-    text = jitted.lower(*args).compile().as_text()
+    compiled = jitted.lower(*args).compile()
+    text = compiled.as_text()
     mesh_shape = dict(mesh.shape) if mesh is not None else {}
-    return ledger_from_hlo_text(text, step=step, mesh_shape=mesh_shape)
+    ledger = ledger_from_hlo_text(text, step=step, mesh_shape=mesh_shape)
+    ledger.peak_hbm_bytes = compiled_peak_bytes(compiled)
+    return ledger
 
 
 def write_ledgers(path: str, ledgers: Sequence[CommLedger]) -> None:
@@ -239,5 +267,7 @@ def load_ledgers(path: str) -> Dict[str, CommLedger]:
         entries = [CommEntry(**e) for e in d.get("entries", [])]
         out[step] = CommLedger(step=step,
                                mesh_shape=d.get("mesh_shape", {}),
-                               entries=entries)
+                               entries=entries,
+                               peak_hbm_bytes=float(
+                                   d.get("peak_hbm_bytes", 0.0)))
     return out

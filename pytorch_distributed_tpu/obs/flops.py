@@ -430,6 +430,91 @@ def image_comm_bytes_compressed(
         breakdown={"grad_sync": a2a + ag, "scalars": scalars})
 
 
+def image_comm_bytes_zero(
+    leaf_sizes: Sequence[int],
+    dp: int = 4,
+    mode: str = "none",
+    block: Optional[int] = None,
+    metric_scalars: int = 5,
+) -> CommCost:
+    """Explicit-collectives image step under ``--zero wus`` weight-update
+    sharding (parallel/zero.py): the gradient all-reduce splits into a
+    reduce-scatter (grads -> owned 1/N chunk) and an all-gather (parameter
+    delta -> full tree), per leaf.  With ``padded = chunk_layout(size, dp,
+    block)[0]`` and ``e`` the wire element size (4 f32 / 2 bf16):
+
+    - reduce-scatter: ``e * padded/dp`` per-device result bytes per leaf
+    - all-gather:     ``e * padded``   per-device result bytes per leaf
+
+    Wire parity (``zero_wire_parity``): by the EQuARX accounting
+    (obs/comms.py) the pair puts ``2*(dp-1)/dp * e * padded`` on the wire —
+    exactly the ring all-reduce's cost (padding aside), so WUS reclaims
+    (N-1)/N of the optimizer+gradient memory at *equal* wire bytes.
+
+    Quantized modes compose with the qcomm path: stage 1 is the same
+    all-to-all as the compressed all-reduce and the delta all-gather
+    carries the same quantized payload + scales the compressed stage 2
+    would — so the estimate delegates to ``image_comm_bytes_compressed``
+    (identical by-kind totals, different *semantics*: the gather moves
+    lr-scaled deltas, not re-quantized gradient shards)."""
+    from pytorch_distributed_tpu.ops import qcomm
+
+    if dp <= 1:
+        return CommCost(by_kind={}, breakdown={})
+    if mode in qcomm.QUANTIZED_MODES:
+        return image_comm_bytes_compressed(
+            leaf_sizes, dp=dp, mode=mode, block=block,
+            metric_scalars=metric_scalars)
+    elem = 2.0 if mode == "bf16" else 4.0
+    block = qcomm.DEFAULT_BLOCK if block is None else block
+    rs = ag = 0.0
+    for size in leaf_sizes:
+        padded, _ = qcomm.chunk_layout(int(size), dp, block)
+        rs += elem * padded / dp
+        ag += elem * padded
+    scalars = 4.0 * metric_scalars
+    return CommCost(
+        by_kind={"reduce-scatter": rs, "all-gather": ag,
+                 "all-reduce": scalars},
+        breakdown={"grad_sync": rs + ag, "scalars": scalars})
+
+
+def comm_cost_wire_bytes(cost: CommCost, n: int) -> float:
+    """Total wire bytes for an analytic ``CommCost`` under the EQuARX
+    per-device accounting (obs/comms.py ``wire_bytes``) — the common
+    currency for comparing layouts whose *result* bytes differ (an
+    all-reduce returns the full tree, a reduce-scatter returns 1/N)."""
+    from pytorch_distributed_tpu.obs.comms import wire_bytes
+
+    return sum(wire_bytes(kind, b, n) for kind, b in cost.by_kind.items())
+
+
+def zero_wire_parity(leaf_sizes: Sequence[int], dp: int = 4,
+                     mode: str = "none",
+                     block: Optional[int] = None) -> Dict[str, float]:
+    """The WUS free-lunch check: reduce-scatter + all-gather wire bytes vs
+    the one-hop all-reduce for the same gradient tree, same compression
+    mode.  Returns ``{"zero": .., "replicated": .., "ratio": ..}``;
+    ``ratio <= 1 + pad_overhead`` — tests pin it at ~1 (the ring
+    all-reduce IS a reduce-scatter + all-gather, WUS just applies the
+    optimizer between the hops)."""
+    zero = comm_cost_wire_bytes(
+        image_comm_bytes_zero(leaf_sizes, dp=dp, mode=mode, block=block,
+                              metric_scalars=0), dp)
+    if mode == "bf16":
+        repl_cost = image_comm_bytes_compressed(
+            leaf_sizes, dp=dp, mode="bf16", metric_scalars=0)
+    elif mode == "none":
+        repl_cost = image_comm_bytes(sum(int(s) for s in leaf_sizes),
+                                     dp=dp, metric_scalars=0)
+    else:
+        repl_cost = image_comm_bytes_compressed(
+            leaf_sizes, dp=dp, mode=mode, block=block, metric_scalars=0)
+    repl = comm_cost_wire_bytes(repl_cost, dp)
+    return {"zero": zero, "replicated": repl,
+            "ratio": zero / repl if repl else 0.0}
+
+
 def lm_comm_bytes(vocab_size: int, d_model: int, n_layers: int, batch: int,
                   seq_len: int, dp: int = 4, tp: int = 1,
                   fused_ce: bool = False, params: Optional[int] = None,

@@ -286,3 +286,115 @@ def compressed_psum(grads: Pytree, residual: Pytree, axis_name: str,
     new_res = (jax.tree_util.tree_unflatten(treedef, out_r) if use_ef
                else residual)
     return synced, new_res
+
+
+# ------------------------------------- compressed ZeRO collectives (WUS path)
+
+def _rs_leaf(g, r, axis_name, n, idx, mode, block):
+    """Stage 1 of :func:`_compressed_leaf` alone: quantized reduce-scatter.
+
+    Stops at the f32 ``owned`` accumulation — the caller (the weight-update
+    -sharding optimizer, parallel/zero.py) consumes the exact chunk sum
+    directly, so there is no stage-2 re-quantization and no all-gather of
+    gradients at all; the second wire hop of WUS carries the *parameter
+    delta* instead (:func:`compressed_all_gather`, with its own error
+    feedback).  Residual update is therefore stage-1-only: summed over
+    ranks, the residuals equal (true sum - what reached the owners).
+    """
+    shape, size = g.shape, g.size
+    p = g.astype(jnp.float32)
+    if r is not None:
+        p = p + r.reshape(shape)
+    total, nb = chunk_layout(size, n, block)
+    blk = (total // n) // nb
+    xb = jnp.pad(p.ravel(), (0, total - size)).reshape(n, nb, blk)
+    q1, s1 = _quantize(xb, mode)
+    q_t = jax.lax.all_to_all(q1, axis_name, split_axis=0, concat_axis=0)
+    s_t = jax.lax.all_to_all(s1, axis_name, split_axis=0, concat_axis=0)
+    owned = jnp.sum(_dequantize(q_t, s_t), axis=0)          # (nb, blk) f32
+    r_new = None
+    if r is not None:
+        e1 = xb - _dequantize(q1, s1)
+        r_new = e1.reshape(total)[:size].reshape((1,) + shape)
+    return owned.reshape(-1), r_new                          # flat (chunk,)
+
+
+def compressed_reduce_scatter(grads: Pytree, residual: Pytree, axis_name: str,
+                              mode: str = "int8",
+                              block: int = DEFAULT_BLOCK,
+                              ) -> Tuple[Pytree, Pytree]:
+    """Quantized reduce-scatter of a gradient pytree inside ``shard_map``.
+
+    Each rank receives the f32 *sum* of its flat ``chunk_layout`` chunk of
+    every leaf (shape ``(chunk,)``), accumulated from the other ranks'
+    dequantized contributions — half of :func:`compressed_psum`'s wire
+    (the all_to_all hop only), with the same DynamiQ error feedback riding
+    in the stacked residual.
+    """
+    if mode not in QUANTIZED_MODES:
+        raise ValueError(f"compressed_reduce_scatter: mode must be one of "
+                         f"{QUANTIZED_MODES}, got {mode!r}")
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    use_ef = _has_leaves(residual)
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    r_leaves = (jax.tree_util.tree_leaves(residual) if use_ef
+                else [None] * len(g_leaves))
+    if use_ef and len(r_leaves) != len(g_leaves):
+        raise ValueError("residual tree does not match the gradient tree")
+    out_g, out_r = [], []
+    for g, r in zip(g_leaves, r_leaves):
+        owned, r_new = _rs_leaf(g, r, axis_name, n, idx, mode, block)
+        out_g.append(owned)
+        out_r.append(r_new)
+    chunks = jax.tree_util.tree_unflatten(treedef, out_g)
+    new_res = (jax.tree_util.tree_unflatten(treedef, out_r) if use_ef
+               else residual)
+    return chunks, new_res
+
+
+def compressed_all_gather(chunks: Pytree, err: Pytree, axis_name: str,
+                          shaped: Pytree, mode: str = "int8",
+                          block: int = DEFAULT_BLOCK) -> Tuple[Pytree, Pytree]:
+    """Quantized all-gather of per-rank flat chunks back to full leaves.
+
+    ``chunks``: this rank's flat ``(chunk,)`` f32 values per leaf (the
+    WUS parameter-delta).  ``err``: per-rank error-feedback slots of shape
+    ``(1, chunk)`` per leaf (or an empty tree to disable EF) — the wire
+    carries ``q(chunk + err)`` and the new error is what the quantizer
+    dropped, so sub-quantum deltas accumulate across steps instead of
+    vanishing.  ``shaped``: a pytree giving each leaf's target shape (the
+    params).  Every rank dequantizes the same wire payload, so the
+    gathered result — and anything updated from it — stays bit-identical
+    across replicas.
+    """
+    if mode not in QUANTIZED_MODES:
+        raise ValueError(f"compressed_all_gather: mode must be one of "
+                         f"{QUANTIZED_MODES}, got {mode!r}")
+    use_ef = _has_leaves(err)
+    c_leaves, treedef = jax.tree_util.tree_flatten(chunks)
+    p_leaves = jax.tree_util.tree_leaves(shaped)
+    e_leaves = (jax.tree_util.tree_leaves(err) if use_ef
+                else [None] * len(c_leaves))
+    if len(p_leaves) != len(c_leaves) or (use_ef and
+                                          len(e_leaves) != len(c_leaves)):
+        raise ValueError("compressed_all_gather: chunk / shape / error "
+                         "trees do not match")
+    out_f, out_e = [], []
+    for c, e, p in zip(c_leaves, e_leaves, p_leaves):
+        x = c.astype(jnp.float32)
+        if e is not None:
+            x = x + e.reshape(x.shape)
+        xb = x.reshape(-1, min(block, x.size))
+        q, s = _quantize(xb, mode)
+        qg = jax.lax.all_gather(q, axis_name)                # (n, nb, blk)
+        sg = jax.lax.all_gather(s, axis_name)                # (n, nb)
+        full = _dequantize(qg, sg).reshape(-1)[: p.size].reshape(p.shape)
+        out_f.append(full)
+        out_e.append(None if e is None else
+                     (x - _dequantize(q, s).reshape(x.shape)
+                      ).reshape((1,) + x.shape))
+    gathered = jax.tree_util.tree_unflatten(treedef, out_f)
+    new_err = (jax.tree_util.tree_unflatten(treedef, out_e) if use_ef
+               else err)
+    return gathered, new_err

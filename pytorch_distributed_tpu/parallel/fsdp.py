@@ -9,6 +9,12 @@ memory drops by ~the data-axis size; compute is unchanged.
 
 Composes with the ``model`` axis: leaves already sharded by a Megatron spec
 keep it — FSDP takes the largest still-unsharded dim.
+
+The lighter ZeRO-1 point on the same spectrum is ``--zero wus``
+(parallel/zero.py): only the *optimizer* leaves take these fsdp_specs
+shardings (``zero_momentum_specs`` reuses this module), params stay in
+their declared layout — weight-update sharding without the per-use
+parameter all-gathers.
 """
 
 from __future__ import annotations

@@ -74,7 +74,8 @@ def replicated_like(tree: Pytree) -> Pytree:
     return jax.tree_util.tree_map(lambda _: P(), tree)
 
 
-def state_specs(param_specs: Pytree, residual: bool = False):
+def state_specs(param_specs: Pytree, residual: bool = False,
+                momentum_specs: Optional[Pytree] = None):
     """TrainState-shaped PartitionSpec tree: params and momentum share
     ``param_specs``; step and (empty) batch_stats are replicated.  The single
     source for jit in_shardings and device placement — keep them identical
@@ -82,17 +83,26 @@ def state_specs(param_specs: Pytree, residual: bool = False):
 
     ``residual=True``: the state carries error-feedback residuals for
     quantized gradient sync (ops/qcomm.py) — param-shaped under the GSPMD
-    emulation, so they shard exactly like the params."""
+    emulation, so they shard exactly like the params.
+
+    ``momentum_specs``: override the momentum layout — the ``--zero wus``
+    hook (parallel/zero.py ``zero_momentum_specs``): optimizer leaves take
+    data-axis ``fsdp_specs`` shardings while the params keep
+    ``param_specs``, and XLA derives the reduce-scatter/all-gather
+    weight-update pair from the layout mismatch."""
     from pytorch_distributed_tpu.train.state import TrainState
 
     return TrainState(step=P(), params=param_specs, batch_stats={},
-                      momentum=param_specs,
+                      momentum=(param_specs if momentum_specs is None
+                                else momentum_specs),
                       residual=param_specs if residual else {})
 
 
-def shard_state(state, param_specs: Pytree, mesh: Mesh):
+def shard_state(state, param_specs: Pytree, mesh: Mesh,
+                momentum_specs: Optional[Pytree] = None):
     """Place a TrainState on ``mesh`` per ``state_specs(param_specs)``."""
     specs = state_specs(
         param_specs,
-        residual=bool(jax.tree_util.tree_leaves(state.residual)))
+        residual=bool(jax.tree_util.tree_leaves(state.residual)),
+        momentum_specs=momentum_specs)
     return shard_pytree(state, specs, mesh)

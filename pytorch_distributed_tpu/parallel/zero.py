@@ -1,0 +1,241 @@
+"""ZeRO-style weight-update sharding (WUS) over the data axis.
+
+Automatic cross-replica weight-update sharding (arXiv:2004.13336) removes
+the replicated-optimizer-state ceiling of pure data parallelism without
+touching the forward/backward math: instead of all-reducing gradients and
+applying the identical SGD update on every replica,
+
+- **reduce-scatter** the gradients so rank ``i`` owns the exact f32 sum of
+  chunk ``i`` (1/N of every leaf);
+- keep the momentum buffer **sharded**: each rank stores only its chunk,
+  so optimizer state per device drops by ~the data-axis size;
+- apply the torch-parity SGD update on the 1/N chunk;
+- **all-gather** the resulting parameter *delta* once per step and apply
+  it to the (still replicated) parameters on every rank.
+
+Wire cost per step and leaf of L f32 elements on n ranks (ring
+conventions, obs/comms.py): the replicated path's all-reduce moves
+``2(n-1)/n * 4L`` bytes; reduce-scatter ``(n-1)/n * 4L`` plus all-gather
+``(n-1)/n * 4L`` — identical wire, ~(n-1)/n of optimizer+synced-gradient
+bytes reclaimed.  Composes with ``--grad-compress int8|fp8``: both hops
+ride the quantized qcomm path (``compressed_reduce_scatter`` /
+``compressed_all_gather``) with error feedback on each.
+
+Two expressions of the same semantics (mirroring train/steps.py):
+
+- **explicit** (shard_map): this module's chunked helpers — momentum is
+  carried *stacked*, leaf shape ``(n_data, chunk)`` sharded ``P("data")``
+  (the PR-7 residual discipline: each rank reads/writes only its slot);
+- **GSPMD**: a sharding-spec change only — momentum keeps its parameter
+  shape but takes ``fsdp_specs`` shardings while the params stay on their
+  own specs; XLA inserts the reduce-scatter/all-gather pair.
+
+Checkpoint interchange: ``gather_momentum``/``shard_momentum`` convert
+between the stacked-chunk layout and the param-shaped layout every
+checkpoint stores (gather-on-save keeps zero and replicated runs
+restore-compatible in both directions — train/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_tpu.ops import qcomm
+
+Pytree = Any
+
+MODES = ("none", "wus")
+
+
+def resolve_zero(zero: Optional[str]) -> str:
+    """Canonical zero mode from the CLI/config value (None -> ``"none"``)."""
+    mode = zero if zero is not None else "none"
+    if mode not in MODES:
+        raise ValueError(f"zero must be one of {MODES}, got {mode!r}")
+    return mode
+
+
+def chunk_size(size: int, n: int, block: int = qcomm.DEFAULT_BLOCK) -> int:
+    """Per-rank flat chunk length of a ``size``-element leaf (whole blocks,
+    qcomm.chunk_layout padding rules — shared with the wire-byte model)."""
+    total, _ = qcomm.chunk_layout(size, n, block)
+    return total // n
+
+
+def init_wus_momentum(params: Pytree, n_data: int, quantized: bool = False,
+                      block: int = qcomm.DEFAULT_BLOCK) -> Pytree:
+    """Zero-initialized stacked-chunk optimizer state for the explicit path.
+
+    ``{"buf": <tree of (n_data, chunk) f32>}`` — plus an ``"agerr"`` twin
+    when the param-delta all-gather is quantized (error feedback on the
+    second wire hop, so sub-quantum updates accumulate instead of
+    vanishing).  Shard every leaf ``P(data_axis)`` so each rank owns one
+    slot; ``gather_momentum`` restores the param-shaped view.
+    """
+    def chunks(p):
+        return jnp.zeros((n_data, chunk_size(int(np.prod(np.shape(p))),
+                                             n_data, block)), jnp.float32)
+
+    buf = jax.tree_util.tree_map(chunks, params)
+    if quantized:
+        return {"buf": buf, "agerr": jax.tree_util.tree_map(chunks, params)}
+    return {"buf": buf}
+
+
+def is_wus_momentum(momentum: Pytree) -> bool:
+    """True when ``momentum`` carries the stacked-chunk WUS layout (the
+    checkpoint layer keys gather-on-save / shard-on-restore off this)."""
+    return (isinstance(momentum, dict) and "buf" in momentum
+            and set(momentum) <= {"buf", "agerr"})
+
+
+def gather_momentum(momentum: Pytree, params: Pytree) -> Pytree:
+    """Stacked-chunk ``momentum["buf"]`` -> param-shaped host tree.
+
+    Host-side (numpy): runs at checkpoint save so every checkpoint stores
+    the replicated-DP momentum layout regardless of the writer's zero mode
+    (the recipe-interchange invariant).  ``agerr`` is error-feedback state
+    and is deliberately dropped — it restarts at zero on restore, exactly
+    like the qcomm residuals."""
+    def g(b, p):
+        shape = np.shape(p)
+        size = int(np.prod(shape, dtype=np.int64))
+        return np.asarray(b, np.float32).reshape(-1)[:size].reshape(shape)
+
+    return jax.tree_util.tree_map(g, momentum["buf"], params)
+
+
+def shard_momentum(host_momentum: Pytree, template_buf: Pytree) -> Pytree:
+    """Param-shaped momentum -> stacked chunks matching ``template_buf``
+    (the restore-side inverse of :func:`gather_momentum`; padding re-zeros)."""
+    def s(m, t):
+        n, chunk = np.shape(t)
+        flat = np.zeros(n * chunk, np.float32)
+        arr = np.asarray(m, np.float32).reshape(-1)
+        flat[: arr.size] = arr
+        return flat.reshape(n, chunk)
+
+    return jax.tree_util.tree_map(s, host_momentum, template_buf)
+
+
+# ------------------------------------------------------- in-graph (shard_map)
+
+def _own_chunk(p, idx, n, block):
+    """This rank's flat f32 chunk of a replicated param leaf."""
+    total, nb = qcomm.chunk_layout(p.size, n, block)
+    chunk = total // n
+    flat = jnp.pad(p.astype(jnp.float32).ravel(), (0, total - p.size))
+    return jax.lax.dynamic_slice(flat, (idx * chunk,), (chunk,))
+
+
+def reduce_scatter_grads(grads: Pytree, axis_name: str, n: int,
+                         cast_dtype=None,
+                         block: int = qcomm.DEFAULT_BLOCK) -> Pytree:
+    """Per-leaf f32 (or bf16-wire) reduce-scatter: each rank receives the
+    exact sum of its flat chunk.  Padding rides as zeros so the layout
+    matches ``init_wus_momentum`` chunk-for-chunk."""
+    def rs(g):
+        total, _ = qcomm.chunk_layout(g.size, n, block)
+        flat = jnp.pad(g.astype(jnp.float32).ravel(), (0, total - g.size))
+        if cast_dtype is not None:
+            flat = flat.astype(cast_dtype)
+        out = jax.lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                   tiled=True)
+        return out.astype(jnp.float32)
+
+    return jax.tree_util.tree_map(rs, grads)
+
+
+def wus_apply_updates(
+    params: Pytree,
+    momentum: Pytree,
+    grad_chunks: Pytree,
+    lr,
+    idx,
+    n: int,
+    axis_name: str,
+    momentum_coef: float = 0.9,
+    weight_decay: float = 1e-4,
+    mode: str = "none",
+    cast_dtype=None,
+    block: int = qcomm.DEFAULT_BLOCK,
+) -> Tuple[Pytree, Pytree]:
+    """The 1/N-shard weight update + param all-gather (runs per-rank).
+
+    Torch-parity SGD (train/optim.py ``_upd``) on this rank's flat chunk:
+    ``g += wd*p; buf = mu*buf + g; delta = lr*buf`` — then the *delta*
+    chunks are all-gathered (f32, bf16 wire, or the quantized qcomm path
+    with error feedback in ``momentum["agerr"]``) and applied to the
+    replicated params on every rank, so replicas stay bit-identical.
+
+    Returns ``(new_params, new_momentum)`` with momentum in the stacked
+    layout (``(1, chunk)`` per-rank slots inside shard_map).
+    """
+    buf = momentum["buf"]
+    agerr = momentum.get("agerr")
+
+    p_leaves, treedef = jax.tree_util.tree_flatten(params)
+    b_leaves = jax.tree_util.tree_leaves(buf)
+    g_leaves = jax.tree_util.tree_leaves(grad_chunks)
+    if not (len(p_leaves) == len(b_leaves) == len(g_leaves)):
+        raise ValueError("wus_apply_updates: params / momentum['buf'] / "
+                         "grad chunk trees do not match")
+
+    deltas, new_buf = [], []
+    for p, b, g in zip(p_leaves, b_leaves, g_leaves):
+        pc = _own_chunk(p, idx, n, block)
+        b0 = b.reshape(pc.shape)
+        g = g.reshape(pc.shape) + weight_decay * pc
+        b1 = momentum_coef * b0 + g
+        deltas.append(lr * b1)
+        new_buf.append(b1.reshape(b.shape))
+    delta_tree = jax.tree_util.tree_unflatten(treedef, deltas)
+
+    new_momentum = {"buf": jax.tree_util.tree_unflatten(treedef, new_buf)}
+    if mode in qcomm.QUANTIZED_MODES:
+        full, new_agerr = qcomm.compressed_all_gather(
+            delta_tree, agerr, axis_name, params, mode=mode, block=block)
+        new_momentum["agerr"] = new_agerr
+    else:
+        def ag(d, p):
+            wire = d if cast_dtype is None else d.astype(cast_dtype)
+            flat = jax.lax.all_gather(wire, axis_name).astype(
+                jnp.float32).reshape(-1)
+            return flat[: p.size].reshape(p.shape)
+
+        full = jax.tree_util.tree_map(ag, delta_tree, params)
+        if agerr is not None:
+            new_momentum["agerr"] = agerr
+
+    new_params = jax.tree_util.tree_map(
+        lambda p, d: (p.astype(jnp.float32) - d).astype(p.dtype),
+        params, full)
+    return new_params, new_momentum
+
+
+def chunk_sq_sum(tree: Pytree) -> jnp.ndarray:
+    """Sum of squares over a chunk tree — one rank's contribution to the
+    global grad norm (chunks are disjoint, so a psum of these IS the
+    global sum of squares; the replicated-path shortcut of reading the
+    norm off the synced gradient does not exist under reduce-scatter)."""
+    return sum(jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+# -------------------------------------------------------------- GSPMD layout
+
+def zero_momentum_specs(params: Pytree, mesh, data_axis: str = "data",
+                        base_specs: Pytree = None) -> Pytree:
+    """Momentum PartitionSpecs for the GSPMD expression of WUS: every
+    optimizer leaf takes its ``fsdp_specs`` sharding while the params keep
+    ``base_specs`` (or stay replicated) — the update math is unchanged and
+    XLA inserts the reduce-scatter (grads -> sharded buf) and all-gather
+    (buf -> replicated param delta) from the layout alone."""
+    from pytorch_distributed_tpu.parallel.fsdp import fsdp_specs
+
+    return fsdp_specs(params, mesh, data_axis=data_axis,
+                      base_specs=base_specs)

@@ -29,6 +29,7 @@ def run_recipe(
     explicit_collectives: bool = False,
     wire_dtype=None,
     grad_compress_default: Optional[str] = None,
+    zero_default: Optional[str] = None,
     epoch_csv_default: Optional[str] = None,
     bootstrap: bool = True,
 ) -> float:
@@ -38,6 +39,8 @@ def run_recipe(
         cfg.precision = precision_default or "fp32"
     if cfg.grad_compress is None:  # explicit --grad-compress always wins
         cfg.grad_compress = grad_compress_default
+    if cfg.zero is None:  # explicit --zero always wins
+        cfg.zero = zero_default
     if epoch_csv_default is not None and cfg.epoch_csv is None:
         cfg.epoch_csv = epoch_csv_default
     ctx = initialize() if bootstrap else DistContext(0, 1, None)

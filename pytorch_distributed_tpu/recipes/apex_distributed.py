@@ -12,7 +12,10 @@ MXU, loss and BN statistics accumulate f32 (models/resnet.py).  The
 prefetcher's copy/compute overlap is the DeviceFeeder's background async
 transfers (data/loader.py).  The reference's double-normalize quirk
 (SURVEY.md §7.5: transform Normalize *and* GPU-side sub_/div_ with 0-255
-constants) is documented, not replicated.
+constants) is documented, not replicated.  ``--zero wus`` additionally
+shards the f32 optimizer state 1/N over the data axis (parallel/zero.py) —
+under bf16 compute the f32 momentum masters are exactly the bytes worth
+sharding first.
 """
 
 from pytorch_distributed_tpu.recipes._common import run_recipe

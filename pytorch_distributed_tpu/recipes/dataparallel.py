@@ -10,6 +10,11 @@ scatter/gathers through GPU0 each step (the reference's own docs call it
 a local ``data`` mesh is *already* fully parallel: no master device, no
 gather bottleneck, same step math as every other recipe.  The per-epoch CSV
 (dataparallel.py:188,205-213) is on by default, same file name.
+
+``--zero wus`` lifts the replicated-optimizer ceiling (parallel/zero.py):
+momentum takes fsdp_specs shardings under this GSPMD step and XLA inserts
+the reduce-scatter/all-gather weight-update pair — 1/N optimizer bytes per
+chip, identical numerics.
 """
 
 from pytorch_distributed_tpu.recipes._common import run_recipe

@@ -9,7 +9,11 @@ TPU-native delta: the launcher contract is environment variables
 ``env://`` analogue), consumed by ``jax.distributed.initialize``; on a TPU
 pod the runtime metadata supplies them and no launcher is needed at all.
 Gradient sync is GSPMD: XLA fuses the allreduce into the step program where
-DDP hooks it onto backward (distributed.py:147-148).
+DDP hooks it onto backward (distributed.py:147-148).  ``--zero wus`` shards
+the optimizer state 1/N over the data axis (parallel/zero.py — the
+sharding-spec expression of weight-update sharding; ZeRO-1 ≙ torch's
+ZeroRedundancyOptimizer, which DDP users bolt on for exactly this memory
+ceiling).
 """
 
 from pytorch_distributed_tpu.recipes._common import run_recipe

@@ -15,7 +15,11 @@ distributed_slurm_main.py:154), metrics are globally reduced (the reference
 prints per-rank metrics, :272-275), and only rank 0 checkpoints (the
 reference races, :237-243).  Across slices the mesh's data axis spans DCN;
 within a slice, ICI.  ``--dist-file`` is accepted for launch-line parity but
-unused.  Per-epoch CSV on by default, same name (:209).
+unused.  Per-epoch CSV on by default, same name (:209).  At multi-slice
+scale ``--zero wus`` (parallel/zero.py) matters most: optimizer state
+shards 1/N across the full data axis while checkpoints keep the replicated
+param-shaped layout, so a 2-slice run restores a 1-slice checkpoint and
+vice versa.
 """
 
 from pytorch_distributed_tpu.recipes._common import run_recipe

@@ -17,6 +17,13 @@ cutting grad wire bytes ~4x vs f32.  Parameter broadcast
 ≙ params born replicated on the mesh; the allreduce-doubles-as-barrier trick
 is moot — XLA steps are bulk-synchronous.  BatchNorm is per-shard (local),
 exactly like the GPU original's unsynced BN (see train/steps.py docstring).
+
+``--zero wus`` upgrades this explicit step to weight-update sharding
+(parallel/zero.py): the grad allreduce becomes a hand-written
+reduce-scatter, momentum lives as sharded 1/N chunks, and the parameter
+delta is all-gathered once per step — and it composes with
+``--grad-compress int8``, putting *both* wire hops on the quantized qcomm
+path with error feedback (the recommended DP configuration, TUTORIAL §4).
 """
 
 from pytorch_distributed_tpu.recipes._common import run_recipe

@@ -123,6 +123,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "at the pipeline boundary, grads reduce-scatter "
                         "back)")
     p.add_argument("--precision", choices=("fp32", "bf16"), default="bf16")
+    p.add_argument("--zero", choices=("none", "wus"), default="none",
+                   help="ZeRO-style weight-update sharding (parallel/"
+                        "zero.py): 'wus' gives momentum leaves fsdp_specs "
+                        "data-axis shardings (composed over the --tp/--pp "
+                        "layout) while params stay in their declared "
+                        "layout — 1/N optimizer bytes per device, same "
+                        "numerics and checkpoint format.  Lighter than "
+                        "--fsdp (which also shards the params; that is the "
+                        "ZeRO-3 layout, this is ZeRO-1)")
     p.add_argument("--grad-compress", choices=("none", "bf16", "int8", "fp8"),
                    default="none", dest="grad_compress",
                    help="gradient-sync compression (ops/qcomm.py): bf16 "
@@ -447,6 +456,7 @@ def main(argv=None) -> float:
             ft_lr_backoff=args.ft_lr_backoff,
             preempt=guard,
             grad_compress=args.grad_compress,
+            zero=args.zero,
         )
         try:
             final_loss = trainer.fit(args.steps, print_freq=args.print_freq)

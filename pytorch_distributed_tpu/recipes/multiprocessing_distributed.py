@@ -10,7 +10,9 @@ into the runtime — this recipe is the self-contained shape: plain
 ``python -m``, explicit coordinator default (127.0.0.1, the reference's TCP
 address analogue) when ``PTD_TPU_NUM_PROCESSES`` asks for more than one
 process, else single-process over all local chips.  This is the minimum
-end-to-end slice of SURVEY.md §7.3.
+end-to-end slice of SURVEY.md §7.3.  Accepts ``--zero wus`` like every
+recipe (parallel/zero.py weight-update sharding; Trainer threads it from
+the shared Config).
 """
 
 import os

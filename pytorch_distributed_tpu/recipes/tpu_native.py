@@ -4,7 +4,10 @@
 Everything on: bf16 compute policy, GSPMD gradient sync fused into the step,
 sharded exact-masked evaluation, double-buffered device feeding, rank-0
 checkpointing with resume, epoch CSV.  On a pod this same entry point spans
-hosts via TPU runtime metadata with zero launcher ceremony.
+hosts via TPU runtime metadata with zero launcher ceremony.  ``--zero wus``
+(parallel/zero.py) drops per-chip optimizer bytes to 1/N via fsdp_specs
+momentum shardings; checkpoints stay interchangeable with every other
+recipe (gather-on-save).
 """
 
 from pytorch_distributed_tpu.recipes._common import run_recipe

@@ -43,6 +43,7 @@ from pytorch_distributed_tpu.ft.integrity import (
     verify_sidecar,
     write_sidecar,
 )
+from pytorch_distributed_tpu.parallel import zero as zero_lib
 from pytorch_distributed_tpu.train.state import TrainState
 
 CHECKPOINT_NAME = "checkpoint.msgpack"
@@ -148,11 +149,19 @@ def _save_orbax(
     import orbax.checkpoint as ocp
 
     mgr = _orbax_manager(directory)
+    momentum = state.momentum
+    if zero_lib.is_wus_momentum(momentum):
+        # Gather-on-save (weight-update sharding): checkpoints always store
+        # the param-shaped replicated momentum layout so any recipe/mode can
+        # restore any checkpoint.  All ranks gather (collective); the
+        # error-feedback agerr is resettable state and is dropped.
+        host = _to_host({"m": momentum, "p": state.params})
+        momentum = zero_lib.gather_momentum(host["m"], host["p"])
     tree = {
         "step": state.step,
         "params": state.params,
         "batch_stats": state.batch_stats,
-        "momentum": state.momentum,
+        "momentum": momentum,
     }
     has_residual = bool(jax.tree_util.tree_leaves(state.residual))
     if has_residual:
@@ -203,11 +212,17 @@ def _load_orbax(path: str, state_template: TrainState):
         step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no orbax checkpoints under '{root}'")
+    wus = zero_lib.is_wus_momentum(state_template.momentum)
     template = {
         "step": state_template.step,
         "params": state_template.params,
         "batch_stats": state_template.batch_stats,
-        "momentum": state_template.momentum,
+        # Disk always holds the param-shaped momentum (gather-on-save
+        # invariant), so a --zero wus template restores against a
+        # param-shaped stand-in and re-chunks below.
+        "momentum": (jax.tree_util.tree_map(
+            lambda p: np.zeros(np.shape(p), np.float32),
+            state_template.params) if wus else state_template.momentum),
     }
     # The residual is only restorable when both sides carry it (same
     # compression mode); otherwise the template's (possibly zero) residuals
@@ -227,11 +242,18 @@ def _load_orbax(path: str, state_template: TrainState):
         ),
     )
     st = restored["state"]
+    momentum = st["momentum"]
+    if wus:
+        buf = zero_lib.shard_momentum(momentum,
+                                      state_template.momentum["buf"])
+        momentum = {"buf": buf}
+        if "agerr" in state_template.momentum:
+            momentum["agerr"] = jax.tree_util.tree_map(np.zeros_like, buf)
     state = TrainState(
         step=st["step"],
         params=st["params"],
         batch_stats=st["batch_stats"],
-        momentum=st["momentum"],
+        momentum=momentum,
         residual=st.get("residual", state_template.residual),
     )
     meta = {k: restored["meta"][k] for k in ("epoch", "arch", "best_acc1")}
@@ -290,6 +312,15 @@ def save_checkpoint(
     host_state = _to_host(host_tree, want_value=is_primary)
     if not is_primary:
         return None
+    if zero_lib.is_wus_momentum(state.momentum):
+        # Gather-on-save (weight-update sharding): the stacked-chunk
+        # optimizer shards flatten back to the param-shaped layout every
+        # checkpoint stores — zero and replicated runs stay
+        # restore-compatible in both directions.  The error-feedback agerr
+        # twin is resettable state and is dropped (like qcomm residuals on
+        # a mode switch).
+        host_state["momentum"] = zero_lib.gather_momentum(
+            host_state["momentum"], host_state["params"])
     payload = {
         "epoch": epoch,
         "arch": arch,
@@ -356,6 +387,22 @@ def _load_msgpack(
             "momentum": state_template.momentum,
         }
         saved = dict(tree["state"])
+        if zero_lib.is_wus_momentum(state_template.momentum):
+            # Shard-on-restore (weight-update sharding): disk always holds
+            # the param-shaped momentum (gather-on-save invariant — also
+            # what any legacy replicated-DP checkpoint holds), so a --zero
+            # wus template re-chunks it into its stacked (n, chunk) layout.
+            # Works across mesh sizes: the template's chunking wins.  The
+            # agerr error-feedback twin (quantized all-gather) restarts at
+            # the template's zeros.
+            t_mom = serialization.to_state_dict(state_template.momentum)
+            saved_mom = saved.get("momentum")
+            chunked = dict(t_mom)
+            if saved_mom is not None and not (
+                    isinstance(saved_mom, dict) and "buf" in saved_mom):
+                chunked["buf"] = zero_lib.shard_momentum(
+                    saved_mom, t_mom["buf"])
+            saved["momentum"] = chunked
         saved_res = saved.pop("residual", None)
         t_res = serialization.to_state_dict(state_template.residual)
         if t_res:

@@ -58,6 +58,13 @@ class Config:
     # quantized all-reduce with error feedback.  None = "recipe decides"
     # (horovod defaults to bf16), mirroring the precision convention.
     grad_compress: Optional[str] = None
+    # ZeRO-style weight-update sharding (parallel/zero.py): "wus" shards the
+    # SGD momentum 1/N over the data axis, reduce-scatters gradients, and
+    # all-gathers the parameter delta once per step — (N-1)/N of the
+    # optimizer+synced-gradient bytes reclaimed per device at equal wire
+    # cost.  None = "recipe decides" (all recipes currently default to the
+    # replicated-DP "none"), mirroring the grad_compress convention.
+    zero: Optional[str] = None
     accum_steps: int = 1
     local_rank: int = -1  # launch-line parity only; unused on TPU
     image_size: int = 224
@@ -204,6 +211,14 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "with error feedback (ops/qcomm.py) — true wire "
                    "compression on the explicit-collectives step, numerics "
                    "emulation under GSPMD; unset = recipe default")
+    p.add_argument("--zero", default=d.zero, choices=("none", "wus"),
+                   help="ZeRO-style weight-update sharding "
+                   "(arXiv:2004.13336): wus reduce-scatters gradients, "
+                   "keeps optimizer state sharded 1/N over the data axis, "
+                   "updates on the shard, and all-gathers the parameter "
+                   "delta — ~(N-1)/N of optimizer+gradient bytes reclaimed "
+                   "per device; composes with --grad-compress (both wire "
+                   "hops quantized); unset = recipe default (none)")
     p.add_argument("--resume", default=d.resume, type=str, metavar="PATH",
                    help="path to checkpoint to resume from")
     p.add_argument("--checkpoint-dir", default=d.checkpoint_dir, type=str,

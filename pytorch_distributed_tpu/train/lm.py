@@ -210,6 +210,8 @@ def make_lm_train_step(
     log_norms: bool = False,
     guard_nonfinite: bool = False,
     grad_compress: Optional[str] = None,
+    zero: str = "none",
+    params=None,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
@@ -244,7 +246,25 @@ def make_lm_train_step(
     the gradient psum — so quantized modes run as a *numerics emulation*
     (fake-quantize + error feedback applied to the already-synced global
     gradient; wire bytes unchanged).  True wire compression lives in the
-    explicit-collectives image path (train/steps.py)."""
+    explicit-collectives image path (train/steps.py).
+
+    ``zero='wus'`` (parallel/zero.py): momentum leaves take data-axis
+    ``fsdp_specs`` shardings (``zero_momentum_specs``, composed over
+    ``param_specs`` so TP layouts keep their model-axis dims) while the
+    update math is untouched — XLA derives the weight-update sharding
+    from the layout alone.  Per-device optimizer bytes drop to ~1/N;
+    ``params`` (the concrete param tree) is required to size the specs."""
+    from pytorch_distributed_tpu.parallel import zero as zero_lib
+
+    zero_mode = zero_lib.resolve_zero(zero)
+    mom_specs = None
+    if zero_mode == "wus":
+        if params is None:
+            raise ValueError(
+                "make_lm_train_step(zero='wus') needs the concrete params "
+                "tree to size the momentum fsdp_specs")
+        mom_specs = zero_lib.zero_momentum_specs(
+            params, mesh, data_axis, base_specs=param_specs)
     manual = getattr(model, "has_manual_grads", lambda: False)()
     gc_mode, gc_cast = qcomm.resolve_mode(grad_compress, None)
     if gc_mode != "none":
@@ -431,7 +451,8 @@ def make_lm_train_step(
 
     state_shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),
-        state_specs(param_specs, residual=gc_mode in qcomm.QUANTIZED_MODES),
+        state_specs(param_specs, residual=gc_mode in qcomm.QUANTIZED_MODES,
+                    momentum_specs=mom_specs),
     )
     token_sharding = NamedSharding(mesh, P(data_axis, None))
     return jax.jit(
@@ -444,14 +465,17 @@ def make_lm_train_step(
 
 
 def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data",
-                      has_residual: bool = False):
+                      has_residual: bool = False, momentum_specs=None):
     """Jitted held-out eval step returning exact token-weighted *sums*
     (loss·count, correct, count) — the LM counterpart of the image harness's
     ``make_eval_step`` (reference validate() pattern,
     reference distributed.py:279-324): aggregation is exact on the host,
     reductions live inside the compiled program.  ``has_residual``: the
     caller's TrainState carries error-feedback residuals (quantized
-    ``grad_compress``), so in_shardings must cover that subtree too."""
+    ``grad_compress``), so in_shardings must cover that subtree too.
+    ``momentum_specs``: the ``--zero wus`` momentum layout
+    (``zero_momentum_specs``) — in_shardings must match or XLA gathers
+    the sharded optimizer state on every eval call."""
 
     def step(state: TrainState, tokens: jnp.ndarray):
         # mutable=["losses"]: MoE models sow the router aux loss even in
@@ -472,7 +496,8 @@ def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data",
 
     state_shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),
-        state_specs(param_specs, residual=has_residual)
+        state_specs(param_specs, residual=has_residual,
+                    momentum_specs=momentum_specs)
     )
     token_sharding = NamedSharding(mesh, P(data_axis, None))
     return jax.jit(
@@ -524,6 +549,7 @@ class LMTrainer:
         ft_lr_backoff: float = 0.5,
         chaos=None,
         grad_compress: Optional[str] = None,
+        zero: Optional[str] = None,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -560,7 +586,11 @@ class LMTrainer:
         ``ft.chaos`` injector schedule driven once per loop step (tests
         and drills only); ``grad_compress``: gradient-sync compression
         mode (``none | bf16 | int8 | fp8`` — numerics emulation under the
-        LM GSPMD step, see ``make_lm_train_step``)."""
+        LM GSPMD step, see ``make_lm_train_step``); ``zero``: ``none|wus``
+        weight-update sharding (parallel/zero.py) — momentum leaves take
+        ``fsdp_specs`` data-axis shardings over the param specs, 1/N
+        optimizer bytes per device, identical numerics and checkpoints."""
+        from pytorch_distributed_tpu.parallel import zero as zero_lib
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
             shard_state,
@@ -585,11 +615,17 @@ class LMTrainer:
             param_specs if param_specs is not None else replicated_like(params)
         )
         self.grad_compress, _ = qcomm.resolve_mode(grad_compress, None)
+        self.zero = zero_lib.resolve_zero(zero)
+        self._mom_specs = (
+            zero_lib.zero_momentum_specs(params, mesh,
+                                         base_specs=self.param_specs)
+            if self.zero == "wus" else None)
         residual = qcomm.init_residual(params, self.grad_compress,
                                        explicit=False)
         state = TrainState.create({"params": params}, sgd_init(params),
                                   residual=residual)
-        self.state = shard_state(state, self.param_specs, mesh)
+        self.state = shard_state(state, self.param_specs, mesh,
+                                 momentum_specs=self._mom_specs)
         self.lr_schedule = lr_schedule
         self.step_fn = make_lm_train_step(model, mesh, self.param_specs,
                                           clip_grad_norm=clip_grad_norm,
@@ -600,7 +636,8 @@ class LMTrainer:
                                           # metrics sink will consume them
                                           log_norms=bool(metrics_jsonl),
                                           guard_nonfinite=nan_guard,
-                                          grad_compress=self.grad_compress)
+                                          grad_compress=self.grad_compress,
+                                          zero=self.zero, params=params)
         self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every
@@ -613,7 +650,8 @@ class LMTrainer:
         self._eval_fn = (
             make_lm_eval_step(
                 model, mesh, self.param_specs,
-                has_residual=self.grad_compress in qcomm.QUANTIZED_MODES)
+                has_residual=self.grad_compress in qcomm.QUANTIZED_MODES,
+                momentum_specs=self._mom_specs)
             if eval_dataset is not None
             else None
         )
@@ -675,8 +713,10 @@ class LMTrainer:
 
             loaded, meta = load_checkpoint(resume, self.state)
             # Host-numpy leaves → re-shard to this trainer's specs (any
-            # mesh shape can resume any mesh shape's checkpoint).
-            self.state = shard_state(loaded, self.param_specs, mesh)
+            # mesh shape can resume any mesh shape's checkpoint; the
+            # momentum re-shards to the wus layout when zero is on).
+            self.state = shard_state(loaded, self.param_specs, mesh,
+                                     momentum_specs=self._mom_specs)
             ft = meta["ft"]
             self._start_step = max(int(ft["global_step"]), int(ft["step"]))
             if self.ft_guard is not None:

@@ -13,6 +13,12 @@ Implemented as init/update pure functions over pytrees so the update lives
 inside the jitted SPMD step; ``lr`` is a traced scalar operand.  An optax
 optimizer can be substituted anywhere the harness accepts ``tx`` — this module
 is the default because its numerics are the parity target.
+
+``--zero wus`` (parallel/zero.py) re-implements exactly this ``_upd`` on flat
+1/N parameter chunks so the weight-update-sharded step is bit-compatible with
+the replicated one: any change to the update math here must be mirrored in
+``zero.wus_apply_updates`` (the 3-step parity fence in tests/test_zero.py
+catches drift).
 """
 
 from __future__ import annotations

@@ -23,7 +23,13 @@ class TrainState:
     step: jnp.ndarray          # global step counter (int32 scalar)
     params: Pytree             # f32 master weights
     batch_stats: Pytree        # BatchNorm running mean/var (f32)
-    momentum: Pytree           # SGD momentum buffers (f32, params-shaped)
+    # SGD momentum buffers.  Three layouts flow through this field:
+    # params-shaped f32 (replicated DP, and GSPMD --zero wus where only the
+    # sharding changes); the explicit --zero wus stacked-chunk dict
+    # {"buf": (n_data, chunk) leaves[, "agerr": ...]} sharded P("data")
+    # (parallel/zero.py — checkpoints always store the param-shaped view);
+    # or an optax opt_state when a tx is supplied.
+    momentum: Pytree
     # Error-feedback residuals for quantized gradient sync (ops/qcomm.py):
     # empty for grad_compress none/bf16; params-shaped f32 under GSPMD
     # emulation; stacked (n_data, *shape) sharded over the data axis under

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from pytorch_distributed_tpu.ops import cross_entropy, qcomm, topk_correct
+from pytorch_distributed_tpu.parallel import zero as zero_lib
 from pytorch_distributed_tpu.train.optim import sgd_update
 from pytorch_distributed_tpu.train.state import TrainState
 
@@ -108,6 +109,8 @@ def make_train_step(
     accum_steps: int = 1,
     log_norms: bool = False,
     guard_nonfinite: bool = False,
+    zero: str = "none",
+    params: Optional[Any] = None,
 ) -> Callable[[TrainState, Batch, jnp.ndarray], Tuple[TrainState, Metrics]]:
     """Build the jitted train step for ``mesh``.
 
@@ -155,6 +158,24 @@ def make_train_step(
     lengthen XLA compiles, so the cost is only paid when a metrics sink is
     actually attached (Trainer enables it with ``--metrics-jsonl``).
 
+    ``zero``: ``none | wus`` — ZeRO-style weight-update sharding
+    (parallel/zero.py, arXiv:2004.13336).  Under ``wus`` the explicit
+    path replaces the gradient all-reduce with a reduce-scatter, keeps
+    the momentum buffer sharded ``P(data_axis)`` in stacked-chunk layout,
+    applies the torch-parity SGD update on the 1/N shard, and all-gathers
+    the parameter delta once per step; ``grad_compress`` composes — both
+    wire hops ride the quantized qcomm path with error feedback
+    (``compressed_reduce_scatter`` / ``compressed_all_gather``).  Under
+    GSPMD the same semantics are a sharding-spec change: momentum takes
+    ``fsdp_specs`` shardings (pass ``params`` so the layout can be
+    derived) and XLA inserts the reduce-scatter/all-gather pair.  The
+    momentum pytree under explicit wus is ``{"buf": chunks[, "agerr":
+    chunks]}`` — build it with ``zero_lib.init_wus_momentum``; checkpoints
+    still store the param-shaped layout (train/checkpoint.py gathers on
+    save and re-chunks on restore).  Requires the default torch-parity
+    SGD (``tx`` must be None: the chunked update re-implements
+    ``optim._upd`` on flat shards).
+
     ``guard_nonfinite``: compute a ``nonfinite`` flag from loss + global
     grad norm and gate the whole update (params, momentum, BN stats) on it
     inside the compiled step — a NaN/inf batch is structurally skipped
@@ -170,6 +191,16 @@ def make_train_step(
     """
 
     mode, cast_dtype = qcomm.resolve_mode(grad_compress, wire_dtype)
+    zero_mode = zero_lib.resolve_zero(zero)
+    if zero_mode == "wus":
+        if tx is not None:
+            raise ValueError(
+                "zero='wus' implements the torch-parity SGD update on 1/N "
+                "shards; an optax tx cannot be chunked — drop one of them")
+        if not explicit_collectives and params is None:
+            raise ValueError(
+                "zero='wus' under GSPMD derives the momentum shardings "
+                "from the params tree — pass params=state.params")
 
     def sync_grads(grads, count, residual):
         # grads arrive as *local weighted sums*; sync then normalize.
@@ -296,8 +327,33 @@ def make_train_step(
         grads, new_stats, (loss_sum, c1, c5, count) = accumulated_grads(
             state.params, state.batch_stats, batch, rng
         )
-        grads, gcount, new_residual = sync_grads(grads, count, state.residual)
-        new_params, new_momentum = apply_updates(state, grads, lr)
+        if zero_mode == "wus":
+            # Weight-update sharding: reduce-scatter the gradient sums so
+            # this rank owns the exact f32 sum of its 1/N chunk, update on
+            # the shard (momentum stays chunked), all-gather the delta.
+            n = jax.lax.axis_size(data_axis)
+            idx = jax.lax.axis_index(data_axis)
+            with jax.named_scope("grad_sync"):
+                if mode in qcomm.QUANTIZED_MODES:
+                    gchunks, new_residual = qcomm.compressed_reduce_scatter(
+                        grads, state.residual, data_axis, mode=mode)
+                else:
+                    gchunks = zero_lib.reduce_scatter_grads(
+                        grads, data_axis, n, cast_dtype=cast_dtype)
+                    new_residual = state.residual
+                gcount = jax.lax.psum(count, data_axis)
+                gchunks = jax.tree_util.tree_map(
+                    lambda g: g / gcount, gchunks)
+            with jax.named_scope("optimizer"):
+                new_params, new_momentum = zero_lib.wus_apply_updates(
+                    state.params, state.momentum, gchunks, lr, idx, n,
+                    data_axis, momentum_coef=momentum,
+                    weight_decay=weight_decay, mode=mode,
+                    cast_dtype=cast_dtype)
+        else:
+            grads, gcount, new_residual = sync_grads(
+                grads, count, state.residual)
+            new_params, new_momentum = apply_updates(state, grads, lr)
         # BN running stats: average local EMAs across shards so replicas agree.
         new_stats = jax.lax.pmean(new_stats, data_axis)
         metrics = {
@@ -305,10 +361,19 @@ def make_train_step(
             "acc1": jax.lax.psum(c1, data_axis) * 100.0 / gcount,
             "acc5": jax.lax.psum(c5, data_axis) * 100.0 / gcount,
         }
-        # Synced grads are identical on every shard, so the per-shard
-        # norm IS the global norm — no extra collective.
-        gnorm = (tree_l2_norm(grads)
-                 if (log_norms or guard_nonfinite) else None)
+        gnorm = None
+        if log_norms or guard_nonfinite:
+            if zero_mode == "wus":
+                # Reduce-scattered chunks are disjoint across ranks, so the
+                # replicated-path shortcut (per-shard norm == global norm)
+                # does not hold — one extra scalar psum of per-chunk square
+                # sums recovers the exact global norm (padding is zeros).
+                gnorm = jnp.sqrt(jax.lax.psum(
+                    zero_lib.chunk_sq_sum(gchunks), data_axis))
+            else:
+                # Synced grads are identical on every shard, so the
+                # per-shard norm IS the global norm — no extra collective.
+                gnorm = tree_l2_norm(grads)
         if guard_nonfinite:
             bad = nonfinite_flag(metrics["loss"], gnorm)
             new_params = gate_update(bad, state.params, new_params)
@@ -375,12 +440,33 @@ def make_train_step(
     # other fields stay replicated).
     state_sharding = replicated
     state_spec = P()
-    if explicit_collectives and mode in qcomm.QUANTIZED_MODES:
+    quantized = mode in qcomm.QUANTIZED_MODES
+    if explicit_collectives and (quantized or zero_mode == "wus"):
+        # Weight-update sharding adds a second sharded-state subtree: the
+        # stacked-chunk momentum {"buf"[, "agerr"]} rides P(data_axis) with
+        # the same one-slot-per-rank discipline as the residual.
+        res_sh = (NamedSharding(mesh, P(data_axis)) if quantized
+                  else replicated)
+        mom_sh = (NamedSharding(mesh, P(data_axis)) if zero_mode == "wus"
+                  else replicated)
         state_sharding = TrainState(
             step=replicated, params=replicated, batch_stats=replicated,
-            momentum=replicated, residual=NamedSharding(mesh, P(data_axis)))
-        state_spec = TrainState(step=P(), params=P(), batch_stats=P(),
-                                momentum=P(), residual=P(data_axis))
+            momentum=mom_sh, residual=res_sh)
+        state_spec = TrainState(
+            step=P(), params=P(), batch_stats=P(),
+            momentum=P(data_axis) if zero_mode == "wus" else P(),
+            residual=P(data_axis) if quantized else P())
+    elif zero_mode == "wus":
+        # GSPMD WUS is a layout statement: momentum leaves take their
+        # fsdp_specs sharding while params stay replicated; XLA's SPMD
+        # partitioner inserts the gradient reduce-scatter (into the
+        # sharded buffer) and the parameter-delta all-gather on its own.
+        mom_sharding = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s),
+            zero_lib.zero_momentum_specs(params, mesh, data_axis=data_axis))
+        state_sharding = TrainState(
+            step=replicated, params=replicated, batch_stats=replicated,
+            momentum=mom_sharding, residual=replicated)
 
     if explicit_collectives:
         batch_specs = {k: P(data_axis) for k in ("images", "labels", "weights")}
@@ -407,6 +493,7 @@ def make_eval_step(
     mesh: Mesh,
     data_axis: str = "data",
     residual_sharded: bool = False,
+    momentum_sharding=None,
 ) -> Callable[[TrainState, Batch], Metrics]:
     """Distributed evaluation step (reference validate(),
     distributed.py:279-324 + the README's distributed-eval chapter).
@@ -420,6 +507,11 @@ def make_eval_step(
     sharded over ``data_axis`` in ``TrainState.residual``; eval never reads
     them, but the in_shardings must still describe them or pjit rejects the
     state.
+
+    ``momentum_sharding``: same story for ``--zero wus`` optimizer state —
+    pass the momentum sharding (a NamedSharding prefix or a momentum-shaped
+    tree of them) the train step uses; ``None`` keeps the replicated-DP
+    default.
     """
 
     def step(state: TrainState, batch: Batch) -> Metrics:
@@ -434,7 +526,8 @@ def make_eval_step(
         step=replicated,
         params=replicated,
         batch_stats=replicated,
-        momentum=replicated,
+        momentum=(replicated if momentum_sharding is None
+                  else momentum_sharding),
         residual=sharded if residual_sharded else replicated,
     )
     batch_shardings = {"images": sharded, "labels": sharded, "weights": sharded}

@@ -52,6 +52,7 @@ class Trainer:
         explicit_collectives: bool = False,
         wire_dtype=None,
         grad_compress: Optional[str] = None,
+        zero: Optional[str] = None,
         data_axis: str = "data",
         tx=None,
         preempt=None,
@@ -64,6 +65,12 @@ class Trainer:
         (none|bf16|int8|fp8, ops/qcomm.py); falls back to
         ``cfg.grad_compress``.  The legacy ``wire_dtype`` argument is the
         deprecated bf16-mode alias.
+
+        ``zero``: ``none|wus`` weight-update sharding (parallel/zero.py);
+        falls back to ``cfg.zero``.  Under ``wus`` the optimizer state is
+        sharded 1/N over the data axis — stacked chunks on the explicit
+        step, ``fsdp_specs`` shardings under GSPMD — and checkpoints keep
+        storing the param-shaped momentum, so runs restore across modes.
 
         ``preempt``: optional ``utils.preempt.PreemptionGuard`` (already
         installed) polled between steps; ``fit()`` installs a guard for
@@ -155,17 +162,48 @@ class Trainer:
         self.grad_compress, self._grad_cast = qcomm.resolve_mode(
             gc, wire_dtype)
 
+        # Weight-update sharding (kwarg > cfg, like grad_compress) — the
+        # mode decides the optimizer-state layout carried in TrainState.
+        from pytorch_distributed_tpu.parallel import zero as zero_lib
+
+        self.zero = zero_lib.resolve_zero(
+            zero if zero is not None else getattr(cfg, "zero", None))
+        if self.zero == "wus" and tx is not None:
+            raise ValueError(
+                "--zero wus implements the torch-parity SGD on 1/N shards; "
+                "an optax tx cannot be chunked — drop one of them")
+
         seed = cfg.seed if cfg.seed is not None else 0
         rng = jax.random.PRNGKey(seed)
         sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
         variables = self.model.init(rng, sample, train=False)
-        opt0 = tx.init(variables["params"]) if tx is not None else sgd_init(
-            variables["params"]
-        )
+        n_data = dict(self.mesh.shape)[self.data_axis]
+        self._mom_sharding = None   # non-replicated momentum layout (wus)
+        if self.zero == "wus" and explicit_collectives:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            opt0 = zero_lib.init_wus_momentum(
+                variables["params"], n_data,
+                quantized=self.grad_compress in qcomm.QUANTIZED_MODES)
+            self._mom_sharding = NamedSharding(
+                self.mesh, PartitionSpec(self.data_axis))
+            opt0 = jax.device_put(opt0, self._mom_sharding)
+        elif self.zero == "wus":
+            from jax.sharding import NamedSharding
+
+            opt0 = sgd_init(variables["params"])
+            self._mom_sharding = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s),
+                zero_lib.zero_momentum_specs(
+                    variables["params"], self.mesh, data_axis=self.data_axis))
+            opt0 = jax.device_put(opt0, self._mom_sharding)
+        else:
+            opt0 = tx.init(variables["params"]) if tx is not None else \
+                sgd_init(variables["params"])
         residual = qcomm.init_residual(
             variables["params"], self.grad_compress,
             explicit=explicit_collectives,
-            n_data=dict(self.mesh.shape)[self.data_axis])
+            n_data=n_data)
         self.state = TrainState.create(variables, opt0, residual=residual)
         del variables
 
@@ -253,11 +291,14 @@ class Trainer:
             # costs nothing when off.
             log_norms=bool(cfg.metrics_jsonl),
             guard_nonfinite=bool(getattr(cfg, "nan_guard", False)),
+            zero=self.zero,
+            params=self.state.params,
         )
         self.eval_step = make_eval_step(
             self.model, self.mesh, data_axis=data_axis,
             residual_sharded=(explicit_collectives
-                              and self.grad_compress in qcomm.QUANTIZED_MODES))
+                              and self.grad_compress in qcomm.QUANTIZED_MODES),
+            momentum_sharding=self._mom_sharding)
         self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
         # One observability entry point (obs/): the epoch CSV registers as
         # an epoch sink, a --telemetry-csv sampler registers in fit(), and

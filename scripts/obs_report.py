@@ -166,7 +166,7 @@ def summarize_bench(records: List[dict]) -> List[str]:
 
 
 _COMM_FIELDS = ("model_comm_bytes", "comm_wire_bytes", "collective_count",
-                "exposed_comm_ms", "overlap_pct")
+                "exposed_comm_ms", "overlap_pct", "peak_hbm_bytes")
 
 
 def comm_stats(records: List[dict]) -> Dict[str, Optional[float]]:
@@ -434,13 +434,16 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         "model_comm_bytes": cs["model_comm_bytes"],
         "comm_wire_bytes": cs["comm_wire_bytes"],
         "exposed_comm_ms": cs["exposed_comm_ms"],
+        "peak_hbm_bytes": cs["peak_hbm_bytes"],
     }
 
 
 # (name, lower_is_better, absolute_pp) — goodput diffs in percentage
 # points, the rest in relative percent.  exposed_comm_ms fences the
 # overlap win (more un-overlapped collective time per step); wire bytes
-# fence the traffic itself (a sharding change that moves more data).
+# fence the traffic itself (a sharding change that moves more data);
+# peak_hbm_bytes fences the compiled per-device footprint (the --zero
+# wus / fused-CE memory wins, stamped from the ledger's memory_analysis).
 _DIFF_METRICS = (
     ("step_time_p50", True, False),
     ("step_time_p95", True, False),
@@ -449,6 +452,7 @@ _DIFF_METRICS = (
     ("goodput", False, True),
     ("exposed_comm_ms", True, False),
     ("comm_wire_bytes", True, False),
+    ("peak_hbm_bytes", True, False),
 )
 
 
@@ -705,6 +709,33 @@ def _selftest() -> int:
         assert by_name["exposed_comm_ms"]["verdict"] == "REGRESS", dd
         assert by_name["comm_wire_bytes"]["verdict"] == "PASS", dd
         json.dumps(dd)
+
+        # ---- planted peak-HBM regression: same timings, compiled peak
+        # grew (e.g. a --zero wus run accidentally fell back to replicated
+        # optimizer state) -> only the peak_hbm_bytes fence must REGRESS
+        base_m = os.path.join(d, "base_mem.jsonl")
+        bad_m = os.path.join(d, "bad_mem.jsonl")
+        for path, peak in ((base_m, 2.0e8), (bad_m, 3.1e8)):
+            with MetricsLogger(path, flush_every=50) as log:
+                for i in range(30):
+                    log.log_step(i, step_time=0.010, n_items=128, lr=0.1,
+                                 extra={"model_comm_bytes": 66952.0,
+                                        "comm_wire_bytes": 100428.0,
+                                        "peak_hbm_bytes": peak})
+        m_recs, _ = load_metrics(base_m)
+        n_recs, _ = load_metrics(bad_m)
+        text4, regressed4 = diff_report(m_recs, n_recs)
+        assert regressed4, (
+            f"selftest: peak-HBM regression must REGRESS:\n{text4}")
+        dm = diff_data(m_recs, n_recs)
+        by_name4 = {r["metric"]: r for r in dm["metrics"]}
+        assert by_name4["peak_hbm_bytes"]["verdict"] == "REGRESS", dm
+        assert by_name4["comm_wire_bytes"]["verdict"] == "PASS", dm
+        # reverse direction (the memory WIN) must pass the peak fence
+        # (row-scoped: the wall-clock goodput metric is timing-noisy here)
+        dr = diff_data(n_recs, m_recs)
+        by_rev = {r["metric"]: r for r in dr["metrics"]}
+        assert by_rev["peak_hbm_bytes"]["verdict"] == "PASS", dr
     print("obs_report selftest: OK")
     return 0
 

@@ -187,38 +187,18 @@ def test_int8_step_parity_vs_f32():
 
 # ------------------------------------------------------------ wire fences
 
-class _WideMLP(__import__("flax").linen.Module):
-    """Leaves sized as multiples of n*block: padding-free quantization, so
-    the measured wire ratio reflects realistic layers."""
-
-    classes: int = 10
-
-    @__import__("flax").linen.compact
-    def __call__(self, x, train: bool = True):
-        import flax.linen as nn
-
-        x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(256)(x))
-        return nn.Dense(self.classes)(x)
-
-
-def _wide_ledger(mode, mesh, model, variables):
+def _recipe_ledger(get_lowering, name):
+    """Comm ledger for one shardlint recipe's cached session lowering —
+    pure text parsing over the memoized compile (analysis.core), instead
+    of a fresh per-test ``lower().compile()``."""
     from pytorch_distributed_tpu.obs import comms
 
-    step = make_train_step(model, mesh, explicit_collectives=True,
-                           grad_compress=mode)
-    state = _fresh_state(variables, mode, True, 4)
-    batch = {
-        "images": jnp.zeros((16, 8, 8, 3), jnp.float32),
-        "labels": jnp.zeros((16,), jnp.int32),
-        "weights": jnp.ones((16,), jnp.float32),
-    }
-    return comms.ledger_from_jitted(
-        step, (state, batch, jnp.float32(0.1)), step=f"wide_{mode}",
-        mesh=mesh)
+    low = get_lowering(name)
+    return comms.ledger_from_hlo_text(low.text, step=name,
+                                      mesh_shape=low.mesh_shape)
 
 
-def test_int8_wire_bytes_fence_and_analytic_parity():
+def test_int8_wire_bytes_fence_and_analytic_parity(get_lowering):
     """The ISSUE-8 acceptance fence, measured from compiled HLO: int8
     grad_sync wire bytes shrink >= 3.5x vs f32, entries are labeled with
     the int8 wire encoding, and the analytic model lands within +-15%."""
@@ -227,11 +207,8 @@ def test_int8_wire_bytes_fence_and_analytic_parity():
         image_comm_bytes_compressed,
     )
 
-    mesh = build_mesh(MeshSpec(("data",), (4,)), jax.devices()[:4])
-    model = _WideMLP()
-    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
-    lg_f32 = _wide_ledger("none", mesh, model, variables)
-    lg_int8 = _wide_ledger("int8", mesh, model, variables)
+    lg_f32 = _recipe_ledger(get_lowering, "train_image_explicit")
+    lg_int8 = _recipe_ledger(get_lowering, "train_image_int8")
 
     gs_f32 = lg_f32.by_phase()["grad_sync"]
     gs_int8 = lg_int8.by_phase()["grad_sync"]
@@ -243,26 +220,25 @@ def test_int8_wire_bytes_fence_and_analytic_parity():
     # payload dominates the f32 scale side-cars
     assert encodings["int8"] > 10 * encodings.get("f32", 0.0), encodings
 
-    leaf_sizes = [l.size for l in
-                  jax.tree_util.tree_leaves(variables["params"])]
+    # both recipes share _tiny_image_model; leaf sizes off the cached
+    # lowering's own state argument
+    leaf_sizes = [l.size for l in jax.tree_util.tree_leaves(
+        get_lowering("train_image_int8").args[0].params)]
     pred = image_comm_bytes_compressed(leaf_sizes, dp=4, mode="int8")
     assert comm_residual_pct(
         pred.total_bytes, lg_int8.total_bytes) <= 15.0, (
         pred.total_bytes, lg_int8.total_bytes)
 
 
-def test_wire_encoding_json_roundtrip(tmp_path):
+def test_wire_encoding_json_roundtrip(tmp_path, get_lowering):
     """Ledger JSON round-trips wire_encoding; legacy entries without the
     field load with the f32 default."""
     from pytorch_distributed_tpu.obs import comms
 
-    mesh = build_mesh(MeshSpec(("data",), (4,)), jax.devices()[:4])
-    model = _WideMLP()
-    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
-    lg = _wide_ledger("int8", mesh, model, variables)
+    lg = _recipe_ledger(get_lowering, "train_image_int8")
     path = os.path.join(tmp_path, "comm_ledger.json")
     comms.write_ledgers(path, [lg])
-    loaded = comms.load_ledgers(path)["wide_int8"]
+    loaded = comms.load_ledgers(path)["train_image_int8"]
     assert (loaded.phase_wire_encodings("grad_sync")
             == lg.phase_wire_encodings("grad_sync"))
 
@@ -270,11 +246,11 @@ def test_wire_encoding_json_roundtrip(tmp_path):
     import json
 
     data = json.load(open(path))
-    for e in data["wide_int8"]["entries"]:
+    for e in data["train_image_int8"]["entries"]:
         e.pop("wire_encoding")
     with open(path, "w") as f:
         json.dump(data, f)
-    legacy = comms.load_ledgers(path)["wide_int8"]
+    legacy = comms.load_ledgers(path)["train_image_int8"]
     assert {e.wire_encoding for e in legacy.entries} == {"f32"}
 
 

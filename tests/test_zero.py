@@ -1,0 +1,325 @@
+"""ZeRO-1 weight-update sharding (parallel/zero.py, ``--zero wus``) fences.
+
+Covers the ISSUE-9 contracts on the simulated CPU mesh:
+
+- step parity: 3 explicit-collective steps under wus track the replicated
+  DP step bit-tight in f32 and loosely composed with int8 grad compression;
+- GSPMD composition: LM training with ``zero_momentum_specs`` matches the
+  replicated run and actually holds 1/N momentum shards;
+- gather/shard round-trip: the stacked-chunk momentum layout flattens to
+  the param-shaped tree and re-chunks exactly;
+- checkpoints: sharded momentum round-trips through the param-shaped disk
+  layout, and mode-switch restores work in BOTH directions
+  (legacy-replicated -> wus, wus -> replicated);
+- kill-and-resume parity under ``--zero wus`` (the ISSUE-9 acceptance
+  criterion), riding the test_ft preemption drill;
+- shardlint: ``declared_zero`` promotes the replicated-state info finding
+  to a hard error, while the real zero recipes stay green;
+- analytic wire parity: RS+AG wire bytes equal the ring all-reduce's
+  (obs/flops.py zero_wire_parity), and the analytic model lands within
+  the ±15% residual window of the compiled train_image_zero ledger.
+"""
+
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.ft import ChaosSchedule, SignalAt
+from pytorch_distributed_tpu.models.transformer import TransformerLM
+from pytorch_distributed_tpu.ops import qcomm
+from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+from pytorch_distributed_tpu.parallel import zero as zero_lib
+from pytorch_distributed_tpu.train.checkpoint import (
+    CHECKPOINT_NAME,
+    load_checkpoint,
+    save_checkpoint,
+)
+from pytorch_distributed_tpu.train.lm import LMTrainer, SyntheticTokenDataset
+from pytorch_distributed_tpu.train.optim import sgd_init
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.steps import make_train_step
+
+from tests.test_steps import _MLP, _leaves_allclose
+
+N = 4
+
+
+def _mesh4():
+    return build_mesh(MeshSpec(("data",), (N,)), jax.devices()[:N])
+
+
+def _mlp_variables(seed=0):
+    model = _MLP(classes=10)
+    return model, model.init(jax.random.PRNGKey(seed),
+                             jnp.zeros((1, 8, 8, 3)))
+
+
+def _batches(k=3, seed=4):
+    rng = np.random.default_rng(seed)
+    return [{
+        "images": rng.normal(size=(16, 8, 8, 3)).astype(np.float32),
+        "labels": rng.integers(0, 10, size=16).astype(np.int32),
+        "weights": np.ones(16, np.float32),
+    } for _ in range(k)]
+
+
+def _run_explicit(model, variables, mesh, zero, grad_compress="none"):
+    v = jax.tree_util.tree_map(jnp.array, variables)
+    if zero == "wus":
+        momentum = zero_lib.init_wus_momentum(
+            v["params"], N, quantized=grad_compress in qcomm.QUANTIZED_MODES)
+    else:
+        momentum = sgd_init(v["params"])
+    residual = qcomm.init_residual(v["params"], grad_compress,
+                                   explicit=True, n_data=N)
+    state = TrainState.create(v, momentum, residual=residual)
+    step = make_train_step(model, mesh, explicit_collectives=True,
+                           grad_compress=grad_compress, zero=zero)
+    for b in _batches():
+        state, metrics = step(state, b, jnp.float32(0.1))
+    return state, float(metrics["loss"])
+
+
+# ------------------------------------------------------------- step parity
+
+def test_wus_step_parity_vs_replicated():
+    """The ISSUE-9 numerics fence: 3 explicit steps on the 4-way mesh.
+    f32 wus IS the replicated update (reduce-scatter + chunked SGD +
+    delta all-gather reassociates the same math) — tight tolerance;
+    int8 wus composes with error feedback — loose tolerance."""
+    mesh = _mesh4()
+    model, variables = _mlp_variables()
+    s_repl, loss_repl = _run_explicit(model, variables, mesh, "none")
+    s_wus, loss_wus = _run_explicit(model, variables, mesh, "wus")
+    np.testing.assert_allclose(loss_wus, loss_repl, rtol=2e-5)
+    _leaves_allclose(s_repl.params, s_wus.params, rtol=2e-5)
+    # momentum actually lives 1/N-sharded
+    for leaf in jax.tree_util.tree_leaves(s_wus.momentum):
+        assert leaf.addressable_shards[0].data.size * N == leaf.size
+
+    s_q, loss_q = _run_explicit(model, variables, mesh, "wus", "int8")
+    np.testing.assert_allclose(loss_q, loss_repl, rtol=5e-3)
+    _leaves_allclose(s_repl.params, s_q.params, rtol=0.05, atol=5e-3)
+    # both quantized hops carry live error feedback
+    assert sum(float(jnp.sum(jnp.abs(l)))
+               for l in jax.tree_util.tree_leaves(s_q.residual)) > 0.0
+    assert sum(float(jnp.sum(jnp.abs(l)))
+               for l in jax.tree_util.tree_leaves(s_q.momentum["agerr"])) > 0.0
+
+
+def test_gspmd_lm_zero_parity_and_sharding(tmp_path):
+    """GSPMD composition: LMTrainer with zero='wus' (momentum resharded by
+    zero_momentum_specs) matches the replicated run on identical synthetic
+    batches, and its biggest momentum shard is 1/N of the replicated one."""
+    mesh = build_mesh(MeshSpec(("data",), (jax.device_count(),)))
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(64, 16, 32)
+
+    def fit(zero):
+        with mesh:
+            t = LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
+                          eval_dataset=None, zero=zero)
+            loss = t.fit(3, print_freq=4)
+        return t, loss
+
+    t_repl, loss_repl = fit(None)
+    t_wus, loss_wus = fit("wus")
+    np.testing.assert_allclose(loss_wus, loss_repl, rtol=2e-5)
+    _leaves_allclose(t_repl.state.params, t_wus.state.params, rtol=2e-5)
+
+    def max_shard(state):
+        return max(l.addressable_shards[0].data.size
+                   for l in jax.tree_util.tree_leaves(state.momentum))
+
+    assert max_shard(t_wus.state) * jax.device_count() \
+        <= max_shard(t_repl.state)
+
+
+# --------------------------------------------------- momentum layout + disk
+
+def _nonzero_wus(params, quantized=False):
+    mom = zero_lib.init_wus_momentum(params, N, quantized=quantized)
+    rng = np.random.default_rng(7)
+    mom["buf"] = jax.tree_util.tree_map(
+        lambda b: jnp.asarray(rng.normal(size=b.shape).astype(np.float32)),
+        mom["buf"])
+    # Zero the dead padding tail of the last chunk (gather drops it, so a
+    # round-trip comparison must not depend on it).
+    mom["buf"] = zero_lib.shard_momentum(
+        zero_lib.gather_momentum(mom, params), mom["buf"])
+    return mom
+
+
+def test_gather_shard_momentum_roundtrip():
+    """gather(...) flattens the stacked chunks to the exact param-shaped
+    tree; shard(...) re-chunks it back bit-exactly (padding dropped)."""
+    _, variables = _mlp_variables()
+    params = variables["params"]
+    mom = _nonzero_wus(params)
+    gathered = zero_lib.gather_momentum(mom, params)
+    for g, p in zip(jax.tree_util.tree_leaves(gathered),
+                    jax.tree_util.tree_leaves(params)):
+        assert np.shape(g) == np.shape(p)
+    rechunked = zero_lib.shard_momentum(gathered, mom["buf"])
+    _leaves_allclose(rechunked, mom["buf"], rtol=0, atol=0)
+
+
+def test_checkpoint_sharded_momentum_roundtrip(tmp_path):
+    """Disk always stores the param-shaped momentum (gather-on-save); a
+    wus template re-chunks it on restore with agerr reset to zeros."""
+    _, variables = _mlp_variables()
+    state = TrainState.create(
+        variables, _nonzero_wus(variables["params"], quantized=True))
+    path = save_checkpoint(str(tmp_path), state, 0, "mlp", 0.0, False)
+
+    template = TrainState.create(
+        jax.tree_util.tree_map(jnp.zeros_like, variables),
+        zero_lib.init_wus_momentum(variables["params"], N, quantized=True))
+    loaded, _ = load_checkpoint(path, template)
+    _leaves_allclose(loaded.momentum["buf"], state.momentum["buf"],
+                     rtol=0, atol=0)
+    for leaf in jax.tree_util.tree_leaves(loaded.momentum["agerr"]):
+        np.testing.assert_array_equal(np.asarray(leaf), 0.0)
+
+
+def test_checkpoint_mode_switch_both_directions(tmp_path):
+    """legacy-replicated -> wus and wus -> replicated both restore: the
+    param-shaped disk layout is the lingua franca."""
+    _, variables = _mlp_variables()
+    rng = np.random.default_rng(9)
+    repl_mom = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.normal(size=np.shape(p))
+                              .astype(np.float32)),
+        variables["params"])
+
+    # replicated save -> wus restore
+    repl_state = TrainState.create(variables, repl_mom)
+    p1 = save_checkpoint(str(tmp_path / "a"), repl_state, 0, "mlp",
+                         0.0, False)
+    wus_template = TrainState.create(
+        jax.tree_util.tree_map(jnp.zeros_like, variables),
+        zero_lib.init_wus_momentum(variables["params"], N))
+    as_wus, _ = load_checkpoint(p1, wus_template)
+    _leaves_allclose(
+        zero_lib.gather_momentum(as_wus.momentum, as_wus.params),
+        repl_mom, rtol=0, atol=0)
+
+    # wus save -> replicated restore
+    wus_state = TrainState.create(variables,
+                                  _nonzero_wus(variables["params"]))
+    p2 = save_checkpoint(str(tmp_path / "b"), wus_state, 0, "mlp",
+                         0.0, False)
+    repl_template = TrainState.create(
+        jax.tree_util.tree_map(jnp.zeros_like, variables),
+        sgd_init(variables["params"]))
+    as_repl, _ = load_checkpoint(p2, repl_template)
+    _leaves_allclose(
+        as_repl.momentum,
+        zero_lib.gather_momentum(wus_state.momentum, wus_state.params),
+        rtol=0, atol=0)
+
+
+def test_wus_kill_and_resume_parity(tmp_path):
+    """ISSUE-9 acceptance: a --zero wus run preempted mid-stream resumes
+    through the gather-on-save/shard-on-restore layout and finishes with
+    the SAME final parameters and loss as the uninterrupted wus run."""
+    from pytorch_distributed_tpu.utils.preempt import PreemptionGuard
+
+    mesh = build_mesh(MeshSpec(("data",), (jax.device_count(),)))
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(64, 16, 32)
+    d = str(tmp_path / "ckpt")
+
+    def trainer(**kw):
+        return LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
+                         eval_dataset=None, zero="wus", **kw)
+
+    with mesh:
+        ref = trainer()
+        loss_ref = ref.fit(8, print_freq=4)
+
+        guard = PreemptionGuard(signals=(signal.SIGUSR1,)).install()
+        try:
+            t1 = trainer(checkpoint_dir=d, save_steps=2, preempt=guard,
+                         chaos=ChaosSchedule(SignalAt(4, signal.SIGUSR1)))
+            t1.fit(8, print_freq=1)
+        finally:
+            guard.uninstall()
+        stop = int(t1.state.step)
+        assert 0 < stop < 8
+
+        t2 = trainer(checkpoint_dir=d,
+                     resume=os.path.join(d, CHECKPOINT_NAME))
+        assert t2._start_step == stop
+        loss2 = t2.fit(8, print_freq=4)
+    assert loss2 == pytest.approx(loss_ref, rel=1e-6)
+    _leaves_allclose(jax.device_get(ref.state.params),
+                     jax.device_get(t2.state.params), rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------------- shardlint + wires
+
+def test_shardlint_declared_zero_promotes_to_error(get_lowering):
+    """A GSPMD step that keeps replicated param-shaped momentum is an info
+    note under plain DP but a hard error once the step declares --zero wus
+    (the sharding silently fell back)."""
+    from pytorch_distributed_tpu.analysis import core
+
+    low = get_lowering("train_image_gspmd")
+    plain = core.analyze_lowering(low, min_replicated_bytes=1)
+    infos = [f for f in plain.findings if f.kind == "replicated-state"]
+    assert infos and all(f.severity == "info" for f in infos)
+
+    declared = core.analyze_lowering(low, min_replicated_bytes=1,
+                                     declared_zero=True)
+    errors = [f for f in declared.findings if f.kind == "replicated-state"]
+    assert errors and all(f.severity == "error" for f in errors)
+
+
+def test_shardlint_zero_recipes_green(get_lowering):
+    """The real zero recipes carry no replicated optimizer state and no
+    error findings — at the declared_zero severity analyze_recipe applies
+    to them (analysis.core.ZERO_RECIPES)."""
+    from pytorch_distributed_tpu.analysis import core
+
+    for name in sorted(core.ZERO_RECIPES):
+        rep = core.analyze_lowering(get_lowering(name), declared_zero=True)
+        assert not [f for f in rep.findings
+                    if f.kind == "replicated-state"], (name, rep.findings)
+        assert not [f for f in rep.findings
+                    if f.severity == "error"], (name, rep.findings)
+    kinds = {
+        e.kind for e in __import__(
+            "pytorch_distributed_tpu.obs.comms",
+            fromlist=["comms"]).ledger_from_hlo_text(
+            get_lowering("train_image_zero").text).entries}
+    assert {"reduce-scatter", "all-gather"} <= kinds
+
+
+def test_zero_wire_parity_and_analytic_fence(get_lowering):
+    """RS+AG wire bytes == the ring all-reduce's (ratio ~1, padding
+    aside), for every compression mode; and the analytic model lands
+    within ±15% of the compiled train_image_zero ledger."""
+    from pytorch_distributed_tpu.obs import comms
+    from pytorch_distributed_tpu.obs.flops import (
+        comm_residual_pct,
+        image_comm_bytes_zero,
+        zero_wire_parity,
+    )
+
+    low = get_lowering("train_image_zero")
+    leaf_sizes = [l.size for l in
+                  jax.tree_util.tree_leaves(low.args[0].params)]
+    for mode in ("none", "bf16", "int8"):
+        parity = zero_wire_parity(leaf_sizes, dp=N, mode=mode)
+        assert 0.98 <= parity["ratio"] <= 1.02, (mode, parity)
+
+    lg = comms.ledger_from_hlo_text(low.text, step="train_image_zero",
+                                    mesh_shape=low.mesh_shape)
+    pred = image_comm_bytes_zero(leaf_sizes, dp=N)
+    assert comm_residual_pct(pred.total_bytes, lg.total_bytes) <= 15.0, (
+        pred.total_bytes, lg.total_bytes)
