@@ -14,6 +14,12 @@ Complements the detection layers — ``obs/`` (stragglers, metrics) and
   SIGKILL at step k, NaN batches, LR spikes, per-rank delay, byte-level
   checkpoint corruption) driving the survival tests and
   ``scripts/chaoskit.py``.
+- ``elastic``    — membership-epoch coordination and exact cross-world
+  re-sharding (ISSUE 10): ``ElasticCoordinator`` folds heartbeat liveness
+  into join/leave decisions, ``ElasticSim`` drives the in-process drills,
+  ``LoseRankAt``/``JoinRankAt`` inject membership changes, and the regrid
+  helpers move stacked ZeRO-WUS momentum / error-feedback residuals
+  between world sizes losslessly.
 
 Step-granular resume itself lives in the trainers + ``train/checkpoint``
 (``--save-steps``, iterator state in the checkpoint's ``ft`` record).
@@ -30,6 +36,18 @@ from pytorch_distributed_tpu.ft.chaos import (
     corrupt_file,
 )
 from pytorch_distributed_tpu.ft.divergence import DivergenceGuard, StateKeeper
+from pytorch_distributed_tpu.ft.elastic import (
+    ElasticCoordinator,
+    ElasticSim,
+    JoinRankAt,
+    LoseRankAt,
+    Membership,
+    MembershipChange,
+    regrid_stacked_residual,
+    regrid_wus_momentum,
+    rescale_batch,
+    rescale_lr,
+)
 from pytorch_distributed_tpu.ft.integrity import (
     CheckpointCorruptError,
     check_integrity,
@@ -48,13 +66,23 @@ __all__ = [
     "ChaosSchedule",
     "DelayRank",
     "DivergenceGuard",
+    "ElasticCoordinator",
+    "ElasticSim",
+    "JoinRankAt",
     "KillAt",
     "LRSpikeAt",
+    "LoseRankAt",
+    "Membership",
+    "MembershipChange",
     "NaNBatchAt",
     "SignalAt",
     "StateKeeper",
     "check_integrity",
     "corrupt_file",
+    "regrid_stacked_residual",
+    "regrid_wus_momentum",
+    "rescale_batch",
+    "rescale_lr",
     "file_sha256",
     "read_sidecar",
     "replace_with_sidecar",

@@ -12,6 +12,10 @@ the watchdog's ``recompile`` events — into a badput taxonomy:
                         step of the resumed run (the restart appends to
                         the same JSONL, so the gap is visible in one file);
 - ``recompile``         post-warmup compilation time (obs/watchdog.py);
+- ``remesh``            wall time between an elastic membership change
+                        (shrink/grow, ft/elastic.py) and the first step on
+                        the rebuilt mesh — teardown, re-shard, and the
+                        recompile at the new world size all land here;
 - ``stall``             inter-step wall gaps far beyond the step-time p95
                         with no event explaining them — data starvation,
                         checkpoint I/O, or eval, all "not training".
@@ -27,7 +31,7 @@ import dataclasses
 from typing import Any, Dict, List, Optional
 
 BADPUT_KINDS = ("nan_skip", "rollback_discard", "preempt_gap", "recompile",
-                "stall")
+                "remesh", "stall")
 
 
 @dataclasses.dataclass
@@ -120,11 +124,20 @@ def compute_goodput(records: List[dict], stall_factor: float = 5.0,
         elif kind == "recompile":
             counts["recompile"] += 1
             badput["recompile"] += float(e.get("duration_s", 0.0))
+        elif kind == "remesh":
+            # Like preempt: the cost is the gap between the membership
+            # change and the first step on the rebuilt mesh (re-shard +
+            # recompile at the new world size).
+            counts["remesh"] += 1
+            t0 = e.get("t")
+            nxt = [r["t"] for r in steps if r.get("t", 0.0) > (t0 or 0.0)]
+            if t0 is not None and nxt:
+                badput["remesh"] += min(nxt) - t0
 
     # Stall scan: unexplained inter-step wall gaps.  Gaps that contain a
-    # preemption event are already booked above.
+    # preemption or remesh event are already booked above.
     event_ts = [e.get("t", 0.0) for e in events
-                if str(e["ft_event"]) == "preempt"]
+                if str(e["ft_event"]) in ("preempt", "remesh")]
     floor = max(stall_min_s, stall_factor * p95)
     for a, b in zip(steps, steps[1:]):
         if "t" not in a or "t" not in b:

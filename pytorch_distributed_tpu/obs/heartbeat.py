@@ -11,7 +11,21 @@ before a hung collective turns into a silent pod-wide stall.
 Deliberately stdlib-only (no jax import): the monitor side runs anywhere —
 a login node, a cron job, a test harness — without touching the TPU
 runtime, and the writer adds no device work to the hot loop (one small
-append per ``interval_s``).
+file rewrite per ``interval_s``).
+
+Beats are now *liveness evidence* for elastic membership decisions
+(ft/elastic.py), which hardens two soft spots of the original appender:
+
+- **Atomic writes.**  Each beat rewrites the whole (capped) line buffer to
+  a tmp file and ``os.replace``s it into place, so a reader never sees a
+  torn line and a SIGKILLed writer leaves a fully-parseable file — the
+  walk-back in ``read_heartbeats`` is now a belt, not the load-bearing
+  strap.
+- **Membership epoch.**  Every beat stamps the writer's ``epoch`` and
+  ``world``.  After a re-mesh bumps the epoch, beats from a prior
+  incarnation (an evicted rank still flushing, a stale file from before a
+  restart) are filtered by ``read_heartbeats(min_epoch=...)`` instead of
+  masquerading as live members.
 """
 
 from __future__ import annotations
@@ -34,14 +48,38 @@ class HeartbeatWriter:
     the run ends mid-interval.
     """
 
+    #: Lines retained per heartbeat file; the monitor only ever reads the
+    #: newest parseable record, older lines are debugging history.
+    MAX_LINES = 512
+
     def __init__(self, hb_dir: str, process_index: int = 0,
-                 interval_s: float = 5.0):
+                 interval_s: float = 5.0, world: Optional[int] = None,
+                 epoch: int = 0):
         self.dir = hb_dir
         self.process_index = int(process_index)
         self.interval_s = float(interval_s)
+        # Membership identity: the trainer bumps these on re-mesh so every
+        # subsequent beat is attributable to the new incarnation.
+        self.world = None if world is None else int(world)
+        self.epoch = int(epoch)
         self.path = os.path.join(hb_dir, f"{_PREFIX}{self.process_index:05d}.jsonl")
         os.makedirs(hb_dir, exist_ok=True)
         self._last = float("-inf")
+        self._lines: list = []
+        if os.path.exists(self.path):
+            # A restarted incarnation inherits the file; keep its tail as
+            # history rather than clobbering forensic context.
+            try:
+                with open(self.path) as f:
+                    self._lines = f.read().splitlines()[-self.MAX_LINES:]
+            except OSError:
+                self._lines = []
+
+    def set_membership(self, world: int, epoch: int) -> None:
+        """Called by the trainer on re-mesh: subsequent beats carry the new
+        world size and membership epoch."""
+        self.world = int(world)
+        self.epoch = int(epoch)
 
     def beat(self, step: int, force: bool = False,
              step_time_ema: Optional[float] = None,
@@ -56,13 +94,23 @@ class HeartbeatWriter:
         if not force and now - self._last < self.interval_s:
             return False
         self._last = now
-        rec = {"pid": self.process_index, "step": int(step), "t": now}
+        rec = {"pid": self.process_index, "step": int(step), "t": now,
+               "epoch": self.epoch}
+        if self.world is not None:
+            rec["world"] = self.world
         if step_time_ema is not None:
             rec["ema"] = float(step_time_ema)
         if last_ft is not None:
             rec["last_ft"] = str(last_ft)
-        with open(self.path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        self._lines.append(json.dumps(rec))
+        del self._lines[:-self.MAX_LINES]
+        # Atomic rewrite: liveness decisions (elastic eviction) must never
+        # act on a torn record, and a writer killed mid-beat must leave
+        # the previous complete file behind, not a half-written line.
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("\n".join(self._lines) + "\n")
+        os.replace(tmp, self.path)
         return True
 
     def close(self, step: Optional[int] = None,
@@ -73,11 +121,18 @@ class HeartbeatWriter:
                       last_ft=last_ft)
 
 
-def read_heartbeats(hb_dir: str) -> Dict[int, dict]:
-    """Latest beat per process: ``{pid: {"pid", "step", "t"}}``.
+def read_heartbeats(hb_dir: str,
+                    min_epoch: Optional[int] = None) -> Dict[int, dict]:
+    """Latest beat per process: ``{pid: {"pid", "step", "t", ...}}``.
 
-    Tolerates a torn final line (a writer killed mid-append) by walking
-    back to the newest parseable record.
+    Tolerates a torn final line (a writer killed mid-append, or a file
+    from before the atomic-rewrite hardening) by walking back to the
+    newest parseable record.
+
+    ``min_epoch`` filters out beats stamped with an older membership
+    epoch: after a re-mesh, a prior incarnation's beats must not be
+    mistaken for live ranks.  Beats without an epoch field (pre-elastic
+    writers) count as epoch 0.
     """
     beats: Dict[int, dict] = {}
     if not os.path.isdir(hb_dir):
@@ -90,6 +145,9 @@ def read_heartbeats(hb_dir: str) -> Dict[int, dict]:
         for line in reversed(lines):
             try:
                 rec = json.loads(line)
+                if (min_epoch is not None
+                        and int(rec.get("epoch", 0)) < min_epoch):
+                    break  # newest record is stale; older ones are too
                 beats[int(rec["pid"])] = rec
                 break
             except (ValueError, KeyError, TypeError):

@@ -37,6 +37,7 @@ import argparse
 import jax
 import jax.numpy as jnp
 
+from pytorch_distributed_tpu.ft.elastic import ElasticSim
 from pytorch_distributed_tpu.models.transformer import TransformerLM
 from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh, initialize
 from pytorch_distributed_tpu.parallel.tp import replicated_like, tp_specs
@@ -170,6 +171,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ft-lr-backoff", type=float, default=0.5,
                    dest="ft_lr_backoff", metavar="F",
                    help="LR multiplier applied at each rollback")
+    p.add_argument("--elastic", action="store_true", dest="elastic",
+                   help="elastic training (ft/elastic.py): on rank loss "
+                        "re-mesh to the survivors and continue from the "
+                        "last-good snapshot; on rank join re-shard and "
+                        "re-admit (plain-dp meshes only)")
+    p.add_argument("--min-ranks", type=int, default=1, dest="min_ranks",
+                   metavar="N",
+                   help="elastic shrink floor: refuse changes that would "
+                        "take the data axis below N ranks")
+    p.add_argument("--rescale-lr", choices=("none", "linear", "sqrt"),
+                   default="none", dest="rescale_lr",
+                   help="LR/global-batch rule across an elastic world "
+                        "change: none = global batch constant, LR "
+                        "untouched; linear/sqrt = per-rank batch constant, "
+                        "LR scaled by (new/old) or sqrt(new/old)")
     p.add_argument("--dataset-length", type=int, default=4096)
     p.add_argument("--text-glob", type=str, default=None,
                    help="train on real files: byte-level LM over this glob "
@@ -284,6 +300,14 @@ def main(argv=None) -> float:
     if args.generate > 0 and (args.tp > 1 or args.sp > 1 or args.ep > 1
                               or args.pp > 1):
         raise SystemExit("--generate supports plain dp runs only")
+    if args.elastic and (args.tp > 1 or args.sp > 1 or args.ep > 1
+                         or args.pp > 1 or args.fsdp):
+        raise SystemExit("--elastic re-meshes the data axis and supports "
+                         "plain dp runs only (drop --tp/--sp/--ep/--pp/"
+                         "--fsdp)")
+    if not args.elastic and args.rescale_lr != "none":
+        raise SystemExit("--rescale-lr applies to elastic world changes; "
+                         "add --elastic")
     if args.sp_impl == "a2a" and args.sp > 1:
         if args.pp > 1:
             raise SystemExit("--sp-impl a2a does not run inside pipeline "
@@ -457,6 +481,10 @@ def main(argv=None) -> float:
             preempt=guard,
             grad_compress=args.grad_compress,
             zero=args.zero,
+            elastic=(ElasticSim(dict(mesh.shape).get("data", 1),
+                                min_ranks=args.min_ranks)
+                     if args.elastic else None),
+            rescale_lr=args.rescale_lr,
         )
         try:
             final_loss = trainer.fit(args.steps, print_freq=args.print_freq)

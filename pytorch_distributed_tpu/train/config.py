@@ -102,6 +102,14 @@ class Config:
     ft_check_every: int = 10
     ft_lr_backoff: float = 0.5
     preempt_signals: str = "term"
+    # Elastic training (ft/elastic.py): re-mesh on rank loss/join and
+    # re-shard state from the last-good snapshot.  min_ranks is the shrink
+    # floor; rescale_lr picks the LR/global-batch rule across a world
+    # change ("none" holds the global batch constant and the LR untouched;
+    # "linear"/"sqrt" hold the per-rank batch constant and scale the LR).
+    elastic: bool = False
+    min_ranks: int = 1
+    rescale_lr: str = "none"
     epoch_csv: Optional[str] = None
     profile_dir: Optional[str] = None
     # Profiler capture windows (obs/trace.py ProfileWindow): 'E' or 'A:B'
@@ -255,6 +263,24 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    help="comma-separated signals the preemption guard traps "
                    "(default 'term'; add 'int' for interactive Ctrl-C runs, "
                    "e.g. 'term,int')")
+    p.add_argument("--elastic", action="store_true", dest="elastic",
+                   help="elastic training (ft/elastic.py): on rank loss "
+                   "re-mesh to the survivors and continue from the "
+                   "last-good snapshot; on rank join re-shard and re-admit "
+                   "— every shrink/grow is a 'remesh' ft_event the goodput "
+                   "ledger books")
+    p.add_argument("--min-ranks", default=d.min_ranks, type=int,
+                   dest="min_ranks", metavar="N",
+                   help="elastic shrink floor: refuse membership changes "
+                   "that would take the data axis below N ranks "
+                   "(default 1)")
+    p.add_argument("--rescale-lr", default=d.rescale_lr,
+                   choices=("none", "linear", "sqrt"), dest="rescale_lr",
+                   help="LR/global-batch rule across an elastic world "
+                   "change: none = hold the global batch constant, LR "
+                   "untouched (parity default); linear/sqrt = hold the "
+                   "per-rank batch constant and scale the LR by (new/old) "
+                   "or sqrt(new/old)")
     p.add_argument("--epoch-csv", default=d.epoch_csv, type=str,
                    help="append [timestamp, epoch_seconds] rows to this CSV")
     p.add_argument("--profile-dir", default=d.profile_dir, type=str,

@@ -550,6 +550,8 @@ class LMTrainer:
         chaos=None,
         grad_compress: Optional[str] = None,
         zero: Optional[str] = None,
+        elastic=None,
+        rescale_lr: str = "none",
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -589,7 +591,18 @@ class LMTrainer:
         LM GSPMD step, see ``make_lm_train_step``); ``zero``: ``none|wus``
         weight-update sharding (parallel/zero.py) — momentum leaves take
         ``fsdp_specs`` data-axis shardings over the param specs, 1/N
-        optimizer bytes per device, identical numerics and checkpoints."""
+        optimizer bytes per device, identical numerics and checkpoints.
+
+        Elastic training (ft/elastic.py): ``elastic`` is a membership
+        controller (``ElasticSim`` in-process, or any object with
+        ``poll(step) -> MembershipChange | None``); on a change ``fit``
+        tears down and rebuilds the mesh/shardings/feeder/jitted steps
+        from the survivor set and re-shards the last-good ``StateKeeper``
+        snapshot onto the new topology.  ``rescale_lr`` is the rescale
+        rule across a world change: ``none`` holds the *global* batch
+        constant (LR untouched — the parity-fence default), ``linear`` /
+        ``sqrt`` hold the *per-rank* batch constant and scale the LR by
+        (new/old) or sqrt(new/old)."""
         from pytorch_distributed_tpu.parallel import zero as zero_lib
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
@@ -616,67 +629,52 @@ class LMTrainer:
         )
         self.grad_compress, _ = qcomm.resolve_mode(grad_compress, None)
         self.zero = zero_lib.resolve_zero(zero)
-        self._mom_specs = (
-            zero_lib.zero_momentum_specs(params, mesh,
-                                         base_specs=self.param_specs)
-            if self.zero == "wus" else None)
-        residual = qcomm.init_residual(params, self.grad_compress,
-                                       explicit=False)
-        state = TrainState.create({"params": params}, sgd_init(params),
-                                  residual=residual)
-        self.state = shard_state(state, self.param_specs, mesh,
-                                 momentum_specs=self._mom_specs)
         self.lr_schedule = lr_schedule
-        self.step_fn = make_lm_train_step(model, mesh, self.param_specs,
-                                          clip_grad_norm=clip_grad_norm,
-                                          accum_steps=accum_steps,
-                                          fused_ce_chunks=fused_ce_chunks,
-                                          fused_ce_mode=fused_ce_mode,
-                                          # in-graph norms only when a
-                                          # metrics sink will consume them
-                                          log_norms=bool(metrics_jsonl),
-                                          guard_nonfinite=nan_guard,
-                                          grad_compress=self.grad_compress,
-                                          zero=self.zero, params=params)
-        self.token_sharding = NamedSharding(mesh, P("data", None))
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every
         self.eval_batches = eval_batches
         self.best_ppl = float("inf")
         self.eval_history: list = []  # (loss, ppl, acc%) per evaluate() call
         self.prefetch = prefetch
-        self._span = None  # this process's batch-row range, computed once
-        self._agree = None  # lazy PreemptionAgreement (see utils/preempt.py)
-        self._eval_fn = (
-            make_lm_eval_step(
-                model, mesh, self.param_specs,
-                has_residual=self.grad_compress in qcomm.QUANTIZED_MODES,
-                momentum_specs=self._mom_specs)
-            if eval_dataset is not None
-            else None
-        )
+        # ---- elastic membership (ft/elastic.py) ----
+        from pytorch_distributed_tpu.ft import elastic as elastic_lib
+
+        if rescale_lr not in elastic_lib.RESCALE_RULES:
+            raise ValueError(f"rescale_lr must be one of "
+                             f"{elastic_lib.RESCALE_RULES}, got {rescale_lr!r}")
+        self.elastic = elastic
+        self.rescale_lr_rule = rescale_lr
+        self._elastic_lr_scale = 1.0
+        self._membership_epoch = 0
+        # Everything mesh-shape-dependent lives in _build_for_mesh so a
+        # membership change can rebuild it against the survivor set.
+        self._step_kwargs = dict(
+            clip_grad_norm=clip_grad_norm, accum_steps=accum_steps,
+            fused_ce_chunks=fused_ce_chunks, fused_ce_mode=fused_ce_mode,
+            # in-graph norms only when a metrics sink will consume them
+            log_norms=bool(metrics_jsonl), guard_nonfinite=nan_guard)
+        self._build_for_mesh(mesh, params)
+        residual = qcomm.init_residual(params, self.grad_compress,
+                                       explicit=False)
+        state = TrainState.create({"params": params}, sgd_init(params),
+                                  residual=residual)
+        self.state = shard_state(state, self.param_specs, mesh,
+                                 momentum_specs=self._mom_specs)
         from pytorch_distributed_tpu.obs import HeartbeatWriter, MetricsLogger
 
         self.obs = MetricsLogger(metrics_jsonl,
                                  process_index=jax.process_index())
         self.hb = (HeartbeatWriter(hb_dir, jax.process_index(),
-                                   interval_s=hb_interval_s)
+                                   interval_s=hb_interval_s,
+                                   world=dict(mesh.shape).get("data", 1),
+                                   epoch=self._membership_epoch)
                    if hb_dir else None)
 
         # ---- efficiency accounting (obs/) ----
         self._mfu = None
+        self._mfu_on = mfu
         if mfu:
-            from pytorch_distributed_tpu.obs.flops import (
-                MFUReporter,
-                device_peak_flops,
-                lm_step_cost_for,
-            )
-
-            cost = lm_step_cost_for(model, batch_size, dataset.seq_len,
-                                    fused_ce_chunks=fused_ce_chunks)
-            dev = mesh.devices.flat[0]
-            self._mfu = MFUReporter(cost, n_devices=mesh.devices.size,
-                                    peak_per_chip=device_peak_flops(dev))
+            self._build_mfu()
         self._goodput = None
         if goodput:
             from pytorch_distributed_tpu.obs.goodput import GoodputTracker
@@ -701,11 +699,16 @@ class LMTrainer:
         self.ft_guard = None
         self._keeper = None
         if nan_guard:
-            from pytorch_distributed_tpu.ft import DivergenceGuard, StateKeeper
+            from pytorch_distributed_tpu.ft import DivergenceGuard
 
             self.ft_guard = DivergenceGuard(
                 rollback_k=ft_rollback_k, check_every=ft_check_every,
                 lr_backoff=ft_lr_backoff, obs=self.obs)
+        if nan_guard or self.elastic is not None:
+            # Elastic re-meshing re-shards from the same last-good host
+            # snapshot the divergence guard rolls back to.
+            from pytorch_distributed_tpu.ft import StateKeeper
+
             self._keeper = StateKeeper()
         self._start_step = 0
         if resume:
@@ -725,6 +728,128 @@ class LMTrainer:
                 self.best_ppl = float(meta["best_acc1"])
             print(f"=> resumed {meta['arch']} from '{resume}' at step "
                   f"{self._start_step}", flush=True)
+
+    def _build_for_mesh(self, mesh: Mesh, params) -> None:
+        """Build (or rebuild) every mesh-shape-dependent piece against
+        ``mesh``: momentum shardings, the jitted train/eval steps, the
+        token sharding, and the caches keyed to the old topology (row
+        span, preemption agreement, comm-ledger fields).  Called once
+        from ``__init__`` and again on every elastic ``remesh`` — this is
+        the mesh-shape-agnostic seam the ISSUE's refactor names."""
+        from pytorch_distributed_tpu.parallel import zero as zero_lib
+
+        self.mesh = mesh
+        self._mom_specs = (
+            zero_lib.zero_momentum_specs(params, mesh,
+                                         base_specs=self.param_specs)
+            if self.zero == "wus" else None)
+        self.step_fn = make_lm_train_step(self.model, mesh, self.param_specs,
+                                          grad_compress=self.grad_compress,
+                                          zero=self.zero, params=params,
+                                          **self._step_kwargs)
+        self.token_sharding = NamedSharding(mesh, P("data", None))
+        self._eval_fn = (
+            make_lm_eval_step(
+                self.model, mesh, self.param_specs,
+                has_residual=self.grad_compress in qcomm.QUANTIZED_MODES,
+                momentum_specs=self._mom_specs)
+            if self.eval_dataset is not None else None)
+        self._span = None   # per-process row range: topology-keyed
+        self._agree = None  # lazy PreemptionAgreement holds the old mesh
+        self._comm_fields = None  # ledger re-emits against the new mesh
+
+    def _build_mfu(self) -> None:
+        from pytorch_distributed_tpu.obs.flops import (
+            MFUReporter,
+            device_peak_flops,
+            lm_step_cost_for,
+        )
+
+        cost = lm_step_cost_for(
+            self.model, self.batch_size, self.dataset.seq_len,
+            fused_ce_chunks=self._step_kwargs["fused_ce_chunks"])
+        dev = self.mesh.devices.flat[0]
+        self._mfu = MFUReporter(cost, n_devices=self.mesh.devices.size,
+                                peak_per_chip=device_peak_flops(dev))
+
+    def remesh(self, new_world: int, completed: int,
+               refresh_snapshot: bool = True) -> int:
+        """Re-mesh to ``new_world`` data-parallel devices: rebuild mesh /
+        shardings / jitted steps from the survivor set and re-shard the
+        last-good ``StateKeeper`` snapshot onto the new topology.  Returns
+        the resume step (the snapshot's step — a shrink rewinds to the
+        last state the dead rank could not have tainted; a grow refreshes
+        the snapshot first, so it resumes where it left off).
+
+        LM state re-shards without layout surgery: params, GSPMD momentum
+        (param-shaped, ``zero_momentum_specs``-sharded under ``wus``), and
+        the quantized-emulation residual are all param-shaped host leaves,
+        and ``shard_state`` places them under any mesh — the same "any
+        shape resumes any shape" property the checkpoints already prove.
+        (The explicit stacked layouts live in the image ``Trainer``, which
+        re-grids them via ft/elastic.py.)"""
+        axes = tuple(self.mesh.axis_names)
+        if axes != ("data",):
+            raise ValueError(
+                f"elastic re-mesh supports pure data-parallel meshes; "
+                f"this trainer's mesh has axes {axes}")
+        devs = jax.devices()
+        if not 1 <= new_world <= len(devs):
+            raise ValueError(
+                f"new world {new_world} outside [1, {len(devs)}] devices")
+        old_world = dict(self.mesh.shape)["data"]
+        if self._keeper is None:
+            from pytorch_distributed_tpu.ft import StateKeeper
+
+            self._keeper = StateKeeper()
+        if refresh_snapshot or not self._keeper.has_snapshot:
+            self._keeper.update(self.state, completed)
+        host = self._keeper.restore()
+        resume = int(self._keeper.step)
+        from pytorch_distributed_tpu.ft import elastic as elastic_lib
+
+        if self.rescale_lr_rule != "none":
+            self.batch_size = elastic_lib.rescale_batch(
+                self.batch_size, old_world, new_world, self.rescale_lr_rule)
+            self._elastic_lr_scale *= elastic_lib.rescale_lr(
+                1.0, old_world, new_world, self.rescale_lr_rule)
+        if self.batch_size % new_world:
+            raise ValueError(
+                f"global batch {self.batch_size} does not divide the new "
+                f"data axis ({new_world} devices); pick --min-ranks / batch "
+                "so every admissible world divides it")
+        from pytorch_distributed_tpu.parallel.mesh import MeshSpec, build_mesh
+        from pytorch_distributed_tpu.parallel.tp import shard_state
+
+        new_mesh = build_mesh(MeshSpec(("data",), (new_world,)),
+                              devices=devs[:new_world])
+        self._build_for_mesh(new_mesh, host.params)
+        self.state = shard_state(host, self.param_specs, new_mesh,
+                                 momentum_specs=self._mom_specs)
+        if self._mfu_on:
+            self._build_mfu()  # n_devices (and maybe batch) changed
+        self._membership_epoch += 1
+        if self.hb is not None:
+            self.hb.set_membership(new_world, self._membership_epoch)
+        return resume
+
+    def _apply_remesh(self, chg, at_step: int) -> int:
+        """Act on a committed ``MembershipChange`` inside ``fit``: log the
+        ``remesh`` ft_event (goodput books the gap to the first step on
+        the new mesh as ``remesh`` badput) and rebuild.  Returns the
+        resume step."""
+        kind = chg.kind
+        old_world = dict(self.mesh.shape)["data"]
+        self.obs.log_event("remesh", step=at_step, change=kind,
+                           old_world=chg.old.world, new_world=chg.new.world,
+                           epoch=chg.new.epoch, reason=chg.reason,
+                           rescale=self.rescale_lr_rule)
+        resume = self.remesh(chg.new.world, completed=at_step,
+                             refresh_snapshot=(kind == "grow"))
+        print(f"=> remesh ({kind}) at step {at_step}: world "
+              f"{old_world}->{chg.new.world}, epoch {chg.new.epoch}, "
+              f"resuming at step {resume} ({chg.reason})", flush=True)
+        return resume
 
     def _row_span(self) -> Tuple[int, int]:
         """This process's row range of the global batch under the token
@@ -881,6 +1006,23 @@ class LMTrainer:
                   f"{ledger.total_bytes} B/step payload) to "
                   f"{self._comm_ledger_path}", flush=True)
 
+    def _token_iter(self, start: int, steps: int):
+        """Token stream for logical steps ``[start, steps)`` — prefetched
+        via AsyncFeeder or synchronous.  Factored out so an elastic
+        re-mesh can rebuild it mid-fit: the generators bind ``self``
+        lazily, so a fresh iterator picks up the new batch size, row span,
+        and token sharding."""
+        from pytorch_distributed_tpu.data.loader import AsyncFeeder
+
+        host_iter = (
+            self._local_batch(self.dataset, i) for i in range(start, steps)
+        )
+        if self.prefetch > 0:
+            return AsyncFeeder(self._put_tokens,
+                               prefetch=self.prefetch)(host_iter)
+        # synchronous baseline (measured in lm_feeder_bench)
+        return (self._put_tokens(b) for b in host_iter)
+
     def fit(self, steps: int, print_freq: int = 10) -> float:
         from pytorch_distributed_tpu.obs import scope
 
@@ -902,19 +1044,10 @@ class LMTrainer:
         # windows) + async transfer dispatch run on a producer thread, off
         # the step hot path — the LM counterpart of the image DeviceFeeder
         # (reference apex data_prefetcher, apex_distributed.py:115-169).
-        from pytorch_distributed_tpu.data.loader import AsyncFeeder
-
         # Each process assembles ONLY its own rows (wraparound batching,
         # the convention both LM datasets implement); a resumed run starts
         # the stream at the checkpointed step — no epoch rerun.
-        host_iter = (
-            self._local_batch(self.dataset, i) for i in range(start, steps)
-        )
-        if self.prefetch > 0:
-            token_iter = AsyncFeeder(self._put_tokens,
-                                     prefetch=self.prefetch)(host_iter)
-        else:  # synchronous baseline (measured in lm_feeder_bench)
-            token_iter = (self._put_tokens(b) for b in host_iter)
+        token_iter = self._token_iter(start, steps)
         if self._keeper is not None and not self._keeper.has_snapshot:
             # Initial last-good snapshot (all ranks — see StateKeeper).
             self._keeper.update(self.state, start)
@@ -922,7 +1055,8 @@ class LMTrainer:
         lr = jnp.float32(self.lr)
         try:
             meters.restart_clock()
-            for i in range(start, steps):
+            i = start
+            while i < steps:
                 # print_freq cadence: the cross-process agreement collective
                 # (see utils/preempt.py) must run at the same step on every
                 # rank, and stays off the per-step hot path.
@@ -935,6 +1069,22 @@ class LMTrainer:
                     break
                 if self.chaos is not None:
                     self.chaos.on_step(self, i)
+                if self.elastic is not None:
+                    chg = self.elastic.poll(i)
+                    if chg is not None:
+                        # Membership changed: rebuild against the survivor
+                        # set and restart the token stream at the resume
+                        # step (a shrink rewinds to the last-good snapshot;
+                        # the step-indexed batching regenerates the same
+                        # tokens, so retrained steps replay, not drift).
+                        token_iter.close()
+                        completed = i = self._apply_remesh(chg, at_step=i)
+                        token_iter = self._token_iter(i, steps)
+                        tokens_per_step = (self.batch_size
+                                           * self.dataset.seq_len)
+                        lr_val = None  # re-push the LR to the new mesh
+                        meters.restart_clock()
+                        continue
                 tokens = next(token_iter)
                 if self.chaos is not None:
                     tokens = self.chaos.on_batch(i, tokens)
@@ -942,6 +1092,7 @@ class LMTrainer:
                        if self.lr_schedule is not None else self.lr)
                 if self.ft_guard is not None:
                     val = val * self.ft_guard.lr_scale
+                val = val * self._elastic_lr_scale
                 if val != lr_val:
                     lr_val, lr = val, jnp.float32(val)
                 if (self._comm_ledger_path is not None
@@ -995,6 +1146,7 @@ class LMTrainer:
                     meters.restart_clock()  # eval must not pollute the meter
                 else:
                     final_ppl = None
+                i += 1
             if self.ft_guard is not None and self.ft_guard.drain():
                 # Trailing flags buffered past the last cadence point must
                 # resolve before the end-of-fit checkpoint can capture a

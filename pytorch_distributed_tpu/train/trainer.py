@@ -174,6 +174,11 @@ class Trainer:
                 "an optax tx cannot be chunked — drop one of them")
 
         seed = cfg.seed if cfg.seed is not None else 0
+        # Stashed for _build_for_mesh: an elastic re-mesh rebuilds the
+        # jitted steps and feeder against the survivor set.
+        self._explicit = explicit_collectives
+        self._tx = tx
+        self._seed = seed
         rng = jax.random.PRNGKey(seed)
         sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
         variables = self.model.init(rng, sample, train=False)
@@ -273,33 +278,10 @@ class Trainer:
                     f"{cfg.accum_steps} must be a whole multiple of the "
                     f"'{self.data_axis}' mesh axis ({shards} shards)"
                 )
-        self.train_step = make_train_step(
-            self.model,
-            self.mesh,
-            momentum=cfg.momentum,
-            weight_decay=cfg.weight_decay,
-            data_axis=data_axis,
-            wire_dtype=(self._grad_cast
-                        if self.grad_compress == "bf16" else None),
-            grad_compress=self.grad_compress,
-            explicit_collectives=explicit_collectives,
-            seed=seed,
-            tx=tx,
-            accum_steps=cfg.accum_steps,
-            # In-graph grad/param norms only when a metrics sink consumes
-            # them — the reductions lengthen compiles, so observability
-            # costs nothing when off.
-            log_norms=bool(cfg.metrics_jsonl),
-            guard_nonfinite=bool(getattr(cfg, "nan_guard", False)),
-            zero=self.zero,
-            params=self.state.params,
-        )
-        self.eval_step = make_eval_step(
-            self.model, self.mesh, data_axis=data_axis,
-            residual_sharded=(explicit_collectives
-                              and self.grad_compress in qcomm.QUANTIZED_MODES),
-            momentum_sharding=self._mom_sharding)
-        self.feeder = DeviceFeeder(self.mesh, data_axis=data_axis)
+        # Everything mesh-shape-dependent (jitted steps, feeder, the
+        # momentum sharding, topology-keyed caches) builds in one place so
+        # an elastic re-mesh can rebuild it against the survivor set.
+        self._build_for_mesh(self.mesh)
         # One observability entry point (obs/): the epoch CSV registers as
         # an epoch sink, a --telemetry-csv sampler registers in fit(), and
         # per-step structured records land in --metrics-jsonl.
@@ -315,18 +297,9 @@ class Trainer:
         # Efficiency accounting (obs/): per-step MFU/HFU from the analytic
         # FLOPs model, the live goodput ledger, and the recompile watchdog.
         self._mfu = None
-        if getattr(cfg, "mfu", False):
-            from pytorch_distributed_tpu.obs.flops import (
-                MFUReporter,
-                device_peak_flops,
-                image_step_cost,
-            )
-
-            cost = image_step_cost(cfg.arch, cfg.batch_size, cfg.image_size,
-                                   cfg.num_classes)
-            dev = self.mesh.devices.flat[0]
-            self._mfu = MFUReporter(cost, n_devices=self.mesh.devices.size,
-                                    peak_per_chip=device_peak_flops(dev))
+        self._mfu_on = bool(getattr(cfg, "mfu", False))
+        if self._mfu_on:
+            self._build_mfu()
         self._goodput = None
         if getattr(cfg, "goodput", False):
             from pytorch_distributed_tpu.obs.goodput import GoodputTracker
@@ -346,6 +319,200 @@ class Trainer:
         # Monotonic logged-train-step counter; a resume restores it so the
         # metrics JSONL step axis continues instead of restarting at 0.
         self._global_step = self._resume_global
+
+        # ---- elastic membership (ft/elastic.py) ----
+        from pytorch_distributed_tpu.ft import elastic as elastic_lib
+
+        self.rescale_lr_rule = str(getattr(cfg, "rescale_lr", "none") or "none")
+        if self.rescale_lr_rule not in elastic_lib.RESCALE_RULES:
+            raise ValueError(
+                f"--rescale-lr must be one of {elastic_lib.RESCALE_RULES}, "
+                f"got {self.rescale_lr_rule!r}")
+        self._elastic_lr_scale = 1.0
+        self._membership_epoch = 0
+        self.elastic = elastic_lib.elastic_controller_from_config(
+            cfg, dict(self.mesh.shape)[self.data_axis])
+        if self.elastic is not None and self._keeper is None:
+            # Re-meshing re-shards from the same last-good host snapshot
+            # the divergence guard rolls back to.
+            from pytorch_distributed_tpu.ft import StateKeeper
+
+            self._keeper = StateKeeper()
+        if self.hb is not None:
+            self.hb.set_membership(dict(self.mesh.shape)[self.data_axis],
+                                   self._membership_epoch)
+
+    def _build_for_mesh(self, mesh: Mesh) -> None:
+        """Build (or rebuild) every mesh-shape-dependent piece against
+        ``mesh``: the momentum sharding, jitted train/eval steps, the
+        device feeder, and the topology-keyed caches (preemption
+        agreement, comm-ledger fields).  Called once from ``__init__`` and
+        again on every elastic ``remesh`` — the mesh-shape-agnostic seam
+        that decouples trainer construction from mesh shape."""
+        from pytorch_distributed_tpu.ops import qcomm
+        from pytorch_distributed_tpu.parallel import zero as zero_lib
+
+        cfg = self.cfg
+        self.mesh = mesh
+        if self.zero == "wus" and self._explicit:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._mom_sharding = NamedSharding(
+                mesh, PartitionSpec(self.data_axis))
+        elif self.zero == "wus":
+            from jax.sharding import NamedSharding
+
+            self._mom_sharding = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                zero_lib.zero_momentum_specs(
+                    self.state.params, mesh, data_axis=self.data_axis))
+        else:
+            self._mom_sharding = None
+        self.train_step = make_train_step(
+            self.model,
+            mesh,
+            momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay,
+            data_axis=self.data_axis,
+            wire_dtype=(self._grad_cast
+                        if self.grad_compress == "bf16" else None),
+            grad_compress=self.grad_compress,
+            explicit_collectives=self._explicit,
+            seed=self._seed,
+            tx=self._tx,
+            accum_steps=cfg.accum_steps,
+            # In-graph grad/param norms only when a metrics sink consumes
+            # them — the reductions lengthen compiles, so observability
+            # costs nothing when off.
+            log_norms=bool(cfg.metrics_jsonl),
+            guard_nonfinite=bool(getattr(cfg, "nan_guard", False)),
+            zero=self.zero,
+            params=self.state.params,
+        )
+        self.eval_step = make_eval_step(
+            self.model, mesh, data_axis=self.data_axis,
+            residual_sharded=(self._explicit
+                              and self.grad_compress in qcomm.QUANTIZED_MODES),
+            momentum_sharding=self._mom_sharding)
+        self.feeder = DeviceFeeder(mesh, data_axis=self.data_axis)
+        self._agree = None        # PreemptionAgreement holds the old mesh
+        self._comm_fields = None  # ledger re-emits against the new mesh
+
+    def _build_mfu(self) -> None:
+        from pytorch_distributed_tpu.obs.flops import (
+            MFUReporter,
+            device_peak_flops,
+            image_step_cost,
+        )
+
+        cfg = self.cfg
+        cost = image_step_cost(cfg.arch, cfg.batch_size, cfg.image_size,
+                               cfg.num_classes)
+        dev = self.mesh.devices.flat[0]
+        self._mfu = MFUReporter(cost, n_devices=self.mesh.devices.size,
+                                peak_per_chip=device_peak_flops(dev))
+
+    def remesh(self, new_world: int, refresh_snapshot: bool = True) -> int:
+        """Re-mesh to ``new_world`` devices on the data axis: rebuild the
+        mesh / jitted steps / feeder from the survivor set and re-shard the
+        last-good ``StateKeeper`` snapshot onto the new topology.  Returns
+        the global step to resume from (the snapshot's step).
+
+        Unlike the LM path, the explicit-collectives layouts bake n_data
+        into the state itself, so this is where the layout surgery
+        happens: stacked ZeRO-WUS momentum chunks re-grid losslessly
+        (flat-concat → truncate → re-chunk, ft/elastic.py) and stacked
+        per-rank error-feedback residuals fold their sum into slot 0 —
+        the total pending correction is preserved exactly.  Param-shaped
+        leaves need no surgery; the jitted step's in_shardings place the
+        host snapshot on the next call, exactly like ``_rollback``."""
+        from pytorch_distributed_tpu.ft import elastic as elastic_lib
+        from pytorch_distributed_tpu.ops import qcomm
+        from pytorch_distributed_tpu.parallel import zero as zero_lib
+        from pytorch_distributed_tpu.parallel.mesh import MeshSpec, build_mesh
+
+        axes = tuple(self.mesh.axis_names)
+        if axes != (self.data_axis,):
+            raise ValueError(
+                f"elastic re-mesh supports pure data-parallel meshes; "
+                f"this trainer's mesh has axes {axes}")
+        devs = jax.devices()
+        if not 1 <= new_world <= len(devs):
+            raise ValueError(
+                f"new world {new_world} outside [1, {len(devs)}] devices")
+        old_world = dict(self.mesh.shape)[self.data_axis]
+        if self._keeper is None:
+            from pytorch_distributed_tpu.ft import StateKeeper
+
+            self._keeper = StateKeeper()
+        if refresh_snapshot or not self._keeper.has_snapshot:
+            self._keeper.update(self.state, self._global_step)
+        host = self._keeper.restore()
+        resume_global = int(self._keeper.step)
+        if self.rescale_lr_rule != "none":
+            new_batch = elastic_lib.rescale_batch(
+                self.cfg.batch_size, old_world, new_world,
+                self.rescale_lr_rule)
+            self._elastic_lr_scale *= elastic_lib.rescale_lr(
+                1.0, old_world, new_world, self.rescale_lr_rule)
+            if new_batch != self.cfg.batch_size:
+                # Per-rank batch held constant: loaders re-size (epoch
+                # length changes take effect from the resume step).
+                self.cfg.batch_size = new_batch
+                self.local_batch = new_batch // max(
+                    1, self.ctx.process_count)
+                self._build_data()
+        if self.cfg.batch_size % new_world:
+            raise ValueError(
+                f"global batch {self.cfg.batch_size} does not divide the "
+                f"new data axis ({new_world} devices); pick --min-ranks / "
+                "batch so every admissible world divides it")
+        new_mesh = build_mesh(MeshSpec((self.data_axis,), (new_world,)),
+                              devices=devs[:new_world])
+        momentum = host.momentum
+        if zero_lib.is_wus_momentum(momentum):
+            momentum = elastic_lib.regrid_wus_momentum(
+                momentum, host.params, new_world)
+        residual = host.residual
+        if (self._explicit and self.grad_compress in qcomm.QUANTIZED_MODES
+                and residual):
+            residual = elastic_lib.regrid_stacked_residual(residual,
+                                                           new_world)
+        self.state = TrainState(host.step, host.params, host.batch_stats,
+                                momentum, residual)
+        self._build_for_mesh(new_mesh)
+        if self._mom_sharding is not None:
+            # The stacked/sharded momentum is placed eagerly (its layout
+            # just changed); everything param-shaped re-shards lazily via
+            # the step's in_shardings.
+            self.state = TrainState(
+                self.state.step, self.state.params, self.state.batch_stats,
+                jax.device_put(self.state.momentum, self._mom_sharding),
+                self.state.residual)
+        if self._mfu_on:
+            self._build_mfu()  # n_devices (and maybe batch) changed
+        self._membership_epoch += 1
+        if self.hb is not None:
+            self.hb.set_membership(new_world, self._membership_epoch)
+        return resume_global
+
+    def _apply_remesh(self, chg, epoch: int) -> int:
+        """Act on a committed ``MembershipChange`` inside ``train_epoch``:
+        log the ``remesh`` ft_event (goodput books the gap to the first
+        step on the new mesh) and rebuild.  Returns the global resume
+        step."""
+        kind = chg.kind
+        old_world = dict(self.mesh.shape)[self.data_axis]
+        self.obs.log_event("remesh", step=self._global_step, change=kind,
+                           old_world=chg.old.world, new_world=chg.new.world,
+                           epoch=chg.new.epoch, reason=chg.reason,
+                           rescale=self.rescale_lr_rule, train_epoch=epoch)
+        resume = self.remesh(chg.new.world,
+                             refresh_snapshot=(kind == "grow"))
+        print(f"=> remesh ({kind}) at global step {self._global_step}: "
+              f"world {old_world}->{chg.new.world}, epoch {chg.new.epoch}, "
+              f"resuming at global step {resume} ({chg.reason})", flush=True)
+        return resume
 
     def _load_pretrained(self) -> None:
         """``--pretrained`` parity (reference distributed.py:134-136 loads zoo
@@ -554,14 +721,19 @@ class Trainer:
         self.train_loader.set_epoch(epoch)
         self.val_sampler.set_epoch(epoch)
         scale = self.ft_guard.lr_scale if self.ft_guard is not None else 1.0
-        lr_arr = jnp.float32(lr * scale)
+        lr_arr = jnp.float32(lr * scale * self._elastic_lr_scale)
         completed = start_step
         if self._keeper is not None and not self._keeper.has_snapshot:
             self._keeper.update(self.state, self._global_step)
         meters.restart_clock()
-        for i, batch in enumerate(
-                self.feeder(self.train_loader.iter_batches(start_step)),
-                start=start_step):
+        # Global step this epoch's step 0 corresponds to — the anchor that
+        # maps a StateKeeper (global-step) snapshot back to a step-in-epoch
+        # when an elastic rewind lands mid-epoch.
+        epoch_base = self._global_step - start_step
+        epoch_len = len(self.train_loader)
+        batch_iter = self.feeder(self.train_loader.iter_batches(start_step))
+        i = start_step
+        while i < epoch_len:
             if profiler is not None:
                 profiler.step_begin(epoch, i)
             # Polled at print_freq cadence so the agreement collective (a
@@ -573,6 +745,28 @@ class Trainer:
                 return completed, True
             if self.chaos is not None:
                 self.chaos.on_step(self, i)
+            if self.elastic is not None:
+                chg = self.elastic.poll(self._global_step)
+                if chg is not None:
+                    # Membership changed: rebuild against the survivor set
+                    # and rewind to the snapshot step (the sampler's
+                    # (seed, epoch) permutation regenerates the identical
+                    # index stream, so replayed steps see the same data).
+                    batch_iter.close()
+                    resume_global = self._apply_remesh(chg, epoch)
+                    self._global_step = resume_global
+                    completed = i = max(0, resume_global - epoch_base)
+                    epoch_len = len(self.train_loader)  # batch rescale
+                    batch_iter = self.feeder(
+                        self.train_loader.iter_batches(i))
+                    lr_arr = jnp.float32(
+                        lr * scale * self._elastic_lr_scale)
+                    meters.restart_clock()
+                    continue
+            batch = next(batch_iter, None)
+            if batch is None:
+                break
+            if self.chaos is not None:
                 batch = self.chaos.on_batch(i, batch)
             n = self.cfg.batch_size
             if (getattr(cfg, "comm_ledger", None)
@@ -611,13 +805,15 @@ class Trainer:
                 if at_save:
                     rollback = self.ft_guard.drain() or rollback
                 if rollback:
-                    lr_arr = jnp.float32(lr * self._rollback(epoch, i))
+                    lr_arr = jnp.float32(lr * self._rollback(epoch, i)
+                                         * self._elastic_lr_scale)
                 # A flagged streak means the current state is suspect —
                 # don't refresh the last-good snapshot/checkpoint from it.
                 at_save = at_save and self.ft_guard.consecutive == 0
             if at_save:
                 self._save_step_checkpoint(epoch, completed)
                 meters.restart_clock()  # exclude checkpoint I/O from meter
+            i += 1
         if self.ft_guard is not None and self.ft_guard.drain():
             # Trailing flags (buffered past the last cadence point) must be
             # resolved before the epoch-end checkpoint can capture them.

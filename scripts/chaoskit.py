@@ -11,14 +11,22 @@ Commands:
   also 0 with a note when no sidecar exists — legacy file);
 - ``seal PATH``      write/refresh the sidecar for an existing file (adopt
   a pre-FT checkpoint into the verified world);
+- ``drill shrink|grow``  run an end-to-end elastic membership drill
+  (ISSUE 10) on a tiny synthetic LM: ``shrink`` loses a rank at a
+  seed-deterministic step and continues at world N−1; ``grow`` re-admits
+  it later and finishes back at world N.  Exit 0 iff every expected
+  ``remesh`` event was committed.  The only command that builds a mesh
+  (jax imported lazily inside it);
 - ``--selftest``     the fast no-mesh CI path (tier-1, like
   ``shardlint.py --selftest`` / ``obs_report.py --selftest``): sidecar
   round-trip, flip/truncate detection, corruption determinism, retry
-  backoff — no jax import, no devices.
+  backoff, drill-plan determinism, membership-injector latching — no
+  devices.
 
 Signal/NaN/delay injectors live in ``pytorch_distributed_tpu.ft.chaos`` and
 are installed programmatically (``chaos=`` on either trainer); this CLI
-covers the parts that act on files from outside a run.
+covers the parts that act on files from outside a run, plus the drill
+runner above.
 """
 
 from __future__ import annotations
@@ -65,6 +73,76 @@ def cmd_verify(args) -> int:
 def cmd_seal(args) -> int:
     side = write_sidecar(args.path)
     print(f"wrote '{side}'")
+    return 0
+
+
+def drill_plan(seed: int, steps: int):
+    """Seed-deterministic (lose_step, join_step) for the elastic drill —
+    same seed, same schedule, every time (the chaoskit contract)."""
+    import random
+
+    rng = random.Random(int(seed))
+    if steps < 8:
+        raise ValueError(f"drill needs >= 8 steps, got {steps}")
+    lose = rng.randrange(2, steps // 2)
+    join = rng.randrange(lose + 2, steps - 1)
+    return lose, join
+
+
+def cmd_drill(args) -> int:
+    """End-to-end elastic drill on the tiny synthetic LM (the only
+    chaoskit command that touches devices; jax imported here, lazily)."""
+    import tempfile
+
+    import jax
+
+    from pytorch_distributed_tpu.ft import (
+        ChaosSchedule,
+        ElasticSim,
+        JoinRankAt,
+        LoseRankAt,
+    )
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.lm import (
+        LMTrainer,
+        SyntheticTokenDataset,
+    )
+
+    world = args.world
+    if world < 2 or world > len(jax.devices()):
+        print(f"need 2 <= --world <= {len(jax.devices())} devices, "
+              f"got {world}")
+        return 2
+    lose_step, join_step = drill_plan(args.seed, args.steps)
+    victim = world - 1
+    injectors = [LoseRankAt(lose_step, rank=victim, reason="drill")]
+    want = [("shrink", world, world - 1)]
+    if args.kind == "grow":
+        injectors.append(JoinRankAt(join_step, rank=victim, reason="drill"))
+        want.append(("grow", world - 1, world))
+    print(f"drill {args.kind}: world {world}, lose rank {victim} at step "
+          f"{lose_step}" + (f", re-admit at step {join_step}"
+                            if args.kind == "grow" else ""))
+
+    mesh = build_mesh(MeshSpec(("data",), (world,)),
+                      devices=jax.devices()[:world])
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(length=256, seq_len=16, vocab=64,
+                               seed=args.seed)
+    # global batch divisible by both worlds N and N-1
+    batch = world * (world - 1)
+    sim = ElasticSim(world, min_ranks=1)
+    t = LMTrainer(model, mesh, ds, batch_size=batch, lr=1e-2,
+                  seed=args.seed, save_steps=2, prefetch=0,
+                  elastic=sim, chaos=ChaosSchedule(*injectors))
+    loss = t.fit(args.steps, print_freq=max(1, args.steps // 4))
+    got = [(c.kind, c.old.world, c.new.world) for c in sim.history]
+    print(f"final loss {loss:.4f}; remesh events {got}")
+    if got != want:
+        print(f"FAIL: expected {want}")
+        return 1
+    print(f"drill {args.kind}: OK")
     return 0
 
 
@@ -133,6 +211,47 @@ def _selftest() -> int:
         # 6. CLI surface: verify exit codes match the file state.
         assert cmd_verify(argparse.Namespace(path=p)) == 0
         assert cmd_verify(argparse.Namespace(path=c1)) == 1
+
+        # 7. Drill-plan determinism: same seed → same schedule; schedules
+        #    are ordered with re-admission strictly after the loss.
+        assert drill_plan(0, 12) == drill_plan(0, 12)
+        assert drill_plan(0, 12) != drill_plan(1, 12) or \
+            drill_plan(0, 16) != drill_plan(1, 16)
+        for seed in range(8):
+            lose, join = drill_plan(seed, 12)
+            assert 2 <= lose < join < 11, (seed, lose, join)
+
+        # 8. Membership injectors latch once and drive the trainer's
+        #    elastic controller — no jax needed, a stub trainer suffices.
+        from pytorch_distributed_tpu.ft.elastic import (
+            JoinRankAt,
+            LoseRankAt,
+        )
+
+        class _Ctl:
+            def __init__(self):
+                self.calls = []
+
+            def force_lose(self, rank, reason="chaos"):
+                self.calls.append(("lose", rank, reason))
+
+            def force_join(self, rank, reason="chaos"):
+                self.calls.append(("join", rank, reason))
+
+        class _Trainer:
+            elastic = _Ctl()
+
+        tr = _Trainer()
+        lose = LoseRankAt(3, rank=2, reason="drill")
+        join = JoinRankAt(5, rank=2, reason="drill")
+        for s in range(8):
+            lose.on_step(tr, s)
+            join.on_step(tr, s)
+        assert tr.elastic.calls == [("lose", 2, "drill"),
+                                    ("join", 2, "drill")]
+        assert lose.fired and join.fired
+        # a trainer without an elastic controller ignores the injection
+        LoseRankAt(0, rank=0).on_step(object(), 0)
     print("chaoskit selftest: OK")
     return 0
 
@@ -153,6 +272,16 @@ def main(argv=None) -> int:
     v.add_argument("path")
     s = sub.add_parser("seal", help="write the sha256 sidecar for a file")
     s.add_argument("path")
+    d = sub.add_parser("drill",
+                       help="run an end-to-end elastic membership drill")
+    d.add_argument("kind", choices=("shrink", "grow"),
+                   help="shrink: lose a rank and continue; grow: lose "
+                        "then re-admit it")
+    d.add_argument("--world", type=int, default=4,
+                   help="starting data-parallel world size")
+    d.add_argument("--steps", type=int, default=12)
+    d.add_argument("--seed", type=int, default=0,
+                   help="drives the injection schedule (deterministic)")
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()
@@ -162,6 +291,8 @@ def main(argv=None) -> int:
         return cmd_verify(args)
     if args.cmd == "seal":
         return cmd_seal(args)
+    if args.cmd == "drill":
+        return cmd_drill(args)
     ap.print_help()
     return 2
 

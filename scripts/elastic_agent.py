@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""elastic_agent — membership-epoch coordination CLI for elastic runs.
+
+The file-based half of ISSUE 10: ``ElasticCoordinator`` (ft/elastic.py)
+maintains ``membership.json`` in the run's heartbeat directory; this CLI
+is how operators (and restarted ranks) talk to it.  No devices, no mesh —
+pure file coordination, safe on a login node beside a live run.
+
+Commands:
+
+- ``status --hb-dir D``   one-shot report: current membership epoch +
+  ranks, per-rank liveness (live / slow / dead, from the same
+  ``find_stragglers`` thresholds the trainers use), pending join
+  requests.  Exit 0 when every member is live, 1 otherwise — cronnable.
+- ``watch --hb-dir D``    the coordinator loop: every ``--interval``
+  seconds run one ``decide()`` round — evict dead members, admit pending
+  joins, commit the next epoch atomically.  ``--once`` for a single
+  round (the cron idiom).  ``--min-ranks`` is the shrink floor below
+  which eviction is refused.
+- ``join --hb-dir D --rank R``  file an admission request for a
+  restarted/new rank; the next ``decide()`` folds it in.
+- ``--selftest``          the fast no-mesh CI path (like
+  ``chaoskit.py --selftest``): membership round-trip, join protocol,
+  dead-eviction + epoch fencing of stale beats, min-ranks refusal.
+
+Decisions only move ``membership.json``; the training processes observe
+the epoch bump via their own elastic pollers and re-mesh themselves
+(train/trainer.py, train/lm.py ``remesh``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_tpu.ft.elastic import (  # noqa: E402
+    ElasticCoordinator,
+    Membership,
+    split_liveness,
+)
+from pytorch_distributed_tpu.obs.heartbeat import (  # noqa: E402
+    find_stragglers,
+    read_heartbeats,
+)
+
+
+def _coordinator(args) -> ElasticCoordinator:
+    return ElasticCoordinator(
+        args.hb_dir, world=args.world, min_ranks=args.min_ranks,
+        max_step_lag=args.max_step_lag, max_age_s=args.max_age_s)
+
+
+def cmd_status(args) -> int:
+    co = _coordinator(args)
+    cur = co.membership()
+    beats = read_heartbeats(args.hb_dir, min_epoch=cur.epoch)
+    flagged = find_stragglers(beats, max_step_lag=args.max_step_lag,
+                              max_age_s=args.max_age_s)
+    dead, slow = split_liveness(flagged)
+    print(f"membership epoch {cur.epoch}: world {cur.world} "
+          f"ranks {list(cur.ranks)}")
+    unhealthy = 0
+    for r in cur.ranks:
+        beat = beats.get(r)
+        if r in dead:
+            state, unhealthy = f"DEAD ({flagged[r]})", unhealthy + 1
+        elif r in slow:
+            state, unhealthy = f"slow ({flagged[r]})", unhealthy + 1
+        elif beat is None:
+            # no beat at this epoch yet: in flight (just re-meshed)
+            state = "no beat at this epoch (in flight)"
+        else:
+            state = f"live (step {beat.get('step')})"
+        print(f"  rank {r}: {state}")
+    joins = sorted(co.pending_joins())
+    if joins:
+        print(f"pending joins: {joins}")
+    return 1 if unhealthy else 0
+
+
+def cmd_watch(args) -> int:
+    co = _coordinator(args)
+    while True:
+        chg = co.decide()
+        if chg is not None:
+            print(f"epoch {chg.old.epoch} -> {chg.new.epoch} "
+                  f"({chg.kind}): world {chg.old.world} -> "
+                  f"{chg.new.world}; {chg.reason}", flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+def cmd_join(args) -> int:
+    co = _coordinator(args)
+    co.request_join(args.rank)
+    print(f"filed join request for rank {args.rank} "
+          f"({co.join_path(args.rank)})")
+    return 0
+
+
+def _selftest() -> int:
+    """No-mesh coordination fast path: membership round-trip, the join
+    protocol, epoch fencing of stale beats, and the min-ranks floor."""
+    import tempfile
+
+    from pytorch_distributed_tpu.ft.elastic import atomic_write_json
+
+    with tempfile.TemporaryDirectory() as d:
+        hb = os.path.join(d, "hb")
+        co = ElasticCoordinator(hb, world=4, min_ranks=2, max_age_s=5.0)
+
+        # 1. Fresh membership: epoch 0, all ranks; json round-trips.
+        cur = co.membership()
+        assert (cur.epoch, cur.ranks) == (0, (0, 1, 2, 3)), cur
+        assert Membership.from_json(cur.to_json()) == cur
+
+        # 2. Atomic write discipline: no tmp litter after a commit.
+        atomic_write_json(co.path, cur.to_json())
+        assert not [n for n in os.listdir(hb) if ".tmp." in n]
+
+        # 3. All live → no decision, epoch stays put.
+        now = time.time()
+        beats = {r: {"pid": r, "step": 10, "t": now, "epoch": 0}
+                 for r in range(4)}
+        assert co.decide(now=now, beats=beats) is None
+        assert co.membership().epoch == 0
+
+        # 4. Dead beat → evicted, epoch bumps, survivors committed.
+        beats[3]["t"] = now - 3600.0
+        chg = co.decide(now=now, beats=beats)
+        assert chg is not None and chg.kind == "shrink"
+        assert chg.new.ranks == (0, 1, 2) and chg.new.epoch == 1
+        assert co.membership() == chg.new
+
+        # 5. Stale-incarnation fencing: a beat from epoch 0 never reads
+        #    as live at epoch 1 (read path drops it) — the hardened
+        #    heartbeat writer stamps epoch into every record.
+        hb_live = read_heartbeats(hb, min_epoch=co.membership().epoch)
+        assert 3 not in hb_live
+
+        # 6. Join protocol: request → pending → admitted → request file
+        #    consumed; grow bumps the epoch again.
+        co.request_join(3)
+        assert co.pending_joins() == {3}
+        fresh = {r: {"pid": r, "step": 12, "t": now, "epoch": 1}
+                 for r in (0, 1, 2)}
+        chg2 = co.decide(now=now, beats=fresh)
+        assert chg2 is not None and chg2.kind == "grow"
+        assert chg2.new.ranks == (0, 1, 2, 3) and chg2.new.epoch == 2
+        assert co.pending_joins() == set()
+
+        # 7. Min-ranks floor: losing 3 of 4 would leave 1 < 2 — refused,
+        #    membership and epoch unmoved.
+        dead3 = {r: {"pid": r, "step": 12,
+                     "t": now - (3600.0 if r else 0.0), "epoch": 2}
+                 for r in range(4)}
+        assert co.decide(now=now, beats=dead3) is None
+        assert co.membership().epoch == 2
+
+        # 8. A member with NO beat at the current epoch is in flight,
+        #    not dead — must not be evicted.
+        assert co.decide(now=now, beats={0: {"pid": 0, "step": 1,
+                                             "t": now, "epoch": 2}}) is None
+
+        # 9. CLI surface: status exits 0 on a live fleet, 1 with a dead
+        #    member; join files the request where decide() finds it.
+        ns = argparse.Namespace(hb_dir=hb, world=4, min_ranks=2,
+                                max_step_lag=3, max_age_s=5.0, rank=9)
+
+        def beat_file(r, t):
+            path = os.path.join(hb, f"heartbeat-{r:05d}.jsonl")
+            with open(path, "w") as f:
+                f.write(json.dumps({"pid": r, "step": 5, "t": t,
+                                    "epoch": 2}) + "\n")
+
+        for r in range(4):
+            beat_file(r, time.time())
+        assert cmd_status(ns) == 0
+        beat_file(3, time.time() - 3600.0)  # rank 3 goes dead
+        assert cmd_status(ns) == 1
+        assert cmd_join(ns) == 0
+        assert co.pending_joins() == {9}
+    print("elastic_agent selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Membership-epoch coordination for elastic runs")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the fast no-mesh coordination checks")
+    sub = ap.add_subparsers(dest="cmd")
+
+    def common(p):
+        p.add_argument("--hb-dir", required=True,
+                       help="the run's heartbeat directory")
+        p.add_argument("--world", type=int, default=1,
+                       help="initial world size if membership.json is new")
+        p.add_argument("--min-ranks", type=int, default=1,
+                       help="shrink floor: never evict below this world")
+        p.add_argument("--max-step-lag", type=int, default=3)
+        p.add_argument("--max-age-s", type=float, default=60.0,
+                       help="beat age beyond which a rank reads as dead")
+
+    s = sub.add_parser("status", help="one-shot membership + liveness report")
+    common(s)
+    w = sub.add_parser("watch", help="run the coordinator decision loop")
+    common(w)
+    w.add_argument("--interval", type=float, default=10.0,
+                   help="seconds between decide() rounds")
+    w.add_argument("--once", action="store_true",
+                   help="one decision round and exit (cron idiom)")
+    j = sub.add_parser("join", help="file a join request for a rank")
+    common(j)
+    j.add_argument("--rank", type=int, required=True)
+
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if args.cmd == "status":
+        return cmd_status(args)
+    if args.cmd == "watch":
+        return cmd_watch(args)
+    if args.cmd == "join":
+        return cmd_join(args)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

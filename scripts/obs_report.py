@@ -298,6 +298,19 @@ def heartbeat_stats(hb_dir: str, now: Optional[float], max_step_lag: int,
     return beats, flagged, now
 
 
+def read_membership(hb_dir: str) -> Optional[Dict]:
+    """The elastic coordinator's membership.json, if this run is elastic
+    (ft/elastic.py) — {"epoch": int, "ranks": [...]} or None."""
+    path = os.path.join(hb_dir, "membership.json")
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        return {"epoch": int(obj["epoch"]),
+                "ranks": [int(r) for r in obj["ranks"]]}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def summarize_heartbeats(hb_dir: str, now: Optional[float],
                          max_step_lag: int, max_age_s: float) -> List[str]:
     beats, flagged, now = heartbeat_stats(hb_dir, now, max_step_lag,
@@ -305,11 +318,19 @@ def summarize_heartbeats(hb_dir: str, now: Optional[float],
     if not beats:
         return ["  (no heartbeats)"]
     lines = []
+    member = read_membership(hb_dir)
+    if member is not None:
+        lines.append(f"  membership epoch {member['epoch']}: "
+                     f"world {len(member['ranks'])} "
+                     f"ranks {member['ranks']}")
     for pid in sorted(beats):
         b = beats[pid]
         mark = f"  ** STRAGGLER: {flagged[pid]}" if pid in flagged else ""
+        # hardened beats stamp their membership epoch (+ world) so a
+        # stale incarnation is visibly from a pre-re-mesh world
+        ep = f" epoch {b['epoch']}" if "epoch" in b else ""
         lines.append(f"  process {pid:<3}       step {b['step']:<8} "
-                     f"beat age {now - b['t']:.1f}s{mark}")
+                     f"beat age {now - b['t']:.1f}s{ep}{mark}")
     if not flagged:
         lines.append("  no stragglers")
     return lines
@@ -406,8 +427,12 @@ def report_json(args) -> Dict:
             args.hb_dir, args.now, args.max_step_lag, args.max_beat_age)
         out["heartbeats"] = {
             str(pid): {"step": b.get("step"), "beat_age_s": now - b["t"],
+                       "epoch": b.get("epoch"),
                        "straggler": flagged.get(pid)}
             for pid, b in sorted(beats.items())}
+        member = read_membership(args.hb_dir)
+        if member is not None:
+            out["membership"] = member
     return out
 
 
@@ -431,6 +456,7 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         "throughput": sum(thr) / len(thr) if thr else None,
         "mfu": sum(mfu) / len(mfu) if mfu else None,
         "goodput": gp.goodput_pct if gp.steps else None,
+        "badput_remesh_s": gp.badput_s["remesh"] if gp.steps else None,
         "model_comm_bytes": cs["model_comm_bytes"],
         "comm_wire_bytes": cs["comm_wire_bytes"],
         "exposed_comm_ms": cs["exposed_comm_ms"],
@@ -438,18 +464,23 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
     }
 
 
-# (name, lower_is_better, absolute_pp) — goodput diffs in percentage
-# points, the rest in relative percent.  exposed_comm_ms fences the
-# overlap win (more un-overlapped collective time per step); wire bytes
-# fence the traffic itself (a sharding change that moves more data);
-# peak_hbm_bytes fences the compiled per-device footprint (the --zero
-# wus / fused-CE memory wins, stamped from the ledger's memory_analysis).
+# (name, lower_is_better, absolute) — goodput diffs in absolute
+# percentage points and badput_remesh_s in absolute seconds (both use
+# goodput_threshold_pp: a remesh storm is seconds of lost wall clock,
+# not a ratio — an elastic drill vs its uninterrupted baseline divides
+# by zero otherwise); the rest diff in relative percent.
+# exposed_comm_ms fences the overlap win (more un-overlapped collective
+# time per step); wire bytes fence the traffic itself (a sharding change
+# that moves more data); peak_hbm_bytes fences the compiled per-device
+# footprint (the --zero wus / fused-CE memory wins, stamped from the
+# ledger's memory_analysis).
 _DIFF_METRICS = (
     ("step_time_p50", True, False),
     ("step_time_p95", True, False),
     ("throughput", False, False),
     ("mfu", False, False),
     ("goodput", False, True),
+    ("badput_remesh_s", True, True),
     ("exposed_comm_ms", True, False),
     ("comm_wire_bytes", True, False),
     ("peak_hbm_bytes", True, False),
@@ -476,8 +507,10 @@ def diff_data(a_records: List[dict], b_records: List[dict],
         if va is None or vb is None:
             row["verdict"] = "missing"
         elif absolute_pp:
-            row["delta_pp"] = vb - va
-            worse = (va - vb) > goodput_threshold_pp
+            delta = vb - va
+            row["delta_pp"] = delta
+            worse = (delta > goodput_threshold_pp if lower_better
+                     else -delta > goodput_threshold_pp)
             row["verdict"] = "REGRESS" if worse else "PASS"
             regressed = regressed or worse
         elif va == 0:
@@ -585,6 +618,8 @@ def _selftest() -> int:
             log.log_event("skip", step=7, consecutive=1)
             log.log_event("skip", step=8, consecutive=2)
             log.log_event("rollback", step=9, restored_step=5, lr_scale=0.5)
+            log.log_event("remesh", step=12, change="shrink", old_world=4,
+                          new_world=3, epoch=1, reason="drill")
             log.log_event("preempt", step=19)
         with open(mpath, "a") as f:
             # torn tail (a killed writer) + a bench staleness event
@@ -595,12 +630,15 @@ def _selftest() -> int:
                 "reason": "device discovery hung (tunnel unreachable)",
             }) + "\n")
             f.write('{"step": 20, "step_time": 0.0')
-        # heartbeats: pid 0 current, pid 1 lagging AND stale
+        # heartbeats: pid 0 current (elastic, epoch-stamped), pid 1
+        # lagging AND stale; membership.json as the coordinator leaves it
         hb_dir = os.path.join(d, "hb")
-        w0 = HeartbeatWriter(hb_dir, 0, interval_s=0.0)
+        w0 = HeartbeatWriter(hb_dir, 0, interval_s=0.0, world=3, epoch=1)
         w0.beat(19, step_time_ema=0.011, last_ft="preempt")
         with open(os.path.join(hb_dir, "heartbeat-00001.jsonl"), "w") as f:
             f.write(json.dumps({"pid": 1, "step": 3, "t": now - 120}) + "\n")
+        with open(os.path.join(hb_dir, "membership.json"), "w") as f:
+            f.write(json.dumps({"epoch": 1, "ranks": [0, 1, 2]}))
         # telemetry CSV (statistics.sh contract)
         tpath = os.path.join(d, "telemetry.csv")
         with open(tpath, "w", newline="") as f:
@@ -634,7 +672,9 @@ def _selftest() -> int:
                        "== ft events ==", "skip", "rollback", "preempt",
                        "lr scale          0.5 after 1 rollback",
                        "== goodput ==", "goodput", "badput/nan_skip",
-                       "badput/rollback_discard",
+                       "badput/rollback_discard", "badput/remesh",
+                       "membership epoch 1: world 3 ranks [0, 1, 2]",
+                       "epoch 1",
                        "== comms ==", "per-step payload  66952 B",
                        "16 collectives", "exposed comm      0.400 ms",
                        "overlap 33.3%", "residual", "[ok]",
@@ -656,6 +696,9 @@ def _selftest() -> int:
         assert js["comms"]["ledger"]["lm_train_dp"]["total_bytes"] == 66952
         assert js["heartbeats"]["1"]["straggler"], js["heartbeats"]
         assert not js["heartbeats"]["0"]["straggler"], js["heartbeats"]
+        assert js["heartbeats"]["0"]["epoch"] == 1, js["heartbeats"]
+        assert js["membership"] == {"epoch": 1, "ranks": [0, 1, 2]}, js
+        assert js["goodput"]["counts"]["remesh"] == 1, js["goodput"]
         json.dumps(js)  # must be serializable end-to-end
         # pid 0 must NOT be flagged
         line0 = [ln for ln in out.splitlines() if "process 0" in ln]
@@ -675,7 +718,8 @@ def _selftest() -> int:
         text, regressed = diff_report(a_recs, b_recs)
         assert regressed, f"selftest: slowed run must REGRESS:\n{text}"
         for needle in ("== diff ==", "step_time_p50", "REGRESS",
-                       "overall: REGRESS", "throughput", "mfu"):
+                       "overall: REGRESS", "throughput", "mfu",
+                       "badput_remesh_s"):
             assert needle in text, f"selftest: {needle!r} missing from:\n{text}"
         text2, regressed2 = diff_report(a_recs, a_recs)
         assert not regressed2 and "overall: PASS" in text2, (

@@ -41,6 +41,42 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
+def lm_world32():
+    """Session-shared tiny-LM training world: the full-device data mesh,
+    the vocab-32 1-layer TransformerLM, and its synthetic dataset.
+
+    Several suites fit this identical configuration (test_zero's parity
+    and kill-resume drills, and anything else on the vocab-32 smoke
+    model); sharing the objects keeps model.init traced once and — more
+    importantly — lets fitted-trainer fixtures below amortize whole
+    train-step compiles across tests on the 1-core CI host."""
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.lm import SyntheticTokenDataset
+
+    mesh = build_mesh(MeshSpec(("data",), (jax.device_count(),)))
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(64, 16, 32)
+    return mesh, model, ds
+
+
+@pytest.fixture(scope="session")
+def lm_wus_ref_fit(lm_world32):
+    """The uninterrupted ``--zero wus`` reference run (8 steps, lr 0.05,
+    batch 8): one compile + one fit for every test that needs the wus
+    baseline (replicated-parity fences, kill-and-resume parity).  Tests
+    must treat the returned trainer as read-only."""
+    from pytorch_distributed_tpu.train.lm import LMTrainer
+
+    mesh, model, ds = lm_world32
+    with mesh:
+        t = LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
+                      eval_dataset=None, zero="wus")
+        loss = t.fit(8, print_freq=4)
+    return t, loss
+
+
+@pytest.fixture(scope="session")
 def get_lowering():
     """Session-shared compiled recipe lowerings.
 

@@ -19,9 +19,21 @@ from pytorch_distributed_tpu.train.state import TrainState
 VOCAB, D, HEADS, LAYERS, SEQ, BATCH = 64, 32, 2, 2, 32, 8
 
 
-def _tokens(seed=0):
-    rng = np.random.default_rng(seed)
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(0)
     return rng.integers(0, VOCAB, size=(BATCH, SEQ)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def replicated_baseline(tokens):
+    """The pure-DP 8-device one-step reference (params + metrics): one
+    compile for every composed-mesh parity test in the module (the
+    compile-budget discipline: tests/conftest.py ``lm_world32``)."""
+    mesh = build_mesh(MeshSpec(("data",), (8,)), jax.devices()[:8])
+    model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
+                          n_layers=LAYERS)
+    return _run_one_step(mesh, model, None, tokens)
 
 
 def _run_one_step(mesh, model, specs, tokens):
@@ -45,14 +57,8 @@ def _run_one_step(mesh, model, specs, tokens):
         )
 
 
-def test_dp_sp_tp_composed_matches_replicated():
-    tokens = _tokens()
-
-    base_mesh = build_mesh(MeshSpec(("data",), (8,)), jax.devices()[:8])
-    base_model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
-                               n_layers=LAYERS)
-    base_params, base_metrics = _run_one_step(base_mesh, base_model, None,
-                                              tokens)
+def test_dp_sp_tp_composed_matches_replicated(tokens, replicated_baseline):
+    base_params, base_metrics = replicated_baseline
 
     mesh = build_mesh(MeshSpec(("data", "seq", "model"), (2, 2, 2)),
                       jax.devices()[:8])

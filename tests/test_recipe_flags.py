@@ -1,0 +1,117 @@
+"""Recipe CLI flag-collision lint (ISSUE 10 satellite).
+
+The PR-9 bug class: ``add_argument`` with a wrong ``dest`` does not raise
+— a later flag can silently *overwrite* another flag's parsed value (the
+``--zero`` patch briefly gave lm_pretrain's ``--zero`` the ``precision``
+dest, so ``--precision bf16 --zero wus`` dropped the precision on the
+floor).  argparse only errors on duplicate option *strings*, never on
+shared ``dest``s, so this stays invisible until a run mis-parses.
+
+This lint builds every recipe parser (no devices needed — parsers are
+pure argparse) and asserts, recursively through subparsers:
+
+- no duplicate option strings (and the conflict handler is the erroring
+  default, so argparse keeps catching those at add time);
+- no two actions share a ``dest`` (the silent-overwrite class);
+- every flag round-trips: parsing a no-arg line yields exactly one value
+  per dest, and the elastic/zero/compress flags parse to their dests.
+"""
+
+import argparse
+
+import pytest
+
+from pytorch_distributed_tpu.recipes import lm_generate, lm_pretrain
+from pytorch_distributed_tpu.train import config as config_mod
+
+PARSERS = {
+    # every image recipe (distributed, apex, horovod, slurm, dataparallel,
+    # multiprocessing, tpu_native) shares the one canonical parser
+    "train.config": lambda: config_mod.build_parser(),
+    "recipes.lm_pretrain": lambda: lm_pretrain.build_parser(),
+    "recipes.lm_generate": lambda: lm_generate.build_parser(),
+}
+
+
+def _walk(parser):
+    """Yield (parser, action) pairs recursively through subparsers."""
+    for act in parser._actions:
+        yield parser, act
+        if isinstance(act, argparse._SubParsersAction):
+            for sub in act.choices.values():
+                yield from _walk(sub)
+
+
+def _lint(parser):
+    """Return a list of human-readable collision findings (empty = clean)."""
+    findings = []
+    by_parser = {}
+    for p, act in _walk(parser):
+        by_parser.setdefault(id(p), (p, []))[1].append(act)
+    for _pid, (p, actions) in by_parser.items():
+        seen_opts = {}
+        seen_dest = {}
+        for act in actions:
+            for opt in act.option_strings:
+                if opt in seen_opts:
+                    findings.append(
+                        f"duplicate option string {opt!r} "
+                        f"({seen_opts[opt]} vs {act})")
+                seen_opts[opt] = act
+            if act.dest in (argparse.SUPPRESS, None):
+                continue
+            if not act.option_strings and act.dest == "command":
+                continue
+            prev = seen_dest.get(act.dest)
+            if prev is not None:
+                findings.append(
+                    f"dest {act.dest!r} written by two actions: "
+                    f"{prev.option_strings or prev.dest} and "
+                    f"{act.option_strings or act.dest} — the second "
+                    f"silently overwrites the first at parse time")
+            seen_dest[act.dest] = act
+    return findings
+
+
+@pytest.mark.parametrize("name", sorted(PARSERS))
+def test_no_flag_collisions(name):
+    parser = PARSERS[name]()
+    findings = _lint(parser)
+    assert not findings, f"{name}: " + "; ".join(findings)
+
+
+@pytest.mark.parametrize("name", sorted(PARSERS))
+def test_default_conflict_handler(name):
+    """conflict_handler='resolve' would let a duplicate option string
+    silently *replace* the earlier flag — keep the erroring default."""
+    for p, _ in _walk(PARSERS[name]()):
+        assert p.conflict_handler == "error", \
+            f"{name}: parser uses conflict_handler={p.conflict_handler!r}"
+
+
+def test_lint_catches_the_pr9_bug_class():
+    """The lint must actually flag a wrong-dest overwrite (regression
+    test for the lint itself)."""
+    p = argparse.ArgumentParser()
+    p.add_argument("--precision", default="fp32")
+    p.add_argument("--zero", dest="precision")  # the PR-9 mistake
+    findings = _lint(p)
+    assert any("precision" in f and "silently overwrites" in f
+               for f in findings), findings
+
+
+def test_elastic_flags_parse_to_their_own_dests():
+    """The new ISSUE-10 flags land in their own dests on both surfaces
+    and collide with nothing."""
+    cfg = config_mod.parse_config(
+        ["--elastic", "--min-ranks", "2", "--rescale-lr", "sqrt"])
+    assert (cfg.elastic, cfg.min_ranks, cfg.rescale_lr) == (True, 2, "sqrt")
+    # defaults stay inert
+    cfg = config_mod.parse_config([])
+    assert (cfg.elastic, cfg.min_ranks, cfg.rescale_lr) == (False, 1, "none")
+    args = lm_pretrain.build_parser().parse_args(
+        ["--elastic", "--min-ranks", "2", "--rescale-lr", "linear",
+         "--precision", "bf16"])
+    assert (args.elastic, args.min_ranks, args.rescale_lr) == \
+        (True, 2, "linear")
+    assert args.precision == "bf16"  # the PR-9 symptom, pinned

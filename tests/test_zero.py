@@ -29,7 +29,6 @@ import numpy as np
 import pytest
 
 from pytorch_distributed_tpu.ft import ChaosSchedule, SignalAt
-from pytorch_distributed_tpu.models.transformer import TransformerLM
 from pytorch_distributed_tpu.ops import qcomm
 from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
 from pytorch_distributed_tpu.parallel import zero as zero_lib
@@ -38,7 +37,7 @@ from pytorch_distributed_tpu.train.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
-from pytorch_distributed_tpu.train.lm import LMTrainer, SyntheticTokenDataset
+from pytorch_distributed_tpu.train.lm import LMTrainer
 from pytorch_distributed_tpu.train.optim import sgd_init
 from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.steps import make_train_step
@@ -52,9 +51,12 @@ def _mesh4():
     return build_mesh(MeshSpec(("data",), (N,)), jax.devices()[:N])
 
 
-def _mlp_variables(seed=0):
+@pytest.fixture(scope="module")
+def mlp_variables():
+    """One init trace for every momentum-layout test in the module (the
+    compile-budget discipline: tests/conftest.py ``lm_world32``)."""
     model = _MLP(classes=10)
-    return model, model.init(jax.random.PRNGKey(seed),
+    return model, model.init(jax.random.PRNGKey(0),
                              jnp.zeros((1, 8, 8, 3)))
 
 
@@ -86,13 +88,13 @@ def _run_explicit(model, variables, mesh, zero, grad_compress="none"):
 
 # ------------------------------------------------------------- step parity
 
-def test_wus_step_parity_vs_replicated():
+def test_wus_step_parity_vs_replicated(mlp_variables):
     """The ISSUE-9 numerics fence: 3 explicit steps on the 4-way mesh.
     f32 wus IS the replicated update (reduce-scatter + chunked SGD +
     delta all-gather reassociates the same math) — tight tolerance;
     int8 wus composes with error feedback — loose tolerance."""
     mesh = _mesh4()
-    model, variables = _mlp_variables()
+    model, variables = mlp_variables
     s_repl, loss_repl = _run_explicit(model, variables, mesh, "none")
     s_wus, loss_wus = _run_explicit(model, variables, mesh, "wus")
     np.testing.assert_allclose(loss_wus, loss_repl, rtol=2e-5)
@@ -111,23 +113,17 @@ def test_wus_step_parity_vs_replicated():
                for l in jax.tree_util.tree_leaves(s_q.momentum["agerr"])) > 0.0
 
 
-def test_gspmd_lm_zero_parity_and_sharding(tmp_path):
+def test_gspmd_lm_zero_parity_and_sharding(lm_world32, lm_wus_ref_fit):
     """GSPMD composition: LMTrainer with zero='wus' (momentum resharded by
-    zero_momentum_specs) matches the replicated run on identical synthetic
-    batches, and its biggest momentum shard is 1/N of the replicated one."""
-    mesh = build_mesh(MeshSpec(("data",), (jax.device_count(),)))
-    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
-    ds = SyntheticTokenDataset(64, 16, 32)
-
-    def fit(zero):
-        with mesh:
-            t = LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
-                          eval_dataset=None, zero=zero)
-            loss = t.fit(3, print_freq=4)
-        return t, loss
-
-    t_repl, loss_repl = fit(None)
-    t_wus, loss_wus = fit("wus")
+    zero_momentum_specs, from the session-shared reference fit) matches
+    the replicated run on identical synthetic batches, and its biggest
+    momentum shard is 1/N of the replicated one."""
+    mesh, model, ds = lm_world32
+    t_wus, loss_wus = lm_wus_ref_fit
+    with mesh:
+        t_repl = LMTrainer(model, mesh, ds, batch_size=8, lr=0.05,
+                           eval_dataset=None, zero=None)
+        loss_repl = t_repl.fit(8, print_freq=4)
     np.testing.assert_allclose(loss_wus, loss_repl, rtol=2e-5)
     _leaves_allclose(t_repl.state.params, t_wus.state.params, rtol=2e-5)
 
@@ -154,10 +150,10 @@ def _nonzero_wus(params, quantized=False):
     return mom
 
 
-def test_gather_shard_momentum_roundtrip():
+def test_gather_shard_momentum_roundtrip(mlp_variables):
     """gather(...) flattens the stacked chunks to the exact param-shaped
     tree; shard(...) re-chunks it back bit-exactly (padding dropped)."""
-    _, variables = _mlp_variables()
+    _, variables = mlp_variables
     params = variables["params"]
     mom = _nonzero_wus(params)
     gathered = zero_lib.gather_momentum(mom, params)
@@ -168,10 +164,10 @@ def test_gather_shard_momentum_roundtrip():
     _leaves_allclose(rechunked, mom["buf"], rtol=0, atol=0)
 
 
-def test_checkpoint_sharded_momentum_roundtrip(tmp_path):
+def test_checkpoint_sharded_momentum_roundtrip(tmp_path, mlp_variables):
     """Disk always stores the param-shaped momentum (gather-on-save); a
     wus template re-chunks it on restore with agerr reset to zeros."""
-    _, variables = _mlp_variables()
+    _, variables = mlp_variables
     state = TrainState.create(
         variables, _nonzero_wus(variables["params"], quantized=True))
     path = save_checkpoint(str(tmp_path), state, 0, "mlp", 0.0, False)
@@ -186,10 +182,10 @@ def test_checkpoint_sharded_momentum_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(leaf), 0.0)
 
 
-def test_checkpoint_mode_switch_both_directions(tmp_path):
+def test_checkpoint_mode_switch_both_directions(tmp_path, mlp_variables):
     """legacy-replicated -> wus and wus -> replicated both restore: the
     param-shaped disk layout is the lingua franca."""
-    _, variables = _mlp_variables()
+    _, variables = mlp_variables
     rng = np.random.default_rng(9)
     repl_mom = jax.tree_util.tree_map(
         lambda p: jnp.asarray(rng.normal(size=np.shape(p))
@@ -223,15 +219,15 @@ def test_checkpoint_mode_switch_both_directions(tmp_path):
         rtol=0, atol=0)
 
 
-def test_wus_kill_and_resume_parity(tmp_path):
+def test_wus_kill_and_resume_parity(tmp_path, lm_world32, lm_wus_ref_fit):
     """ISSUE-9 acceptance: a --zero wus run preempted mid-stream resumes
     through the gather-on-save/shard-on-restore layout and finishes with
-    the SAME final parameters and loss as the uninterrupted wus run."""
+    the SAME final parameters and loss as the uninterrupted wus run
+    (the session-shared reference fit)."""
     from pytorch_distributed_tpu.utils.preempt import PreemptionGuard
 
-    mesh = build_mesh(MeshSpec(("data",), (jax.device_count(),)))
-    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
-    ds = SyntheticTokenDataset(64, 16, 32)
+    mesh, model, ds = lm_world32
+    ref, loss_ref = lm_wus_ref_fit
     d = str(tmp_path / "ckpt")
 
     def trainer(**kw):
@@ -239,9 +235,6 @@ def test_wus_kill_and_resume_parity(tmp_path):
                          eval_dataset=None, zero="wus", **kw)
 
     with mesh:
-        ref = trainer()
-        loss_ref = ref.fit(8, print_freq=4)
-
         guard = PreemptionGuard(signals=(signal.SIGUSR1,)).install()
         try:
             t1 = trainer(checkpoint_dir=d, save_steps=2, preempt=guard,
