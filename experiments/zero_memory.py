@@ -19,7 +19,9 @@ experiments/fused_ce_memory.py:
 1. **optimizer+gradient bytes** (the headline): live per-device momentum
    shard bytes (from the trained state's addressable shards) + the
    grad_sync-phase collective result bytes from the compiled comm ledger
-   (obs/comms.py) — asserted >= 2x smaller under wus;
+   (obs/comms.py) — asserted >= 2x smaller under wus; the memory
+   ledger's ``opt_state`` class peak (obs/memory.py) reproduces the
+   reclaim from the compiled HLO alone, asserted >= 3.5x;
 2. **compiled peak** (temp+argument+output, ``memory_analysis()``) —
    asserted not to regress;
 3. **40-step A/B** on identical synthetic batches — final-loss relative
@@ -104,9 +106,20 @@ def run_mode(zero: str) -> dict:
 
     rng = np.random.default_rng(0)
     batches = list(_batches(rng))
-    ledger = comms.ledger_from_jitted(
-        step, (state, batches[0], jnp.float32(0.05)),
-        step=f"zero_{zero}", mesh=mesh)
+    # One AOT compile feeds both ledgers: the comm ledger (wire parity)
+    # and the memory ledger (the headline reclaim, now reproducible from
+    # the ledger alone — no live-shard inspection needed).
+    from pytorch_distributed_tpu.obs import memory
+
+    ledger_args = (state, batches[0], jnp.float32(0.05))
+    compiled = step.lower(*ledger_args).compile()
+    text = compiled.as_text()
+    ledger = comms.ledger_from_hlo_text(text, step=f"zero_{zero}",
+                                        mesh_shape=dict(mesh.shape))
+    ledger.peak_hbm_bytes = comms.compiled_peak_bytes(compiled)
+    mled = memory.ledger_from_compiled(
+        compiled, step=f"zero_{zero}", mesh_shape=dict(mesh.shape),
+        arg_classes=memory.arg_classes_of(ledger_args), hlo_text=text)
 
     loss = None
     lr = jnp.float32(0.05)
@@ -134,6 +147,13 @@ def run_mode(zero: str) -> dict:
         "total_wire_bytes": float(ledger.total_wire_bytes),
         "opt_plus_grad_bytes": int(mom_bytes + grad_sync["bytes"]),
         "peak_hbm_bytes": int(ledger.peak_hbm_bytes),
+        # Memory-ledger view (obs/memory.py): the optimizer-state class
+        # peak is the per-device momentum footprint read from the compiled
+        # HLO alone — it must reproduce the live-shard measurement above.
+        "mem_opt_state_peak_bytes": int(
+            mled.class_peaks().get("opt_state", 0)),
+        "mem_peak_bytes": int(mled.peak_bytes),
+        "mem_residual_pct": round(mled.residual_pct(), 2),
         "collectives_by_kind": {
             k: int(v["count"]) for k, v in ledger.by_kind().items()},
         "leaf_sizes": [int(np.prod(np.shape(leaf)))
@@ -164,6 +184,8 @@ def main() -> int:
               f"{row['final_loss']:.6f}", flush=True)
 
     reclaim = repl["opt_plus_grad_bytes"] / max(1, wus["opt_plus_grad_bytes"])
+    ledger_reclaim = (repl["mem_opt_state_peak_bytes"]
+                      / max(1, wus["mem_opt_state_peak_bytes"]))
     loss_delta_pct = (100.0 * abs(wus["final_loss"] - repl["final_loss"])
                       / abs(repl["final_loss"]))
     wire_ratio = wus["total_wire_bytes"] / max(1.0, repl["total_wire_bytes"])
@@ -193,6 +215,7 @@ def main() -> int:
         "replicated": repl,
         "wus": wus,
         "opt_grad_reclaim_factor": round(reclaim, 2),
+        "opt_state_reclaim_from_mem_ledger": round(ledger_reclaim, 2),
         "final_loss_delta_pct": round(loss_delta_pct, 5),
         "wire_ratio_wus_over_repl": round(wire_ratio, 4),
         "analytic_total_bytes": round(predicted.total_bytes, 1),
@@ -209,6 +232,18 @@ def main() -> int:
     # Falsifiable claims (the ISSUE-9 acceptance bar):
     # (N-1)/N of optimizer+synced-grad bytes reclaimed -> >= 2x on DP=4
     assert reclaim >= 2.0, reclaim
+    # ...and the memory ledger reproduces the reclaim from the compiled
+    # HLO alone: the replicated momentum class peak is ~4x the wus shard
+    assert ledger_reclaim >= 3.5, (
+        ledger_reclaim, repl["mem_opt_state_peak_bytes"],
+        wus["mem_opt_state_peak_bytes"])
+    # the static watermark tracks memory_analysis on both lowerings.
+    # ±15% here (vs ±10% on the recipe sweep): this MLP is wide enough
+    # that collective scratch dominates the temp set, and XLA:CPU
+    # all-reduces the gradient tree in place — a sharing the conservative
+    # watermark declines to assume, overshooting by roughly one grad tree.
+    for row in (repl, wus):
+        assert row["mem_residual_pct"] <= 15.0, row
     # equal-numerics: 40-step final loss within 0.1% of replicated DP
     assert loss_delta_pct <= 0.1, loss_delta_pct
     # free lunch: wus wire bytes within 5% of the all-reduce's (padding)

@@ -124,8 +124,10 @@ class Lowering:
 def lower_jitted(jitted, args: Sequence[Any], *, name: str, mesh=None,
                  donate: Optional[Sequence[int]] = None) -> Lowering:
     """The expensive half of the analysis: lower + compile + jaxpr."""
+    global _COMPILE_COUNT
     import jax
 
+    _COMPILE_COUNT += 1
     compiled = jitted.lower(*args).compile()
     return Lowering(
         name=name, jitted=jitted, args=tuple(args),
@@ -135,6 +137,14 @@ def lower_jitted(jitted, args: Sequence[Any], *, name: str, mesh=None,
 
 
 _LOWERING_CACHE: Dict[str, Lowering] = {}
+_COMPILE_COUNT = 0
+
+
+def compile_count() -> int:
+    """AOT lower+compile sweeps paid by this process so far.  The
+    zero-extra-compiles fence: tests snapshot it around the memory-ledger
+    sweep to prove ledgering rides the cached lowerings."""
+    return _COMPILE_COUNT
 
 
 def get_lowering(name: str) -> Lowering:
@@ -698,6 +708,29 @@ def sweep_comm_ledgers(names: Optional[Sequence[str]] = None):
     selected = list(RECIPES) if names is None else [
         n for n in names if n in RECIPES]
     return [comm_ledger_for(n) for n in selected]
+
+
+def mem_ledger_for(name: str):
+    """The live-range memory ledger (obs/memory.py) for one recipe, off
+    the shared lowering cache — the ``memory_analysis()`` ground truth
+    and per-argument buffer classes ride the same compiled record, so
+    the whole sweep is zero extra compiles."""
+    from pytorch_distributed_tpu.obs import comms, memory
+
+    low = get_lowering(name)
+    return memory.ledger_from_hlo_text(
+        low.text, step=name, mesh_shape=low.mesh_shape,
+        arg_classes=memory.arg_classes_of(low.args),
+        measured_peak_bytes=comms.compiled_peak_bytes(low.compiled))
+
+
+def sweep_mem_ledgers(names: Optional[Sequence[str]] = None):
+    """Memory ledgers for every (or the named subset of) recipe step —
+    ``scripts/shardlint.py --mem-ledger`` serializes these to
+    ``mem_ledger.json``."""
+    selected = list(RECIPES) if names is None else [
+        n for n in names if n in RECIPES]
+    return [mem_ledger_for(n) for n in selected]
 
 
 def analyze_all(names: Optional[Sequence[str]] = None,

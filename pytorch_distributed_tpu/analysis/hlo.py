@@ -144,6 +144,70 @@ def parse_instructions(hlo_text: str) -> List[Instruction]:
     return instrs
 
 
+def entry_computation_name(hlo_text: str) -> str:
+    """Name of the module's ENTRY computation ("" when absent).
+
+    ``parse_instructions`` strips the ``ENTRY`` prefix when recording the
+    ``computation`` field, so schedule walkers (obs/memory.py) need the
+    raw-line scan here to know *which* computation is the entry."""
+    for raw in hlo_text.splitlines():
+        s = raw.lstrip()
+        if not s.startswith("ENTRY"):
+            continue
+        m = _COMPUTATION_RE.match(s)
+        if m is not None:
+            return m.group("name")
+    return ""
+
+
+_OPERAND_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def instruction_operands(ins: Instruction) -> List[str]:
+    """Operand instruction names of one parsed instruction, in order.
+
+    Post-optimization HLO prints operands as ``type %name`` tokens inside
+    the opcode's balanced parens (``dot(f32[8,16]{1,0} %Arg_0.1, ...)``);
+    attributes after the close paren (``calls=%fused_computation``,
+    ``to_apply=%region``) reference computations, not values, and are
+    excluded by the balanced scan.  This is the def-use edge extractor
+    under the memory ledger's live-range analysis."""
+    m = _INSTR_RE.match(ins.line)
+    if m is None:
+        return []
+    rhs = m.group("rhs")
+    split = _result_type_and_opcode(rhs)
+    if split is None:
+        return []
+    type_text, opcode = split
+    start = rhs.find(opcode + "(", len(type_text) - 1)
+    if start < 0:
+        return []
+    open_paren = start + len(opcode)
+    depth, i = 0, open_paren
+    while i < len(rhs):
+        if rhs[i] == "(":
+            depth += 1
+        elif rhs[i] == ")":
+            depth -= 1
+            if depth == 0:
+                break
+        i += 1
+    region = rhs[open_paren + 1:i]
+    return _OPERAND_REF_RE.findall(region)
+
+
+_PARAM_NUM_RE = re.compile(r"parameter\((\d+)\)")
+
+
+def parameter_number(ins: Instruction) -> Optional[int]:
+    """Entry-parameter number of a ``parameter(N)`` instruction, else None."""
+    if ins.opcode != "parameter":
+        return None
+    m = _PARAM_NUM_RE.search(ins.line)
+    return int(m.group(1)) if m else None
+
+
 def collect_collectives(
     instrs: Iterable[Instruction],
 ) -> Dict[str, Dict[str, int]]:

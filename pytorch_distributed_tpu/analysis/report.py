@@ -19,6 +19,9 @@ kinds, so CI can gate on severity instead of grepping HLO text per PR:
   (``convert_element_type`` in the jaxpr, global shape ≥ threshold).
 - ``collective-regression`` (error) — per-step collective count/bytes above
   the checked-in baseline (EQuARX-style collective-bytes budget).
+- ``memory-budget`` (error) — per-device peak HBM (temp + argument + output
+  from ``memory_analysis()``) above the checked-in per-step budget: the
+  PR-1 replicated-accumulator class caught by *bytes*, not pattern.
 - ``host-sync`` (error) — a blocking device→host conversion inside a train
   hot loop (analysis/astlint.py).
 """
@@ -31,6 +34,10 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 SEVERITIES = ("error", "warn", "info")
 
+# Fractional headroom on the pinned per-step peak-HBM budget before a
+# ``memory-budget`` error fires (compiler scheduling jitter, not hazards).
+MEM_BUDGET_SLACK = 0.02
+
 KINDS = (
     "replicated-large-tensor",
     "replicated-state",
@@ -38,6 +45,7 @@ KINDS = (
     "no-donation",
     "dtype-promotion",
     "collective-regression",
+    "memory-budget",
     "host-sync",
 )
 
@@ -115,13 +123,18 @@ def baseline_entry(report: StepReport) -> Dict[str, Any]:
 
     ``total_bytes`` pins the cross-kind sum so a reshuffle that trades,
     say, all-gathers for a bigger all-reduce while raising the wire total
-    still fails, even when no single kind exceeds its own line."""
+    still fails, even when no single kind exceeds its own line.
+
+    ``peak_hbm_bytes`` pins the per-device compiled footprint (temp +
+    argument + output from ``memory_analysis()``) so a layout change that
+    silently re-replicates state fails shardlint by *bytes*."""
     return {
         "collectives": {
             k: {"count": v["count"], "bytes": v["bytes"]}
             for k, v in sorted(report.collectives.items())
         },
         "total_bytes": sum(v["bytes"] for v in report.collectives.values()),
+        "peak_hbm_bytes": sum(report.memory.values()),
     }
 
 
@@ -174,6 +187,29 @@ def diff_against_baseline(report: StepReport,
                 bytes=now_total - ref_total,
                 message=(f"per-step collective bytes budget exceeded: "
                          f"{now_total} B total vs baseline {ref_total} B"),
+            ))
+    # the per-step peak-HBM budget (absent from pre-mem-ledger baselines:
+    # skipped until --update-baseline refreshes the pin).  A small slack
+    # absorbs scheduler jitter across compiler point releases; a real
+    # re-replication blows through it by whole buffer sizes.
+    ref_peak = entry.get("peak_hbm_bytes")
+    if ref_peak is not None and report.memory:
+        now_peak = sum(report.memory.values())
+        if now_peak > ref_peak * (1 + MEM_BUDGET_SLACK):
+            findings.append(Finding(
+                kind="memory-budget", severity="error",
+                where=f"{report.name}:peak_hbm",
+                bytes=now_peak - ref_peak,
+                message=(f"per-device peak HBM budget exceeded: {now_peak} B "
+                         f"vs baseline {ref_peak} B "
+                         f"(+{100.0 * (now_peak - ref_peak) / ref_peak:.1f}%)"),
+            ))
+        elif now_peak < ref_peak * (1 - MEM_BUDGET_SLACK):
+            findings.append(Finding(
+                kind="memory-budget", severity="info",
+                where=f"{report.name}:peak_hbm",
+                message=(f"peak HBM below baseline ({now_peak} B vs "
+                         f"{ref_peak} B): refresh with --update-baseline"),
             ))
     return findings
 

@@ -75,6 +75,7 @@ from pytorch_distributed_tpu.obs.heartbeat import (
     HeartbeatWriter,
     find_stragglers,
     read_heartbeats,
+    sample_process_memory,
 )
 from pytorch_distributed_tpu.obs.metrics import (
     REQUIRED_FIELDS,
@@ -97,6 +98,7 @@ __all__ = [
     "HeartbeatWriter",
     "read_heartbeats",
     "find_stragglers",
+    "sample_process_memory",
     "scope",
     "annotate",
     "capture",

@@ -566,6 +566,109 @@ def lm_comm_bytes(vocab_size: int, d_model: int, n_layers: int, batch: int,
                    "head_permutes": permutes, "scalars": scalars})
 
 
+# ----------------------------------------------------------- memory estimates
+@dataclasses.dataclass(frozen=True)
+class MemCost:
+    """Analytic per-device peak-HBM model for one train step.
+
+    The memory-side twin of ``CommCost``: what the state layout and
+    activation schedule *should* keep resident at the step's high-water
+    mark, cross-checked against the static ledger (obs/memory.py) the
+    same way comm estimates are fenced against the measured ledger —
+    tests/test_memory.py pins the residual at ±15%.
+
+    The accounting deliberately mirrors ``memory_analysis()``'s naive
+    temp + argument + output sum (donated buffers counted on both sides)
+    so the number is comparable to both the ledger and the compiler.
+    """
+
+    argument_bytes: float
+    output_bytes: float
+    temp_bytes: float
+    breakdown: Dict[str, float]
+
+    @property
+    def peak_bytes(self) -> float:
+        return self.argument_bytes + self.output_bytes + self.temp_bytes
+
+
+# Same fence arithmetic for memory as for comms — re-exported under the
+# name the memory tests read.
+mem_residual_pct = comm_residual_pct
+
+
+def train_mem_peak(param_bytes: float, act_bytes: float,
+                   data_bytes: float = 0.0, *, dp: int = 4,
+                   zero: bool = False, explicit_sync: bool = True,
+                   metric_bytes: float = 128.0) -> MemCost:
+    """Generic train-step peak-HBM model from first principles:
+
+    - **arguments**: params + momentum + the per-device batch shard.
+      Under ``--zero wus`` the momentum tree lives as owned 1/dp chunks.
+    - **outputs**: the new state (same layout) + the scalar metrics
+      tuple.  Donation aliases outputs onto arguments, but the compiler's
+      accounting (and so the ledger's) books both sides — so does this.
+    - **temps**: the gradient tree (one param-tree copy, live from
+      backward until the update consumes it) + the live activation /
+      saved-residual bytes at the backward peak.  ``explicit_sync`` adds
+      the hand-written grad-sync path's materialized scratch: one synced
+      tree for the all-reduce (or the gathered delta under zero), plus
+      the owned-chunk stack between the reduce-scatter and all-gather
+      hops.  GSPMD steps sync in place — pass ``explicit_sync=False``.
+    """
+    dp = max(1, int(dp))
+    momentum = param_bytes / dp if zero else param_bytes
+    state = param_bytes + momentum
+    grads = param_bytes
+    sync = 0.0
+    if explicit_sync and dp > 1:
+        sync = param_bytes + (param_bytes / dp if zero else 0.0)
+    temp = grads + act_bytes + sync
+    return MemCost(
+        argument_bytes=state + data_bytes,
+        output_bytes=state + metric_bytes,
+        temp_bytes=temp,
+        breakdown={"params": param_bytes, "momentum": momentum,
+                   "data": data_bytes, "grads": grads,
+                   "activations": act_bytes, "grad_sync_scratch": sync,
+                   "metrics": metric_bytes})
+
+
+def lm_act_bytes(d_model: int, n_layers: int, n_heads: int, batch: int,
+                 seq_len: int, vocab_size: int, *, dp: int = 4,
+                 mlp_ratio: int = 4, elem: float = 4.0) -> float:
+    """Live activation/saved-residual bytes at the LM backward peak, per
+    device (``b = batch/dp`` rows).  Per layer per token the autodiff
+    schedule stashes ~9 d-wide tensors (ln1, qkv, attn out, proj out,
+    two residual adds, ln2, fc2 out) + 2 mlp-wide (fc1 out, gelu out) +
+    the two [H, T, T] score/softmax matrices; the loss head holds the
+    logits block plus ~2x for log-softmax and its gradient."""
+    b = batch / max(1, int(dp))
+    per_token = 9.0 * d_model + 2.0 * mlp_ratio * d_model
+    scores = 2.0 * n_heads * seq_len
+    stack = b * seq_len * n_layers * (per_token + scores)
+    head = 3.0 * b * seq_len * vocab_size
+    return elem * (stack + head)
+
+
+def lm_train_mem_peak(vocab_size: int, d_model: int, n_layers: int,
+                      n_heads: int, batch: int, seq_len: int, *,
+                      dp: int = 4, zero: bool = False,
+                      mlp_ratio: int = 4) -> MemCost:
+    """Analytic peak HBM for the GSPMD transformer-LM train step: tied
+    embedding + block stack params (f32), momentum (1/dp-sharded under
+    ``--zero wus``), the lm_act_bytes schedule, int32 token shard.
+    GSPMD derives the grad sync in place, so no explicit scratch term."""
+    params = lm_step_cost(vocab_size, d_model, n_layers, batch,
+                          seq_len, mlp_ratio=mlp_ratio).params
+    act = lm_act_bytes(d_model, n_layers, n_heads, batch, seq_len,
+                       vocab_size, dp=dp, mlp_ratio=mlp_ratio)
+    tokens = 4.0 * (batch / max(1, dp)) * seq_len + 8.0  # int32 + lr/step
+    return train_mem_peak(4.0 * params, act, data_bytes=tokens, dp=dp,
+                          zero=zero, explicit_sync=False,
+                          metric_bytes=256.0)
+
+
 # ------------------------------------------------------------------ reporter
 class MFUReporter:
     """Turns host-measured step seconds into per-step MFU/HFU fields for

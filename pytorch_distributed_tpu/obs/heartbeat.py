@@ -38,6 +38,36 @@ from typing import Dict, Optional
 _PREFIX = "heartbeat-"
 
 
+def sample_process_memory() -> Optional[int]:
+    """Best-effort per-process memory sample, in bytes.
+
+    Device-first: when jax is already imported (the trainer side), the
+    first local device's ``memory_stats()`` ``bytes_in_use`` is the
+    number that matters — live HBM, the thing that OOMs.  Backends
+    without stats (CPU, the simulated mesh) fall back to the process RSS
+    from ``/proc/self/status`` — still enough for the monitor to see one
+    rank's memory balloon away from the fleet.  Never imports jax itself
+    (this module stays stdlib-only for the monitor side) and never
+    raises; returns None when nothing is measurable."""
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            stats = sys.modules["jax"].local_devices()[0].memory_stats()
+            if stats and "bytes_in_use" in stats:
+                return int(stats["bytes_in_use"])
+        except Exception:
+            pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
 class HeartbeatWriter:
     """Appends ``{pid, step, t}`` beats for one process to
     ``<hb_dir>/heartbeat-<pid>.jsonl``.
@@ -83,13 +113,16 @@ class HeartbeatWriter:
 
     def beat(self, step: int, force: bool = False,
              step_time_ema: Optional[float] = None,
-             last_ft: Optional[str] = None) -> bool:
+             last_ft: Optional[str] = None,
+             mem_bytes: Optional[int] = None) -> bool:
         """Record a beat at ``step``; returns True when a line was written.
 
         ``step_time_ema`` (seconds) and ``last_ft`` (the most recent
         ft_event kind) ride along when given, so the monitor can tell a
         *slow* rank (fresh beats, fat EMA) from a *dead* one (stale beats)
-        and see whether the rank already said why it is behind."""
+        and see whether the rank already said why it is behind.
+        ``mem_bytes`` (``sample_process_memory``) rides along the same
+        way: a rank creeping toward OOM announces it beats ahead."""
         now = time.time()
         if not force and now - self._last < self.interval_s:
             return False
@@ -102,6 +135,8 @@ class HeartbeatWriter:
             rec["ema"] = float(step_time_ema)
         if last_ft is not None:
             rec["last_ft"] = str(last_ft)
+        if mem_bytes is not None:
+            rec["mem"] = int(mem_bytes)
         self._lines.append(json.dumps(rec))
         del self._lines[:-self.MAX_LINES]
         # Atomic rewrite: liveness decisions (elastic eviction) must never
@@ -115,10 +150,11 @@ class HeartbeatWriter:
 
     def close(self, step: Optional[int] = None,
               step_time_ema: Optional[float] = None,
-              last_ft: Optional[str] = None) -> None:
+              last_ft: Optional[str] = None,
+              mem_bytes: Optional[int] = None) -> None:
         if step is not None:
             self.beat(step, force=True, step_time_ema=step_time_ema,
-                      last_ft=last_ft)
+                      last_ft=last_ft, mem_bytes=mem_bytes)
 
 
 def read_heartbeats(hb_dir: str,
@@ -180,7 +216,10 @@ def find_stragglers(
       alone, since a stuck rank stalls every rank's step;
     - a beat's ``last_ft`` event kind is appended to the reason when
       present, so a rank that already said why it is behind (preempt,
-      rollback) reads differently from a silent one.
+      rollback) reads differently from a silent one;
+    - a beat's per-process memory sample (``mem``, bytes) is appended
+      the same way — a flagged rank whose memory sits far above the
+      fleet's reads as "about to OOM", not merely slow.
     """
     if not beats:
         return {}
@@ -211,6 +250,8 @@ def find_stragglers(
                 f"beat age {age:.1f}s > {max_age_s:.0f}s (dead or hung)")
         if reasons and b.get("last_ft"):
             reasons.append(f"last ft_event: {b['last_ft']}")
+        if reasons and b.get("mem") is not None:
+            reasons.append(f"mem {b['mem'] / 2**20:.0f} MiB")
         if reasons:
             flagged[pid] = "; ".join(reasons)
     return flagged

@@ -1,0 +1,622 @@
+"""Static per-device memory ledger: live-range watermark + peak attribution.
+
+The comm ledger (obs/comms.py) itemizes every *wire* byte; this module
+does the same for *resident* bytes.  From one compiled step's
+post-optimization HLO (``is_scheduled=true`` — the printed instruction
+order IS the execution schedule) it walks the entry computation, computes
+each value's definition/last-use live range from its shapes, and builds:
+
+- a per-instruction **watermark curve** — ``argument + output + live
+  temporaries`` at every schedule point — whose peak is fenced against
+  ``compiled.memory_analysis()`` (temp + argument + output, the same
+  accounting as ``comms.compiled_peak_bytes``) within ±10%;
+- **peak attribution**: the top-k live buffers at the high-water mark,
+  each with shape, dtype, and the ``named_scope`` phase
+  (forward/backward/grad_sync/optimizer/pp_*) its producer lowered under;
+- a classified breakdown — params / optimizer state / input data
+  (argument classes, from the caller's args pytree), activations &
+  saved residuals / collective scratch (temporaries, by opcode + phase),
+  and outputs.
+
+Accounting conventions (chosen to match XLA's buffer assignment, which
+``memory_analysis`` reports):
+
+- Arguments and outputs are whole-program allocations: ``argument_bytes``
+  and ``output_bytes`` are constant terms under the curve.  Donated
+  inputs alias output buffers at runtime, but ``memory_analysis`` sums
+  the three allocation classes without deducting aliasing — the ledger
+  mirrors that (``donated_bytes`` records the overlap separately).
+- View/bookkeeping ops (``tuple``, ``get-tuple-element``, ``bitcast``,
+  async ``*-done``) allocate nothing; they forward liveness to their
+  operands.
+- Values whose only consumer is a ``tuple``-shaped root are written
+  straight into the output allocation (counted by ``output_bytes``),
+  not the temp set.
+- Elementwise ops and loop fusions may write in place over a dying
+  operand (XLA's ``CanShareOperandBufferWithUser``): when such an op's
+  operand takes its last use at the defining instruction and is at least
+  result-sized, the result's bytes are credited back at that schedule
+  point.
+
+Like the rest of the ``analysis/hlo.py`` stack this is pure text
+parsing — no jax import — so ledgers build (and unit-test) from HLO
+fixtures; ``ledger_from_jitted`` / ``arg_classes_of`` are the only
+entry points that touch jax, and import it lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.analysis import hlo as hlo_mod
+from pytorch_distributed_tpu.obs.comms import (
+    compiled_peak_bytes,
+    phase_of_op_name,
+)
+
+# Buffer classes in the breakdown.  Argument buffers carry
+# params/opt_state/data (from arg_classes_of, "data" when unknown);
+# temporaries are activations or collective scratch; the root is output.
+CLASSES = ("params", "opt_state", "data",
+           "activations", "collective", "output")
+
+# View/bookkeeping opcodes: no allocation, liveness forwards to operands.
+# ``while`` belongs here because XLA requires loop state to alias in
+# place (body parameters = body results = while result): the carried
+# buffers are the init values, already counted at their own defs.
+_ALIAS_OPCODES = frozenset({"tuple", "get-tuple-element", "bitcast", "while"})
+
+# Opcodes whose result may share a dying operand's buffer (XLA's
+# elementwise/loop-fusion sharing, plus the in-place-update family).
+_SHAREABLE_OPCODES = frozenset({
+    "fusion", "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "negate", "abs", "exponential", "log", "tanh", "sqrt", "rsqrt", "power",
+    "select", "convert", "and", "or", "xor", "not", "clamp", "compare",
+    "dynamic-update-slice", "scatter", "copy",
+    # XLA:CPU wraps parallelized fusions in call(...,
+    # to_apply=%parallel_*_fusion) — same sharing rules as the fusion
+    "call",
+})
+
+
+def _is_alias(ins: hlo_mod.Instruction) -> bool:
+    return ins.opcode in _ALIAS_OPCODES or ins.opcode.endswith("-done")
+
+
+@dataclasses.dataclass
+class MemBuffer:
+    """One tracked buffer: an entry argument, a temporary, or an output."""
+
+    name: str
+    bytes: int
+    dtype: str
+    dims: List[int]
+    klass: str            # one of CLASSES
+    phase: str            # producer scope phase (phase_of_op_name)
+    op_name: str          # full jax scope path from metadata
+    source: str           # "file:line"
+    defined_at: int       # schedule index (-1: live at entry — args/outputs)
+    last_use: int         # schedule index of last consumer
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class MemLedger:
+    """Everything the memory ledger knows about one compiled step."""
+
+    step: str
+    mesh_shape: Dict[str, int] = dataclasses.field(default_factory=dict)
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    donated_bytes: int = 0           # argument bytes aliased to outputs
+    peak_bytes: int = 0              # watermark peak (arg + out + temps)
+    peak_index: int = 0              # schedule index of the high-water mark
+    n_instructions: int = 0
+    # Compiled ground truth (comms.compiled_peak_bytes); 0.0 = unknown
+    # (text fixtures, old ledger files).
+    measured_peak_bytes: float = 0.0
+    # Watermark change points [[schedule_index, bytes], ...] — the curve
+    # is a step function; only points where the value moves are kept.
+    watermark: List[List[int]] = dataclasses.field(default_factory=list)
+    # Every tracked buffer, program order (args first at defined_at=-1).
+    buffers: List[MemBuffer] = dataclasses.field(default_factory=list)
+
+    @property
+    def temp_peak_bytes(self) -> int:
+        return self.peak_bytes - self.argument_bytes - self.output_bytes
+
+    def residual_pct(self) -> float:
+        """Watermark-vs-measured disagreement, % of measured (the ±10%
+        fence); 0.0 when no measured peak is attached."""
+        if not self.measured_peak_bytes:
+            return 0.0
+        return abs(self.peak_bytes - self.measured_peak_bytes) \
+            / self.measured_peak_bytes * 100.0
+
+    def live_at(self, index: int) -> List[MemBuffer]:
+        """Buffers resident at one schedule point (args/outputs always)."""
+        out = []
+        for b in self.buffers:
+            if b.defined_at < 0 or b.defined_at <= index <= b.last_use:
+                out.append(b)
+        return out
+
+    def top_buffers(self, k: int = 10) -> List[MemBuffer]:
+        """The top-k live buffers at the high-water mark, largest first."""
+        live = sorted(self.live_at(self.peak_index),
+                      key=lambda b: (-b.bytes, b.name))
+        return live[:k]
+
+    def class_peaks(self) -> Dict[str, int]:
+        """Per-class peak resident bytes over the schedule.
+
+        Argument and output classes are whole-program constants; temp
+        classes (activations, collective) report the max of their own
+        live curves — the number the ZeRO-reclaim and fused-CE fences
+        compare across recipes."""
+        return self._grouped_peaks(lambda b: b.klass)
+
+    def phase_peaks(self) -> Dict[str, int]:
+        """Per-producer-phase peak resident bytes (grad_sync, optimizer,
+        backward, ...) over the temp set.  Whole-program buffers (args,
+        outputs) carry no producer phase and land in ``"resident"``."""
+        return self._grouped_peaks(
+            lambda b: b.phase if b.defined_at >= 0 else "resident")
+
+    def _grouped_peaks(self, key) -> Dict[str, int]:
+        constant: Dict[str, int] = {}
+        deltas_by_group: Dict[str, Dict[int, int]] = {}
+        for b in self.buffers:
+            g = key(b)
+            if b.defined_at < 0:
+                constant[g] = constant.get(g, 0) + b.bytes
+            else:
+                d = deltas_by_group.setdefault(g, {})
+                d[b.defined_at] = d.get(b.defined_at, 0) + b.bytes
+                d[b.last_use + 1] = d.get(b.last_use + 1, 0) - b.bytes
+        out = dict(constant)
+        for g, deltas in deltas_by_group.items():
+            cur = peak = 0
+            for i in sorted(deltas):
+                cur += deltas[i]
+                peak = max(peak, cur)
+            out[g] = out.get(g, 0) + peak
+        return out
+
+    def metrics_fields(self) -> Dict[str, float]:
+        """Per-step fields the trainers stamp into the metrics JSONL."""
+        fields = {
+            "mem_peak_bytes": float(self.peak_bytes),
+            "mem_temp_peak_bytes": float(self.temp_peak_bytes),
+        }
+        if self.measured_peak_bytes:
+            fields["mem_residual_pct"] = self.residual_pct()
+        return fields
+
+    def to_dict(self, top_k: int = 32) -> Dict[str, Any]:
+        return {
+            "step": self.step,
+            "mesh_shape": dict(self.mesh_shape),
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "donated_bytes": self.donated_bytes,
+            "peak_bytes": self.peak_bytes,
+            "peak_index": self.peak_index,
+            "n_instructions": self.n_instructions,
+            "measured_peak_bytes": self.measured_peak_bytes,
+            "residual_pct": self.residual_pct(),
+            "class_peaks": self.class_peaks(),
+            "phase_peaks": self.phase_peaks(),
+            "watermark": [list(p) for p in self.watermark],
+            "top": [b.to_dict() for b in self.top_buffers(top_k)],
+        }
+
+
+_GTE_INDEX_RE = re.compile(r"\bindex=(\d+)")
+_CALLED_COMP_RE = re.compile(r"\b(?:body|to_apply)=%?([\w.\-]+)")
+
+
+def _operand_map(
+    instrs: Sequence[hlo_mod.Instruction],
+) -> List[List[int]]:
+    """Per-instruction operand indices (same-computation defs only)."""
+    index = {ins.name: i for i, ins in enumerate(instrs)}
+    return [[index[n] for n in hlo_mod.instruction_operands(ins)
+             if n in index]
+            for ins in instrs]
+
+
+def _last_uses(
+    instrs: Sequence[hlo_mod.Instruction],
+    operands: Sequence[Sequence[int]],
+) -> Tuple[List[List[int]], List[int], int]:
+    """Element-aware live ranges over one computation's schedule.
+
+    Returns ``(last_use, use_counts, root_idx)`` where ``last_use[i][k]``
+    is the last schedule index at which element ``k`` of instruction
+    ``i``'s result is read.  Tuple elements die independently: a
+    ``get-tuple-element(index=k)`` consumer extends only element ``k``,
+    a ``tuple`` maps its elements back onto its operands positionally,
+    and a ``while`` (whose loop state aliases in place) forwards each
+    result element's lifetime to the matching init element.  Any
+    consumer the mapping can't see through extends every element."""
+    n = len(instrs)
+    m = [max(1, len(ins.shapes)) for ins in instrs]
+    last = [[i] * m[i] for i in range(n)]
+    use_counts = [0] * n
+    root_idx = next((i for i in range(n - 1, -1, -1) if instrs[i].is_root),
+                    n - 1)
+    if n:
+        last[root_idx] = [n - 1] * m[root_idx]
+    for j in range(n - 1, -1, -1):
+        ins = instrs[j]
+        ops = operands[j]
+        for t in set(ops):
+            use_counts[t] += 1
+        alias = _is_alias(ins)
+        reach_all = max(last[j]) if alias else j
+        if ins.opcode == "get-tuple-element" and ops:
+            t = ops[0]
+            k_m = _GTE_INDEX_RE.search(ins.line)
+            k = int(k_m.group(1)) if k_m else None
+            if k is not None and m[t] > 1 and k < m[t]:
+                last[t][k] = max(last[t][k], reach_all)
+            else:
+                for e in range(m[t]):
+                    last[t][e] = max(last[t][e], reach_all)
+        elif ins.opcode == "tuple" and len(ops) == m[j]:
+            for p, t in enumerate(ops):
+                for e in range(m[t]):
+                    last[t][e] = max(last[t][e], last[j][p])
+        elif alias and len(ops) == 1 and m[ops[0]] == m[j]:
+            # while / bitcast / *-done: elements map through 1:1
+            t = ops[0]
+            for e in range(m[t]):
+                last[t][e] = max(last[t][e], last[j][e])
+        else:
+            for t in ops:
+                for e in range(m[t]):
+                    last[t][e] = max(last[t][e], reach_all)
+    return last, use_counts, root_idx
+
+
+@dataclasses.dataclass
+class _TempSpec:
+    """One temp allocation interval inside a computation walk."""
+
+    index: int          # defining schedule index
+    elem: int           # tuple element (0 for scalar results)
+    bytes: int
+    last_use: int
+    body: bool = False  # True: a while/call body's working-set peak
+
+
+def _collect_temps(
+    instrs: Sequence[hlo_mod.Instruction],
+    operands: Sequence[Sequence[int]],
+    last: Sequence[Sequence[int]],
+    use_counts: Sequence[int],
+    root_idx: int,
+    body_peak,  # (computation_name) -> int
+) -> Tuple[List[_TempSpec], List[int]]:
+    """Temp allocations + per-index in-place sharing credits.
+
+    Skips parameters (argument/carried-state allocations), aliases
+    (views), the root and values whose only consumer is a tuple root
+    (written straight into the output/carried allocation).  ``while``
+    and ``call`` instructions contribute their callee's working-set
+    peak as a one-index allocation — the body runs entirely within
+    that schedule slot."""
+    n = len(instrs)
+    root_is_tuple = bool(n) and instrs[root_idx].opcode == "tuple"
+    root_operands = set(operands[root_idx]) if n else set()
+    temps: List[_TempSpec] = []
+    temp_total: Dict[int, int] = {}   # index -> own allocation bytes
+
+    for i, ins in enumerate(instrs):
+        if ins.opcode == "parameter":
+            continue
+        if ins.opcode in ("while", "call"):
+            cm = _CALLED_COMP_RE.search(ins.line)
+            extra = body_peak(cm.group(1)) if cm else 0
+            if extra:
+                temps.append(_TempSpec(index=i, elem=0, bytes=extra,
+                                       last_use=i, body=True))
+        if _is_alias(ins):
+            continue
+        if i == root_idx:
+            continue  # the root's bytes are the output allocation
+        if root_is_tuple and i in root_operands and use_counts[i] == 1:
+            continue  # written straight into the output allocation
+        shapes = ins.shapes or [("", ())]
+        for k, s in enumerate(shapes):
+            b = hlo_mod.shape_bytes(s)
+            lu = last[i][k] if k < len(last[i]) else max(last[i])
+            temps.append(_TempSpec(index=i, elem=k, bytes=b, last_use=lu))
+            temp_total[i] = temp_total.get(i, 0) + b
+
+    # in-place sharing: a shareable op whose operand takes its last use
+    # at the defining instruction writes over that operand's buffer
+    credit = [0] * n
+    alias_src = {i: operands[i][0] for i, ins in enumerate(instrs)
+                 if _is_alias(ins) and operands[i]}
+
+    def _resolved(i: int) -> int:
+        seen = set()
+        while i in alias_src and i not in seen:
+            seen.add(i)
+            i = alias_src[i]
+        return i
+
+    for i, ins in enumerate(instrs):
+        own = temp_total.get(i, 0)
+        if not own or ins.opcode not in _SHAREABLE_OPCODES:
+            continue
+        for oi in operands[i]:
+            src = _resolved(oi)
+            src_bytes = temp_total.get(src, 0)
+            if src_bytes >= own and max(last[src]) == i:
+                credit[i] = own
+                break
+    return temps, credit
+
+
+def _temps_peak(temps: Sequence[_TempSpec], credit: Sequence[int],
+                n: int) -> Tuple[int, int, List[List[int]]]:
+    """Sweep a computation's temp intervals into ``(peak, peak_index,
+    change_points)``; body allocations live only at their own index."""
+    start_add = [0] * (n + 1)
+    end_sub = [0] * (n + 1)
+    for t in temps:
+        start_add[t.index] += t.bytes
+        end_sub[t.last_use] += t.bytes
+    points: List[List[int]] = []
+    cur = 0
+    peak, peak_index = 0, 0
+    prev = None
+    for i in range(n):
+        cur += start_add[i]
+        level = cur - (credit[i] if i < len(credit) else 0)
+        if level > peak:
+            peak, peak_index = level, i
+        if level != prev:
+            points.append([i, level])
+            prev = level
+        cur -= end_sub[i]
+    return peak, peak_index, points
+
+
+def _computation_peak(name: str, by_comp, memo: Dict[str, int]) -> int:
+    """Working-set peak of one non-entry computation (a while/call body),
+    recursing into nested bodies.  Parameters alias the caller's carried
+    buffers and root-only values write back into them, so only genuine
+    body temporaries count — the bytes XLA's heap must find *on top of*
+    the carried state while the loop runs."""
+    if name in memo:
+        return memo[name]
+    memo[name] = 0  # cycle guard
+    instrs = by_comp.get(name, [])
+    if not instrs:
+        return 0
+    operands = _operand_map(instrs)
+    last, use_counts, root_idx = _last_uses(instrs, operands)
+    temps, credit = _collect_temps(
+        instrs, operands, last, use_counts, root_idx,
+        lambda c: _computation_peak(c, by_comp, memo))
+    peak, _, _ = _temps_peak(temps, credit, len(instrs))
+    memo[name] = peak
+    return peak
+
+
+def ledger_from_hlo_text(
+    hlo_text: str,
+    step: str = "step",
+    mesh_shape: Optional[Dict[str, int]] = None,
+    arg_classes: Optional[Sequence[str]] = None,
+    measured_peak_bytes: float = 0.0,
+) -> MemLedger:
+    """Build the memory ledger for one compiled module's text.
+
+    ``arg_classes``: per-entry-parameter class labels (params/opt_state/
+    data) in parameter-number order, from ``arg_classes_of`` on the
+    caller's args pytree; unknown parameters default to "data"."""
+    entry = hlo_mod.entry_computation_name(hlo_text)
+    by_comp: Dict[str, List[hlo_mod.Instruction]] = {}
+    for ins in hlo_mod.parse_instructions(hlo_text):
+        by_comp.setdefault(ins.computation, []).append(ins)
+    instrs = by_comp.get(entry, [])
+    n = len(instrs)
+    operands = _operand_map(instrs)
+    last, use_counts, root_idx = _last_uses(instrs, operands)
+    memo: Dict[str, int] = {}
+    temps, credit = _collect_temps(
+        instrs, operands, last, use_counts, root_idx,
+        lambda c: _computation_peak(c, by_comp, memo))
+
+    # ---- constant terms from the module header
+    param_shapes = hlo_mod.entry_parameter_shapes(hlo_text)
+    argument_bytes = sum(hlo_mod.shape_bytes(s) for s in param_shapes)
+    out_shapes = hlo_mod.entry_output_shapes(hlo_text)
+    output_bytes = sum(hlo_mod.shape_bytes(s) for s in out_shapes)
+    donated_bytes = sum(
+        hlo_mod.shape_bytes(param_shapes[p]) for p in
+        hlo_mod.aliased_param_numbers(hlo_text) if p < len(param_shapes))
+    base = argument_bytes + output_bytes
+
+    # ---- attribution buffers: args, temps, outputs
+    arg_classes = list(arg_classes or [])
+    buffers: List[MemBuffer] = []
+    for i, ins in enumerate(instrs):
+        if ins.opcode != "parameter":
+            continue
+        op_name, source = hlo_mod.parse_op_metadata(ins.line)
+        num = hlo_mod.parameter_number(ins)
+        klass = arg_classes[num] if (
+            num is not None and num < len(arg_classes)) else "data"
+        dtype, dims = ins.shapes[0] if ins.shapes else ("", ())
+        buffers.append(MemBuffer(
+            name=ins.name, bytes=ins.result_bytes(), dtype=dtype,
+            dims=list(dims), klass=klass, phase="", op_name=op_name,
+            source=source, defined_at=-1, last_use=n - 1))
+    for t in temps:
+        ins = instrs[t.index]
+        op_name, source = hlo_mod.parse_op_metadata(ins.line)
+        phase = phase_of_op_name(op_name)
+        if t.body:
+            name, klass, dtype, dims = f"{ins.name}[body]", "activations", \
+                "", []
+        else:
+            name = ins.name if len(ins.shapes) <= 1 \
+                else f"{ins.name}#{t.elem}"
+            klass = "collective" if (
+                ins.opcode in hlo_mod._COLLECTIVE_SET
+                or ins.opcode.endswith("-start")) else "activations"
+            dtype, dims = ins.shapes[t.elem] if t.elem < len(ins.shapes) \
+                else ("", ())
+            dims = list(dims)
+        buffers.append(MemBuffer(
+            name=name, bytes=t.bytes, dtype=dtype, dims=dims, klass=klass,
+            phase=phase, op_name=op_name, source=source,
+            defined_at=t.index, last_use=t.last_use))
+    if output_bytes:
+        out_dtype, out_dims = out_shapes[0] if out_shapes else ("", ())
+        buffers.append(MemBuffer(
+            name="(outputs)", bytes=output_bytes, dtype=out_dtype,
+            dims=list(out_dims), klass="output", phase="", op_name="",
+            source="", defined_at=-1, last_use=n - 1))
+
+    # ---- watermark
+    temp_peak, peak_index, points = _temps_peak(temps, credit, n)
+    watermark = [[i, base + v] for i, v in points]
+    return MemLedger(
+        step=step, mesh_shape=dict(mesh_shape or {}),
+        argument_bytes=argument_bytes, output_bytes=output_bytes,
+        donated_bytes=donated_bytes, peak_bytes=base + temp_peak,
+        peak_index=peak_index, n_instructions=n,
+        measured_peak_bytes=float(measured_peak_bytes),
+        watermark=watermark, buffers=buffers)
+
+
+# --------------------------------------------------------------- jax side
+
+def arg_classes_of(args: Any) -> List[str]:
+    """Per-flattened-leaf buffer classes of a step's argument pytree, in
+    flatten order — which is jit's entry-parameter order.  Classification
+    is by pytree key path: TrainState fields named ``params`` are model
+    weights; ``momentum``/``mu``/``nu``/``opt``/``ef_*``/``residual`` are
+    optimizer state (incl. error-feedback residuals, which live exactly
+    as long as momentum does); everything else (batches, lr, rng) is
+    input data."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(args)
+    out = []
+    for path, _leaf in flat:
+        p = jax.tree_util.keystr(path).lower()
+        if any(t in p for t in ("momentum", ".mu", ".nu", "opt_state",
+                                "ef_", "residual")):
+            out.append("opt_state")
+        elif "param" in p or "batch_stats" in p:
+            out.append("params")
+        else:
+            out.append("data")
+    return out
+
+
+def ledger_from_compiled(
+    compiled,
+    *,
+    step: str = "step",
+    mesh_shape: Optional[Dict[str, int]] = None,
+    arg_classes: Optional[Sequence[str]] = None,
+    hlo_text: Optional[str] = None,
+) -> MemLedger:
+    """Ledger for an already-compiled step: parses ``as_text()`` (or the
+    caller's copy of it) and attaches the ``memory_analysis()`` ground
+    truth — the path the trainers use so one AOT compile feeds both the
+    comm and the memory ledger."""
+    text = hlo_text if hlo_text is not None else compiled.as_text()
+    return ledger_from_hlo_text(
+        text, step=step, mesh_shape=mesh_shape, arg_classes=arg_classes,
+        measured_peak_bytes=compiled_peak_bytes(compiled))
+
+
+def ledger_from_jitted(jitted, args: Sequence[Any], *, step: str = "step",
+                       mesh=None) -> MemLedger:
+    """Lower + compile a jitted step and build its memory ledger.  Same
+    caveat as ``comms.ledger_from_jitted``: the AOT path does not share
+    the jit call cache — one extra compile, so trainers gate it behind
+    ``--mem-ledger`` and reuse the comm ledger's lowering."""
+    compiled = jitted.lower(*args).compile()
+    mesh_shape = dict(mesh.shape) if mesh is not None else {}
+    return ledger_from_compiled(
+        compiled, step=step, mesh_shape=mesh_shape,
+        arg_classes=arg_classes_of(tuple(args)))
+
+
+# ------------------------------------------------------------ serialization
+
+def write_ledgers(path: str, ledgers: Sequence[MemLedger],
+                  top_k: int = 32) -> None:
+    """``mem_ledger.json``: ``{step_name: ledger_dict}``.  The buffer list
+    is truncated to the top-k at peak; the watermark curve keeps every
+    change point."""
+    data = {lg.step: lg.to_dict(top_k=top_k) for lg in ledgers}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_ledgers(path: str) -> Dict[str, MemLedger]:
+    """Round-trip of ``write_ledgers``.  The reconstructed ledger carries
+    the serialized top-k buffers (enough for attribution rendering and
+    every scalar fence); the full temp set is not persisted."""
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[str, MemLedger] = {}
+    for step, d in data.items():
+        out[step] = MemLedger(
+            step=step,
+            mesh_shape=d.get("mesh_shape", {}),
+            argument_bytes=int(d.get("argument_bytes", 0)),
+            output_bytes=int(d.get("output_bytes", 0)),
+            donated_bytes=int(d.get("donated_bytes", 0)),
+            peak_bytes=int(d.get("peak_bytes", 0)),
+            peak_index=int(d.get("peak_index", 0)),
+            n_instructions=int(d.get("n_instructions", 0)),
+            measured_peak_bytes=float(d.get("measured_peak_bytes", 0.0)),
+            watermark=[list(p) for p in d.get("watermark", [])],
+            buffers=[MemBuffer(**b) for b in d.get("top", [])])
+    return out
+
+
+# ------------------------------------------------------- Perfetto export
+
+def watermark_counter_events(
+    ledger: MemLedger,
+    t0_us: float,
+    t1_us: float,
+    pid: int = 0,
+    name: str = "hbm_watermark",
+) -> List[Dict[str, Any]]:
+    """The watermark curve as Chrome-trace counter events ("ph": "C") —
+    the Perfetto counter track obs_timeline merges into the cross-rank
+    trace.  The schedule has no wall-clock of its own, so change points
+    spread linearly over the step's measured ``[t0_us, t1_us]`` span."""
+    if not ledger.watermark or t1_us <= t0_us:
+        return []
+    span = t1_us - t0_us
+    denom = max(1, ledger.n_instructions - 1)
+    events = []
+    for idx, level in ledger.watermark:
+        events.append({
+            "ph": "C", "pid": pid, "name": name,
+            "ts": t0_us + span * (idx / denom),
+            "args": {"bytes": int(level)},
+        })
+    return events

@@ -503,13 +503,19 @@ def clock_offsets_from_heartbeats(hb_dir: str) -> Dict[int, float]:
 
 def to_chrome_trace(timelines: Sequence[Tuple[int, Timeline]],
                     offsets_s: Optional[Dict[int, float]] = None,
+                    mem_ledgers: Optional[Sequence[Any]] = None,
                     ) -> Dict[str, Any]:
     """Merge per-rank timelines into one Chrome-trace/Perfetto JSON dict.
 
     ``timelines``: ``(rank, Timeline)`` pairs; ``offsets_s``: per-rank
     clock offsets (``clock_offsets_from_heartbeats``) subtracted before
     merging.  pid = rank, tid = one per (plane, line) stream; times in
-    microseconds as the trace-event format requires."""
+    microseconds as the trace-event format requires.
+
+    ``mem_ledgers``: optional ``obs.memory.MemLedger`` list (from
+    ``--mem-ledger``); each ledger's watermark curve is stretched over
+    every rank's captured span and merged as a Perfetto counter track
+    ("ph": "C") so the HBM profile reads against the op timeline."""
     offsets_s = offsets_s or {}
     events: List[Dict[str, Any]] = []
     for rank, tl in timelines:
@@ -543,6 +549,15 @@ def to_chrome_trace(timelines: Sequence[Tuple[int, Timeline]],
             if args:
                 ev["args"] = args
             events.append(ev)
+        if mem_ledgers and tl.spans:
+            from . import memory  # local: counter track is opt-in
+
+            t0_us = min(s.start_ns for s in tl.spans) / 1e3 - off_us
+            t1_us = max(s.end_ns for s in tl.spans) / 1e3 - off_us
+            for led in mem_ledgers:
+                events.extend(memory.watermark_counter_events(
+                    led, t0_us, t1_us, pid=rank,
+                    name=f"hbm_watermark · {led.step}"))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

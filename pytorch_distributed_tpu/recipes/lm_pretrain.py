@@ -226,6 +226,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "to PATH and stamp model_comm_bytes/comm_wire_bytes/"
                         "collective_count into each metrics record; costs "
                         "one extra AOT compile of the step")
+    p.add_argument("--mem-ledger", type=str, default=None,
+                   dest="mem_ledger", metavar="PATH",
+                   help="write the step's static HBM memory ledger "
+                        "(live-range watermark, top buffers at peak, "
+                        "class/phase breakdown, obs/memory.py) to PATH and "
+                        "stamp mem_peak_bytes into each metrics record; "
+                        "rides the --comm-ledger AOT lowering so the pair "
+                        "costs one shared compile")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -474,6 +482,7 @@ def main(argv=None) -> float:
             mfu=args.mfu, goodput=args.goodput,
             watch_recompiles=args.watch_recompiles,
             comm_ledger=args.comm_ledger,
+            mem_ledger=args.mem_ledger,
             save_steps=args.save_steps, resume=args.resume,
             nan_guard=args.nan_guard, ft_rollback_k=args.ft_rollback_k,
             ft_check_every=args.ft_check_every,

@@ -137,6 +137,11 @@ class Config:
     # Opt-in because the AOT lowering does not share the jit call cache
     # in jax 0.4.x — it costs one extra compile of the step.
     comm_ledger: Optional[str] = None
+    # Memory ledger (obs/memory.py): static per-device HBM watermark from
+    # the same AOT lowering as the comm ledger (one shared compile for
+    # both), with top-buffers-at-peak attribution and class/phase
+    # breakdown written as JSON next to the run.
+    mem_ledger: Optional[str] = None
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -334,6 +339,15 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "model_comm_bytes/comm_wire_bytes/collective_count "
                    "into each metrics record; costs one extra AOT compile "
                    "of the step")
+    p.add_argument("--mem-ledger", default=d.mem_ledger, type=str,
+                   dest="mem_ledger", metavar="PATH",
+                   help="write the step's static HBM memory ledger "
+                   "(per-instruction live-range watermark, top buffers at "
+                   "the high-water mark, params/opt-state/activations/"
+                   "collective breakdown; obs/memory.py) to PATH and stamp "
+                   "mem_peak_bytes into each metrics record; rides the "
+                   "--comm-ledger AOT lowering, so together they cost one "
+                   "extra compile, not two")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

@@ -541,6 +541,7 @@ class LMTrainer:
         goodput: bool = False,
         watch_recompiles: bool = False,
         comm_ledger: Optional[str] = None,
+        mem_ledger: Optional[str] = None,
         save_steps: int = 0,
         resume: Optional[str] = None,
         nan_guard: bool = False,
@@ -687,10 +688,12 @@ class LMTrainer:
             )
 
             self.watchdog = RecompileWatchdog(obs=self.obs).install()
-        # Communication ledger (obs/comms.py): emitted lazily on the first
-        # fit() batch; opt-in — the AOT lowering does not share the jit
-        # call cache in jax 0.4.x, so it costs one extra step compile.
+        # Communication + memory ledgers (obs/comms.py, obs/memory.py):
+        # emitted lazily on the first fit() batch; opt-in — the AOT
+        # lowering does not share the jit call cache in jax 0.4.x, so the
+        # pair costs one extra step compile, shared between them.
         self._comm_ledger_path = comm_ledger
+        self._mem_ledger_path = mem_ledger
         self._comm_fields: Optional[dict] = None
 
         # ---- fault tolerance (ft/) ----
@@ -990,21 +993,41 @@ class LMTrainer:
         print(f"=> divergence rollback at step {step}: restored state from "
               f"step {restored_step}, lr scale now {scale:g}", flush=True)
 
-    def _emit_comm_ledger(self, tokens, lr) -> None:
-        """AOT-compile the live LM step against the first batch's real
-        shardings, write the itemized collective ledger, and cache the
-        per-step metrics fields for every subsequent record."""
+    def _emit_ledgers(self, tokens, lr) -> None:
+        """AOT-compile the live LM step once against the first batch's
+        real shardings and itemize both opt-in receipts off that single
+        lowering: the collective ledger and the static HBM memory
+        ledger.  The cached metrics fields ride every subsequent
+        record."""
         from pytorch_distributed_tpu.obs import comms
 
-        ledger = comms.ledger_from_jitted(
-            self.step_fn, (self.state, tokens, lr),
-            step="lm_step", mesh=self.mesh)
-        self._comm_fields = ledger.metrics_fields()
-        if self.is_primary:
-            comms.write_ledgers(self._comm_ledger_path, [ledger])
-            print(f"=> wrote comm ledger ({ledger.count} collectives, "
-                  f"{ledger.total_bytes} B/step payload) to "
-                  f"{self._comm_ledger_path}", flush=True)
+        args = (self.state, tokens, lr)
+        compiled = self.step_fn.lower(*args).compile()
+        text = compiled.as_text()
+        mesh_shape = dict(self.mesh.shape)
+        self._comm_fields = {}
+        if self._comm_ledger_path is not None:
+            ledger = comms.ledger_from_hlo_text(
+                text, step="lm_step", mesh_shape=mesh_shape)
+            ledger.peak_hbm_bytes = comms.compiled_peak_bytes(compiled)
+            self._comm_fields.update(ledger.metrics_fields())
+            if self.is_primary:
+                comms.write_ledgers(self._comm_ledger_path, [ledger])
+                print(f"=> wrote comm ledger ({ledger.count} collectives, "
+                      f"{ledger.total_bytes} B/step payload) to "
+                      f"{self._comm_ledger_path}", flush=True)
+        if self._mem_ledger_path is not None:
+            from pytorch_distributed_tpu.obs import memory
+
+            mled = memory.ledger_from_compiled(
+                compiled, step="lm_step", mesh_shape=mesh_shape,
+                arg_classes=memory.arg_classes_of(args), hlo_text=text)
+            self._comm_fields.update(mled.metrics_fields())
+            if self.is_primary:
+                memory.write_ledgers(self._mem_ledger_path, [mled])
+                print(f"=> wrote mem ledger (peak {mled.peak_bytes} B at "
+                      f"instr {mled.peak_index}/{mled.n_instructions}) to "
+                      f"{self._mem_ledger_path}", flush=True)
 
     def _token_iter(self, start: int, steps: int):
         """Token stream for logical steps ``[start, steps)`` — prefetched
@@ -1095,9 +1118,10 @@ class LMTrainer:
                 val = val * self._elastic_lr_scale
                 if val != lr_val:
                     lr_val, lr = val, jnp.float32(val)
-                if (self._comm_ledger_path is not None
+                if ((self._comm_ledger_path is not None
+                        or self._mem_ledger_path is not None)
                         and self._comm_fields is None):
-                    self._emit_comm_ledger(tokens, lr)
+                    self._emit_ledgers(tokens, lr)
                 with scope("lm_step"), self._wd_watch("lm_step", i):
                     self.state, metrics = self.step_fn(self.state, tokens, lr)
                 completed = i + 1
@@ -1112,8 +1136,12 @@ class LMTrainer:
                     extra=extra or None,
                 )
                 if self.hb is not None:
+                    from pytorch_distributed_tpu.obs import (
+                        sample_process_memory,
+                    )
                     self.hb.beat(i, step_time_ema=self.obs.ema,
-                                 last_ft=self.obs.last_event_kind)
+                                 last_ft=self.obs.last_event_kind,
+                                 mem_bytes=sample_process_memory())
                 meters.maybe_display(i, print_freq)
                 at_save = (self.save_steps > 0
                            and completed % self.save_steps == 0)
@@ -1157,9 +1185,11 @@ class LMTrainer:
             if self.watchdog is not None:
                 self.watchdog.uninstall()
             if self.hb is not None:
+                from pytorch_distributed_tpu.obs import sample_process_memory
                 self.hb.close(int(self.state.step) - 1,
                               step_time_ema=self.obs.ema,
-                              last_ft=self.obs.last_event_kind)
+                              last_ft=self.obs.last_event_kind,
+                              mem_bytes=sample_process_memory())
             self.obs.flush()
             if self._goodput is not None:
                 print(f"=> {self._goodput.format_summary()}", flush=True)

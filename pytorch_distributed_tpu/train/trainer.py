@@ -30,6 +30,7 @@ from pytorch_distributed_tpu.obs import (
     HeartbeatWriter,
     MetricsLogger,
     ProfileWindow,
+    sample_process_memory,
     scope,
 )
 from pytorch_distributed_tpu.parallel import DistContext, data_parallel_mesh
@@ -312,9 +313,10 @@ class Trainer:
             )
 
             self.watchdog = RecompileWatchdog(obs=self.obs).install()
-        # Communication ledger (obs/comms.py): emitted lazily on the first
-        # train batch (real shardings in hand), opt-in because the AOT
-        # lowering does not share the jit call cache — one extra compile.
+        # Communication + memory ledgers (obs/comms.py, obs/memory.py):
+        # emitted lazily on the first train batch (real shardings in
+        # hand), opt-in because the AOT lowering does not share the jit
+        # call cache — one extra compile shared by both receipts.
         self._comm_fields: Optional[dict] = None
         # Monotonic logged-train-step counter; a resume restores it so the
         # metrics JSONL step axis continues instead of restarting at 0.
@@ -678,22 +680,43 @@ class Trainer:
               f"{restored}, lr scale now {scale:g}", flush=True)
         return scale
 
-    def _emit_comm_ledger(self, batch, lr_arr) -> None:
-        """AOT-compile the live train step against the first batch's real
-        shardings, itemize every collective, write the ledger JSON, and
-        cache the per-step metrics fields every subsequent ``log_step``
-        record carries (``--comm-ledger``)."""
+    def _emit_ledgers(self, batch, lr_arr) -> None:
+        """AOT-compile the live train step once against the first batch's
+        real shardings and itemize both opt-in receipts off that single
+        lowering: the communication ledger (``--comm-ledger``) and the
+        static HBM memory ledger (``--mem-ledger``).  Sharing the compile
+        keeps the pair at one extra compile, not two; the cached metrics
+        fields ride every subsequent ``log_step`` record."""
         from pytorch_distributed_tpu.obs import comms
 
-        ledger = comms.ledger_from_jitted(
-            self.train_step, (self.state, batch, lr_arr),
-            step="train_step", mesh=self.mesh)
-        self._comm_fields = ledger.metrics_fields()
-        if self.ctx.process_index == 0:
-            comms.write_ledgers(self.cfg.comm_ledger, [ledger])
-            print(f"=> wrote comm ledger ({ledger.count} collectives, "
-                  f"{ledger.total_bytes} B/step payload) to "
-                  f"{self.cfg.comm_ledger}", flush=True)
+        cfg = self.cfg
+        args = (self.state, batch, lr_arr)
+        compiled = self.train_step.lower(*args).compile()
+        text = compiled.as_text()
+        mesh_shape = dict(self.mesh.shape)
+        self._comm_fields = {}
+        if getattr(cfg, "comm_ledger", None):
+            ledger = comms.ledger_from_hlo_text(
+                text, step="train_step", mesh_shape=mesh_shape)
+            ledger.peak_hbm_bytes = comms.compiled_peak_bytes(compiled)
+            self._comm_fields.update(ledger.metrics_fields())
+            if self.ctx.process_index == 0:
+                comms.write_ledgers(cfg.comm_ledger, [ledger])
+                print(f"=> wrote comm ledger ({ledger.count} collectives, "
+                      f"{ledger.total_bytes} B/step payload) to "
+                      f"{cfg.comm_ledger}", flush=True)
+        if getattr(cfg, "mem_ledger", None):
+            from pytorch_distributed_tpu.obs import memory
+
+            mled = memory.ledger_from_compiled(
+                compiled, step="train_step", mesh_shape=mesh_shape,
+                arg_classes=memory.arg_classes_of(args), hlo_text=text)
+            self._comm_fields.update(mled.metrics_fields())
+            if self.ctx.process_index == 0:
+                memory.write_ledgers(cfg.mem_ledger, [mled])
+                print(f"=> wrote mem ledger (peak {mled.peak_bytes} B at "
+                      f"instr {mled.peak_index}/{mled.n_instructions}) to "
+                      f"{cfg.mem_ledger}", flush=True)
 
     def train_epoch(
         self, epoch: int, profiler: Optional[ProfileWindow] = None,
@@ -769,9 +792,10 @@ class Trainer:
             if self.chaos is not None:
                 batch = self.chaos.on_batch(i, batch)
             n = self.cfg.batch_size
-            if (getattr(cfg, "comm_ledger", None)
+            if ((getattr(cfg, "comm_ledger", None)
+                    or getattr(cfg, "mem_ledger", None))
                     and self._comm_fields is None):
-                self._emit_comm_ledger(batch, lr_arr)
+                self._emit_ledgers(batch, lr_arr)
             with scope("train_step"), self._wd_watch("train_step",
                                                      self._global_step):
                 self.state, metrics = self.train_step(self.state, batch, lr_arr)
@@ -791,7 +815,8 @@ class Trainer:
             )
             if self.hb is not None:
                 self.hb.beat(self._global_step, step_time_ema=self.obs.ema,
-                             last_ft=self.obs.last_event_kind)
+                             last_ft=self.obs.last_event_kind,
+                             mem_bytes=sample_process_memory())
             self._global_step += 1
             meters.maybe_display(i, cfg.print_freq)
             at_save = (cfg.save_steps > 0 and completed % cfg.save_steps == 0
@@ -902,7 +927,8 @@ class Trainer:
             if self.hb is not None:
                 self.hb.close(max(0, self._global_step - 1),
                               step_time_ema=self.obs.ema,
-                              last_ft=self.obs.last_event_kind)
+                              last_ft=self.obs.last_event_kind,
+                              mem_bytes=sample_process_memory())
             self.obs.flush()
             if self._goodput is not None:
                 print(f"=> {self._goodput.format_summary()}", flush=True)

@@ -85,3 +85,78 @@ def bench_event(kind, path=None, **fields):
     except OSError:
         pass
     return rec
+
+
+def _default_events_path():
+    return os.environ.get("BENCH_EVENTS_JSONL") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "bench_events.jsonl")
+
+
+def parse_lkg_time(stamp):
+    """``captured_at`` (``%Y-%m-%dT%H:%M:%S%z``) -> epoch seconds, or None
+    on anything unparseable."""
+    from datetime import datetime
+
+    try:
+        return datetime.strptime(str(stamp), "%Y-%m-%dT%H:%M:%S%z").timestamp()
+    except (TypeError, ValueError):
+        return None
+
+
+def bench_staleness(lkg_path=None, events_path=None, now=None):
+    """Days since the benchmark's last *fresh* capture.
+
+    A successful ``bench.py`` run rewrites ``BENCH_LKG.json`` (its
+    ``captured_at`` is the last-good mark); ``stale``/``failed`` events in
+    ``bench_events.jsonl`` never refresh it — they only echo the LKG — but
+    an explicit ``captured`` event does.  Both files are optional: a
+    missing events log is the common case on a fresh checkout, and with no
+    parseable timestamp anywhere the answer is ``None`` rather than a
+    guess.  Returns ``{"metric", "last_good", "days_stale",
+    "stale_events"}``."""
+    if lkg_path is None:
+        lkg_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "BENCH_LKG.json")
+    if events_path is None:
+        events_path = _default_events_path()
+    metric, last_good_t, last_good = None, None, None
+    try:
+        with open(lkg_path) as f:
+            lkg = json.load(f)
+        metric = lkg.get("metric")
+        last_good = lkg.get("captured_at")
+        last_good_t = parse_lkg_time(last_good)
+    except (OSError, ValueError):
+        pass
+    stale_events = 0
+    try:
+        with open(events_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) or "bench_event" not in rec:
+                    continue
+                kind = str(rec["bench_event"])
+                if kind in ("stale", "failed"):
+                    stale_events += 1
+                elif kind == "captured" and rec.get("t") is not None:
+                    t = float(rec["t"])
+                    if last_good_t is None or t > last_good_t:
+                        last_good_t, last_good = t, rec.get("captured_at")
+                    metric = rec.get("metric", metric)
+    except OSError:
+        pass
+    if last_good_t is None:
+        return None
+    if now is None:
+        now = time.time()
+    return {
+        "metric": metric,
+        "last_good": last_good,
+        "days_stale": max(0.0, (now - last_good_t) / 86400.0),
+        "stale_events": stale_events,
+    }

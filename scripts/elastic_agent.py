@@ -76,6 +76,8 @@ def cmd_status(args) -> int:
             state = "no beat at this epoch (in flight)"
         else:
             state = f"live (step {beat.get('step')})"
+            if beat.get("mem") is not None:
+                state += f", mem {beat['mem'] / 2**20:.0f} MiB"
         print(f"  rank {r}: {state}")
     joins = sorted(co.pending_joins())
     if joins:

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""obs_memory: render per-step HBM memory ledgers (obs/memory.py).
+
+Reads either ``mem_ledger.json`` files (scripts/shardlint.py --mem-ledger,
+or a trainer's ``--mem-ledger`` emission) or raw post-optimization HLO
+text dumps (``*.hlo``/``*.txt`` — anything else is treated as a ledger
+JSON), and prints the watermark peak, the measured-vs-static residual,
+the class/phase breakdown, and the top-k live buffers at the high-water
+mark.  Pure text parsing end to end — no jax import — so it runs on a
+login host with only the dump files, same contract as obs_timeline.py.
+
+Usage:
+  python scripts/obs_memory.py mem_ledger.json                # text report
+  python scripts/obs_memory.py dump.hlo --top-k 20            # from raw HLO
+  python scripts/obs_memory.py mem_ledger.json --step lm_train_dp \\
+      --json report.json
+  python scripts/obs_memory.py --selftest        # fixture ledger, no jax
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pytorch_distributed_tpu.obs import memory  # noqa: E402
+
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "data", "mem_fixture.hlo")
+
+# Deterministic 10-instruction module: 3 args (params/opt_state/data), a
+# forward dot, a backward grad fusion, a grad_sync all-reduce, an
+# optimizer fusion written straight into the donated output, and a scalar
+# loss reduce.  Every ledger number it produces is hand-computable — see
+# selftest() for the full derivation.
+_FIXTURE_HLO = """\
+HloModule jit_step, is_scheduled=true, entry_computation_layout={(f32[64,64]{1,0}, f32[64,64]{1,0}, f32[16,64]{1,0})->(f32[64,64]{1,0}, f32[])}, input_output_alias={ {0}: (0, {}, may-alias) }, num_partitions=4
+
+%region_0.20 (Arg_0.21: f32[], Arg_1.22: f32[]) -> f32[] {
+  %Arg_0.21 = f32[] parameter(0)
+  %Arg_1.22 = f32[] parameter(1)
+  ROOT %add.23 = f32[] add(f32[] %Arg_0.21, f32[] %Arg_1.22)
+}
+
+%fused_computation (param_0.1: f32[16,64], param_1.1: f32[16,64]) -> f32[64,64] {
+  %param_0.1 = f32[16,64]{1,0} parameter(0)
+  %param_1.1 = f32[16,64]{1,0} parameter(1)
+  ROOT %dot.11 = f32[64,64]{1,0} dot(f32[16,64]{1,0} %param_1.1, f32[16,64]{1,0} %param_0.1), lhs_contracting_dims={0}, rhs_contracting_dims={0}
+}
+
+%fused_computation.1 (param_0.2: f32[64,64], param_1.2: f32[64,64], param_2.2: f32[64,64]) -> f32[64,64] {
+  %param_0.2 = f32[64,64]{1,0} parameter(0)
+  %param_1.2 = f32[64,64]{1,0} parameter(1)
+  %param_2.2 = f32[64,64]{1,0} parameter(2)
+  %multiply.12 = f32[64,64]{1,0} multiply(f32[64,64]{1,0} %param_0.2, f32[64,64]{1,0} %param_2.2)
+  ROOT %subtract.13 = f32[64,64]{1,0} subtract(f32[64,64]{1,0} %param_1.2, f32[64,64]{1,0} %multiply.12)
+}
+
+ENTRY %main.10 (p0.1: f32[64,64], p1.2: f32[64,64], p2.3: f32[16,64]) -> (f32[64,64], f32[]) {
+  %p0.1 = f32[64,64]{1,0} parameter(0), metadata={op_name="jit(step)/jit(main)/params"}
+  %p1.2 = f32[64,64]{1,0} parameter(1), metadata={op_name="jit(step)/jit(main)/momentum"}
+  %p2.3 = f32[16,64]{1,0} parameter(2), metadata={op_name="jit(step)/jit(main)/batch"}
+  %constant.4 = f32[] constant(0), metadata={op_name="jit(step)/jit(main)/loss/zero"}
+  %dot.5 = f32[16,64]{1,0} dot(f32[16,64]{1,0} %p2.3, f32[64,64]{1,0} %p0.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(step)/jit(main)/jvp(step)/dense" source_file="pytorch_distributed_tpu/train/steps.py" source_line=40}
+  %fusion.6 = f32[64,64]{1,0} fusion(f32[16,64]{1,0} %dot.5, f32[16,64]{1,0} %p2.3), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(step)/jit(main)/transpose(jvp(step))/dense" source_file="pytorch_distributed_tpu/train/steps.py" source_line=40}
+  %all-reduce.7 = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %fusion.6), channel_id=1, replica_groups=[1,4]<=[4], use_global_device_ids=true, to_apply=%region_0.20, metadata={op_name="jit(step)/jit(main)/grad_sync/psum" source_file="pytorch_distributed_tpu/train/steps.py" source_line=55}
+  %reduce.8 = f32[] reduce(f32[16,64]{1,0} %dot.5, f32[] %constant.4), dimensions={0,1}, to_apply=%region_0.20, metadata={op_name="jit(step)/jit(main)/loss/reduce_sum" source_file="pytorch_distributed_tpu/train/steps.py" source_line=47}
+  %fusion.9 = f32[64,64]{1,0} fusion(f32[64,64]{1,0} %all-reduce.7, f32[64,64]{1,0} %p0.1, f32[64,64]{1,0} %p1.2), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(step)/jit(main)/optimizer/sgd" source_file="pytorch_distributed_tpu/train/steps.py" source_line=60}
+  ROOT %tuple.10 = (f32[64,64]{1,0}, f32[]) tuple(f32[64,64]{1,0} %fusion.9, f32[] %reduce.8)
+}
+"""
+
+
+def _mib(b) -> str:
+    return f"{float(b) / 2**20:.3f}"
+
+
+def _ledger_dicts(paths, top_k):
+    """``{step: ledger_dict}`` across the inputs.  HLO dumps are ledgered
+    on the spot; JSON files contribute their serialized dicts verbatim
+    (the stored class/phase breakdowns are authoritative — recomputing
+    them from a truncated top-k buffer list would under-report)."""
+    out = {}
+    for p in paths:
+        if p.endswith((".hlo", ".txt")):
+            step = os.path.splitext(os.path.basename(p))[0]
+            with open(p) as f:
+                led = memory.ledger_from_hlo_text(f.read(), step=step)
+            out[step] = led.to_dict(top_k=top_k)
+        else:
+            with open(p) as f:
+                data = json.load(f)
+            for step, d in data.items():
+                out[step] = d
+    return out
+
+
+def _report_text(step, d, top_k):
+    measured = d.get("measured_peak_bytes", 0.0)
+    lines = [f"ledger {step}: peak {_mib(d['peak_bytes'])} MiB at instr "
+             f"{d['peak_index']}/{d['n_instructions']}"
+             + (f"  (measured {_mib(measured)} MiB, residual "
+                f"{d.get('residual_pct', 0.0):.2f}%)" if measured else "")]
+    lines.append(
+        f"  argument {_mib(d['argument_bytes'])} MiB"
+        f" + output {_mib(d['output_bytes'])} MiB"
+        f" + temps {_mib(d['peak_bytes'] - d['argument_bytes'] - d['output_bytes'])} MiB"
+        f"  (donated {_mib(d['donated_bytes'])} MiB)")
+    cp = d.get("class_peaks", {})
+    if cp:
+        lines.append("  by class (MiB): " + "  ".join(
+            f"{k}={_mib(v)}" for k, v in sorted(
+                cp.items(), key=lambda kv: -kv[1])))
+    pp = d.get("phase_peaks", {})
+    if pp:
+        lines.append("  by phase (MiB): " + "  ".join(
+            f"{k}={_mib(v)}" for k, v in sorted(
+                pp.items(), key=lambda kv: -kv[1])))
+    for b in d.get("top", [])[:top_k]:
+        dims = ",".join(str(x) for x in b.get("dims", []))
+        lines.append(
+            f"  top: {b['name']:<24} {_mib(b['bytes']):>10} MiB"
+            f"  {b.get('dtype', '')}[{dims}]  {b.get('klass', '')}"
+            + (f" ({b['phase']})" if b.get("phase") else ""))
+    wm = d.get("watermark", [])
+    if wm:
+        lines.append(f"  watermark: {len(wm)} change points "
+                     f"(low {_mib(min(v for _, v in wm))} MiB, "
+                     f"high {_mib(max(v for _, v in wm))} MiB)")
+    return lines
+
+
+def make_fixture(path: str) -> None:
+    """Write the deterministic HLO module used by --selftest and the
+    tests."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(_FIXTURE_HLO)
+
+
+def selftest() -> int:
+    """Ledger the checked-in fixture and check every number against the
+    hand derivation.  Schedule (entry computation, 10 instructions):
+
+      idx 0-2  parameters: params 16384 B, opt_state 16384 B, data 4096 B
+      idx 3    constant.4      4 B temp, live [3, 7] (reduce reads it)
+      idx 4    dot.5        4096 B temp, live [4, 7]   (forward)
+      idx 5    fusion.6    16384 B temp, live [5, 6]   (backward grad)
+      idx 6    all-reduce.7 16384 B temp, live [6, 8]  (grad_sync scratch)
+      idx 7    reduce.8    -> written into the output allocation
+      idx 8    fusion.9    -> written into the output allocation
+      idx 9    ROOT tuple  -> the output allocation itself
+
+    Constant terms: argument 36864 B, output 16388 B (donation: param 0).
+    Temp curve peaks at idx 6 (4 + 4096 + 16384 + 16384 = 36868 B), so
+    peak = 36864 + 16388 + 36868 = 90120 B."""
+    path = FIXTURE
+    if not os.path.exists(path):  # regenerate if the fixture went missing
+        make_fixture(path)
+    with open(path) as f:
+        led = memory.ledger_from_hlo_text(
+            f.read(), step="fixture", mesh_shape={"data": 4},
+            arg_classes=["params", "opt_state", "data"])
+
+    assert led.n_instructions == 10, led.n_instructions
+    assert led.argument_bytes == 36864, led.argument_bytes
+    assert led.output_bytes == 16388, led.output_bytes
+    assert led.donated_bytes == 16384, led.donated_bytes
+    assert led.peak_bytes == 90120, led.peak_bytes
+    assert led.peak_index == 6, led.peak_index
+    assert led.temp_peak_bytes == 36868, led.temp_peak_bytes
+    base = 53252  # argument + output
+    assert led.watermark == [
+        [0, base], [3, base + 4], [4, base + 4100], [5, base + 20484],
+        [6, base + 36868], [7, base + 20484], [8, base + 16384],
+        [9, base]], led.watermark
+
+    cls = led.class_peaks()
+    assert cls == {"params": 16384, "opt_state": 16384, "data": 4096,
+                   "activations": 20484, "collective": 16384,
+                   "output": 16388}, cls
+    ph = led.phase_peaks()
+    assert ph == {"resident": base, "forward": 4100, "backward": 16384,
+                  "grad_sync": 16384}, ph
+
+    top = led.top_buffers(3)
+    assert [b.name for b in top] == \
+        ["(outputs)", "all-reduce.7", "fusion.6"], [b.name for b in top]
+    ar = top[1]
+    assert ar.klass == "collective" and ar.phase == "grad_sync", ar
+    assert ar.source.endswith("steps.py:55"), ar.source
+
+    # serialization round-trips the scalar fences
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "mem_ledger.json")
+        memory.write_ledgers(p, [led])
+        back = memory.load_ledgers(p)["fixture"]
+        assert back.peak_bytes == led.peak_bytes
+        assert back.watermark == led.watermark
+        assert back.mesh_shape == {"data": 4}
+
+    # the counter-track export spans [t0, t1] and ends at the last point
+    evs = memory.watermark_counter_events(led, 100.0, 1000.0, pid=3)
+    assert len(evs) == len(led.watermark), evs
+    assert evs[0]["ts"] == 100.0 and evs[-1]["ts"] == 1000.0, evs
+    assert evs[0]["args"]["bytes"] == base, evs[0]
+    assert max(e["args"]["bytes"] for e in evs) == 90120, evs
+
+    print("obs_memory selftest OK: watermark/classes/phases/top/round-trip"
+          " all verified on the checked-in fixture")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("inputs", nargs="*",
+                    help="mem_ledger.json files and/or raw HLO text dumps "
+                         "(*.hlo / *.txt)")
+    ap.add_argument("--step", default=None,
+                    help="only report this step name")
+    ap.add_argument("--top-k", type=int, default=10,
+                    help="live buffers to list at the peak (default 10)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the merged {step: ledger} dict as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the ledger math on the checked-in HLO "
+                         "fixture and exit (no jax, no inputs needed)")
+    ap.add_argument("--make-fixture", default=None, metavar="PATH",
+                    help="write the deterministic HLO module used by "
+                         "--selftest and the tests, then exit")
+    args = ap.parse_args(argv)
+
+    if args.make_fixture:
+        make_fixture(args.make_fixture)
+        print(f"wrote HLO fixture to {args.make_fixture}")
+        return 0
+    if args.selftest:
+        return selftest()
+    if not args.inputs:
+        ap.error("no inputs given (pass mem_ledger.json or *.hlo dumps)")
+
+    ledgers = _ledger_dicts(args.inputs, args.top_k)
+    if args.step:
+        if args.step not in ledgers:
+            raise SystemExit(f"step {args.step!r} not found; "
+                             f"has: {sorted(ledgers)}")
+        ledgers = {args.step: ledgers[args.step]}
+
+    for step in sorted(ledgers):
+        print("\n".join(_report_text(step, ledgers[step], args.top_k)))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(ledgers, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

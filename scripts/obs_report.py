@@ -143,13 +143,36 @@ def summarize_ft_events(records: List[dict]) -> List[str]:
     return lines
 
 
-def summarize_bench(records: List[dict]) -> List[str]:
+def bench_staleness_info(args) -> Optional[Dict]:
+    """Days-since-last-good from BENCH_LKG.json + bench_events.jsonl
+    (scripts/benchlib.py ``bench_staleness``), honoring the report's fixed
+    ``--now`` clock.  None when neither artifact yields a timestamp or
+    staleness reporting is disabled (``--bench-max-stale-days 0``)."""
+    max_days = getattr(args, "bench_max_stale_days", None)
+    if max_days is not None and max_days <= 0:
+        return None
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchlib import bench_staleness
+
+    info = bench_staleness(lkg_path=getattr(args, "bench_lkg", None),
+                           events_path=getattr(args, "bench_events", None),
+                           now=getattr(args, "now", None))
+    if info is not None and max_days is not None:
+        info["max_stale_days"] = max_days
+        info["warn"] = info["days_stale"] > max_days
+    return info
+
+
+def summarize_bench(records: List[dict],
+                    staleness: Optional[Dict] = None) -> List[str]:
     """Fold ``bench_event`` records (scripts/benchlib.py — e.g. a stale
     benchmark probe replaying its last-known-good number) into the
     summary, so a dashboard reading this report can't mistake a replayed
-    benchmark for a fresh one."""
+    benchmark for a fresh one.  ``staleness`` (``bench_staleness_info``)
+    adds the days-since-last-good aging line, with a WARN past
+    ``--bench-max-stale-days``."""
     events = [r for r in records if "bench_event" in r]
-    if not events:
+    if not events and staleness is None:
         return []
     lines = ["== bench =="]
     for e in events:
@@ -162,6 +185,15 @@ def summarize_bench(records: List[dict]) -> List[str]:
         if e.get("reason"):
             detail.append(str(e["reason"]))
         lines.append(f"  {kind:<16}  " + "; ".join(detail))
+    if staleness is not None:
+        ev = (f", {staleness['stale_events']} stale event(s)"
+              if staleness.get("stale_events") else "")
+        lines.append(f"  last good         {staleness['days_stale']:.1f} "
+                     f"days ago ({staleness.get('last_good')}){ev}")
+        if staleness.get("warn"):
+            lines.append(f"  WARN              benchmark stale "
+                         f"> {staleness['max_stale_days']:g} days — "
+                         f"re-run bench.py for a fresh capture")
     return lines
 
 
@@ -243,6 +275,87 @@ def summarize_comms(records: List[dict], ledger_path: Optional[str] = None,
                                  for k, v in sorted(enc.items(),
                                                     key=lambda kv: -kv[1]))
                 lines.append(f"    grad_sync encoding: {encs}")
+    if len(lines) == 1:
+        return []
+    return lines
+
+
+_MEM_FIELDS = ("mem_peak_bytes", "mem_temp_peak_bytes", "mem_residual_pct")
+
+
+def mem_stats(records: List[dict]) -> Dict[str, Optional[float]]:
+    """Per-run means of the memory-ledger fields the trainers stamp
+    (``mem_peak_bytes``/``mem_temp_peak_bytes``/``mem_residual_pct``,
+    obs/memory.py)."""
+    steps = [r for r in records
+             if "ft_event" not in r and "bench_event" not in r]
+    out: Dict[str, Optional[float]] = {}
+    for key in _MEM_FIELDS:
+        vals = [float(r[key]) for r in steps if key in r]
+        out[key] = sum(vals) / len(vals) if vals else None
+    return out
+
+
+def _load_mem_ledger_json(path: str) -> Dict[str, Dict]:
+    """The raw ``mem_ledger.json`` dicts: unlike ``memory.load_ledgers``,
+    the serialized ``class_peaks``/``phase_peaks`` stay authoritative —
+    recomputing them from the truncated top-k buffer list would lie."""
+    with open(path) as f:
+        return json.load(f)
+
+
+def summarize_memory(records: List[dict], ledger_path: Optional[str] = None,
+                     top_k: int = 5) -> List[str]:
+    """The ``== memory ==`` section: per-step peak HBM from the metrics
+    stream, and — when a mem_ledger.json is on disk — the per-step
+    watermark peak vs the compiled ``memory_analysis()`` ground truth
+    (±10%% fence), the class/phase breakdown, and the top live buffers at
+    the high-water mark."""
+    ms = mem_stats(records)
+    if not any(v is not None for v in ms.values()) and not ledger_path:
+        return []
+    lines = ["== memory =="]
+    if ms["mem_peak_bytes"] is not None:
+        temp = (f"  (temps {_mib(ms['mem_temp_peak_bytes'])} MiB)"
+                if ms["mem_temp_peak_bytes"] is not None else "")
+        lines.append(f"  per-step peak     {_mib(ms['mem_peak_bytes'])} MiB"
+                     f"{temp}")
+    if ms["mem_residual_pct"] is not None:
+        verdict = ("ok" if ms["mem_residual_pct"] <= 10.0
+                   else "EXCEEDS ±10%")
+        lines.append(f"  vs memory_analysis residual "
+                     f"{ms['mem_residual_pct']:.1f}% [{verdict}]")
+    if ledger_path:
+        for step, d in sorted(_load_mem_ledger_json(ledger_path).items()):
+            peak = float(d.get("peak_bytes", 0))
+            measured = float(d.get("measured_peak_bytes", 0.0))
+            resid = float(d.get("residual_pct", 0.0))
+            fence = ""
+            if measured:
+                verdict = "ok" if resid <= 10.0 else "EXCEEDS ±10%"
+                fence = (f" (measured {_mib(measured)} MiB, residual "
+                         f"{resid:.1f}% [{verdict}])")
+            lines.append(f"  ledger {step}: peak {_mib(peak)} MiB at instr "
+                         f"{d.get('peak_index')}/{d.get('n_instructions')}"
+                         f"{fence}")
+            classes = ", ".join(
+                f"{k} {_mib(float(v))}"
+                for k, v in sorted(d.get("class_peaks", {}).items(),
+                                   key=lambda kv: -kv[1]) if v)
+            if classes:
+                lines.append(f"    by class (MiB): {classes}")
+            phases = ", ".join(
+                f"{p} {_mib(float(v))}"
+                for p, v in sorted(d.get("phase_peaks", {}).items(),
+                                   key=lambda kv: -kv[1]) if v)
+            if phases:
+                lines.append(f"    by phase (MiB): {phases}")
+            for b in d.get("top", [])[:top_k]:
+                dims = "x".join(str(x) for x in b.get("dims", [])) or "scalar"
+                lines.append(
+                    f"    top: {b.get('name'):<28} {_mib(b.get('bytes', 0))} "
+                    f"MiB {b.get('dtype')}[{dims}] {b.get('klass')}"
+                    + (f" ({b.get('phase')})" if b.get("phase") else ""))
     if len(lines) == 1:
         return []
     return lines
@@ -350,10 +463,15 @@ def report(args) -> str:
         sections += summarize_goodput(records)
         sections += summarize_comms(records, getattr(args, "comm_ledger", None),
                                     getattr(args, "comm_predicted", None))
-        sections += summarize_bench(records)
-    elif getattr(args, "comm_ledger", None):
-        sections += summarize_comms([], args.comm_ledger,
-                                    getattr(args, "comm_predicted", None))
+        sections += summarize_memory(records,
+                                     getattr(args, "mem_ledger", None))
+        sections += summarize_bench(records, bench_staleness_info(args))
+    else:
+        if getattr(args, "comm_ledger", None):
+            sections += summarize_comms([], args.comm_ledger,
+                                        getattr(args, "comm_predicted", None))
+        if getattr(args, "mem_ledger", None):
+            sections += summarize_memory([], args.mem_ledger)
     if args.telemetry_csv:
         sections.append("== devices ==")
         sections += summarize_telemetry(args.telemetry_csv)
@@ -405,6 +523,10 @@ def report_json(args) -> Dict:
             comms["model_comm_bytes"])
         comms["predicted_bytes"] = getattr(args, "comm_predicted", None)
         out["comms"] = comms
+        out["memory"] = mem_stats(records)
+    staleness = bench_staleness_info(args)
+    if staleness is not None:
+        out["bench_staleness"] = staleness
     if getattr(args, "comm_ledger", None):
         from pytorch_distributed_tpu.obs.comms import load_ledgers
 
@@ -414,6 +536,9 @@ def report_json(args) -> Dict:
                    "count": lg.count, "by_kind": lg.by_kind(),
                    "by_phase": lg.by_phase()}
             for step, lg in load_ledgers(args.comm_ledger).items()}
+    if getattr(args, "mem_ledger", None):
+        out.setdefault("memory", {})["ledger"] = _load_mem_ledger_json(
+            args.mem_ledger)
     if args.telemetry_csv:
         n_rows, peak, limit = telemetry_stats(args.telemetry_csv)
         out["devices"] = {
@@ -572,7 +697,8 @@ def diff_report(a_records: List[dict], b_records: List[dict],
 
 
 def run_diff(path_a: str, path_b: str, threshold_pct: float,
-             goodput_threshold_pp: float, fmt: str = "text") -> int:
+             goodput_threshold_pp: float, fmt: str = "text",
+             staleness: Optional[Dict] = None) -> int:
     a, mal_a = load_metrics(path_a)
     b, mal_b = load_metrics(path_b)
     kw = dict(threshold_pct=threshold_pct,
@@ -582,11 +708,19 @@ def run_diff(path_a: str, path_b: str, threshold_pct: float,
     if fmt == "json":
         d = diff_data(a, b, **kw)
         d["malformed_lines"] = {"a": mal_a, "b": mal_b}
+        if staleness is not None:
+            d["bench_staleness"] = staleness
         print(json.dumps(d, indent=2))
         return 1 if d["regressed"] else 0
     text, regressed = diff_report(a, b, **kw)
     if mal_a or mal_b:
         text += f"\n(malformed lines: A {mal_a}, B {mal_b})"
+    if staleness is not None and staleness.get("warn"):
+        # A note, never a verdict: a stale benchmark capture makes the
+        # comparison context-poor but does not make run B a regression.
+        text += (f"\nnote: benchmark baseline stale "
+                 f"{staleness['days_stale']:.1f} days "
+                 f"(> {staleness['max_stale_days']:g}) — re-run bench.py")
     print(text)
     return 1 if regressed else 0
 
@@ -613,7 +747,10 @@ def _selftest() -> int:
                                     "comm_wire_bytes": 100428.0,
                                     "collective_count": 16.0,
                                     "exposed_comm_ms": 0.40,
-                                    "overlap_pct": 33.3})
+                                    "overlap_pct": 33.3,
+                                    "mem_peak_bytes": 820.0,
+                                    "mem_temp_peak_bytes": 120.0,
+                                    "mem_residual_pct": 2.5})
             # ft_event records interleave in the same JSONL (ft/)
             log.log_event("skip", step=7, consecutive=1)
             log.log_event("skip", step=8, consecutive=2)
@@ -661,10 +798,46 @@ def _selftest() -> int:
                 op_name="jit(step)/transpose(jvp(lm_forward))/add",
                 source="lm.py:1")])])
 
+        # a one-entry memory ledger on disk for the memory section
+        from pytorch_distributed_tpu.obs import memory as memory_mod
+
+        mlpath = os.path.join(d, "mem_ledger.json")
+        memory_mod.write_ledgers(mlpath, [memory_mod.MemLedger(
+            step="lm_train_dp", mesh_shape={"data": 4},
+            argument_bytes=400, output_bytes=300, donated_bytes=128,
+            peak_bytes=820, peak_index=3, n_instructions=9,
+            measured_peak_bytes=800.0,
+            watermark=[[0, 700], [2, 820], [6, 724]],
+            buffers=[
+                memory_mod.MemBuffer(
+                    name="(params)", bytes=400, dtype="", dims=[],
+                    klass="params", phase="", op_name="", source="",
+                    defined_at=-1, last_use=8),
+                memory_mod.MemBuffer(
+                    name="fusion.7", bytes=96, dtype="f32", dims=[4, 6],
+                    klass="activations", phase="backward",
+                    op_name="transpose(jvp(lm_forward))/dot",
+                    source="lm.py:1", defined_at=2, last_use=5)])])
+
+        # a 20-days-stale LKG + events trail for the bench aging line
+        bench_lkg = os.path.join(d, "BENCH_LKG.json")
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%S%z",
+                              time.localtime(now - 20 * 86400))
+        with open(bench_lkg, "w") as f:
+            json.dump({"metric": "resnet50_train_images_per_sec_per_chip",
+                       "value": 2511.3, "vs_baseline": 9.3,
+                       "captured_at": stamp}, f)
+        bench_events = os.path.join(d, "bench_events.jsonl")
+        with open(bench_events, "w") as f:
+            f.write(json.dumps({"bench_event": "stale", "t": now - 3600,
+                                "reason": "tunnel unreachable"}) + "\n")
+
         ns = argparse.Namespace(
             metrics_jsonl=mpath, hb_dir=hb_dir, telemetry_csv=tpath,
             now=now, max_step_lag=3, max_beat_age=60.0,
-            comm_ledger=lpath, comm_predicted=66000.0)
+            comm_ledger=lpath, comm_predicted=66000.0,
+            mem_ledger=mlpath, bench_lkg=bench_lkg,
+            bench_events=bench_events, bench_max_stale_days=14.0)
         out = report(ns)
         for needle in ("== steps ==", "steps logged      20", "p95",
                        "throughput", "loss", "grad_norm",
@@ -680,7 +853,12 @@ def _selftest() -> int:
                        "overlap 33.3%", "residual", "[ok]",
                        "ledger lm_train_dp", "all-reduce×1",
                        "by phase: backward",
+                       "== memory ==", "per-step peak",
+                       "residual 2.5% [ok]", "by class (MiB):",
+                       "by phase (MiB):", "top: fusion.7",
                        "== bench ==", "stale", "last good",
+                       "days ago", "1 stale event(s)",
+                       "WARN", "benchmark stale",
                        "== devices ==", "device 0", "device 1",
                        "== heartbeats ==", "STRAGGLER", "step lag",
                        "beat age"):
@@ -689,11 +867,18 @@ def _selftest() -> int:
         # json twin: every section present and structurally sane
         js = report_json(ns)
         for key in ("steps", "ft_events", "goodput", "bench", "comms",
-                    "devices", "heartbeats"):
+                    "memory", "bench_staleness", "devices", "heartbeats"):
             assert key in js, f"selftest: {key!r} missing from json: {js}"
         assert js["steps"]["model_comm_bytes"] == 66952.0, js["steps"]
         assert abs(js["comms"]["residual_pct"]) < 15.0, js["comms"]
         assert js["comms"]["ledger"]["lm_train_dp"]["total_bytes"] == 66952
+        assert js["memory"]["mem_peak_bytes"] == 820.0, js["memory"]
+        mled = js["memory"]["ledger"]["lm_train_dp"]
+        assert mled["peak_bytes"] == 820 and mled["residual_pct"] == 2.5
+        assert mled["class_peaks"]["params"] == 400, mled
+        assert js["bench_staleness"]["warn"], js["bench_staleness"]
+        assert 19.5 < js["bench_staleness"]["days_stale"] < 20.5, (
+            js["bench_staleness"])
         assert js["heartbeats"]["1"]["straggler"], js["heartbeats"]
         assert not js["heartbeats"]["0"]["straggler"], js["heartbeats"]
         assert js["heartbeats"]["0"]["epoch"] == 1, js["heartbeats"]
@@ -780,6 +965,19 @@ def _selftest() -> int:
         dr = diff_data(n_recs, m_recs)
         by_rev = {r["metric"]: r for r in dr["metrics"]}
         assert by_rev["peak_hbm_bytes"]["verdict"] == "PASS", dr
+
+        # ---- bench staleness in --diff: a note, never a failure ----
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = run_diff(fast, fast, 10.0, 5.0, staleness={
+                "warn": True, "days_stale": 20.0, "max_stale_days": 14.0})
+        noted = buf.getvalue()
+        assert rc == 0, f"selftest: stale bench must not fail --diff:\n{noted}"
+        assert "note: benchmark baseline stale 20.0 days" in noted, noted
+        assert "overall: PASS" in noted, noted
     print("obs_report selftest: OK")
     return 0
 
@@ -801,6 +999,25 @@ def main(argv=None) -> int:
                     help="analytic per-step comm bytes (obs.flops."
                     "lm_comm_bytes/image_comm_bytes) to fence the measured "
                     "ledger against (±15%% residual)")
+    ap.add_argument("--mem-ledger", type=str, default=None,
+                    dest="mem_ledger",
+                    help="mem_ledger.json (scripts/shardlint.py "
+                    "--mem-ledger or a trainer's --mem-ledger) to itemize "
+                    "in the memory section: watermark peak vs "
+                    "memory_analysis, class/phase breakdown, top buffers")
+    ap.add_argument("--bench-lkg", type=str, default=None, dest="bench_lkg",
+                    help="BENCH_LKG.json for staleness aging (default: the "
+                    "checked-in repo-root file)")
+    ap.add_argument("--bench-events", type=str, default=None,
+                    dest="bench_events",
+                    help="bench_events.jsonl for staleness aging (default: "
+                    "$BENCH_EVENTS_JSONL or the repo-root file; missing is "
+                    "fine)")
+    ap.add_argument("--bench-max-stale-days", type=float, default=14.0,
+                    dest="bench_max_stale_days", metavar="DAYS",
+                    help="WARN in the bench section (and note in --diff, "
+                    "never a failure) when the last good benchmark capture "
+                    "is older than DAYS (default 14; 0 disables)")
     ap.add_argument("--format", choices=("text", "json"), default="text",
                     help="output format; json emits every section (and "
                     "--diff verdicts) as one machine-readable object")
@@ -830,7 +1047,8 @@ def main(argv=None) -> int:
         return _selftest()
     if args.diff:
         return run_diff(args.diff[0], args.diff[1], args.threshold_pct,
-                        args.goodput_threshold_pp, fmt=args.format)
+                        args.goodput_threshold_pp, fmt=args.format,
+                        staleness=bench_staleness_info(args))
     if args.format == "json":
         print(json.dumps(report_json(args), indent=2))
     else:

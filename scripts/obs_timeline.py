@@ -17,6 +17,8 @@ Usage:
       --hb-dir runs/hb --out merged.trace.json        # cross-rank merge
   python scripts/obs_timeline.py TRACE_DIR \\
       --ledger comm_ledger.json --step lm_train_dp    # bytes -> GB/s
+  python scripts/obs_timeline.py TRACE_DIR \\
+      --mem-ledger mem_ledger.json --out m.trace.json # + HBM counter track
   python scripts/obs_timeline.py TRACE_DIR --json report.json
   python scripts/obs_timeline.py --selftest           # fixture round-trip
 """
@@ -181,6 +183,25 @@ def selftest() -> int:
     # rank 1's identical span lands 2500 us earlier once the skew is removed
     assert abs((r0["ts"] - r1["ts"]) - 2500.0) < 1e-6, (r0["ts"], r1["ts"])
 
+    # HBM watermark merge: a 3-point ledger curve becomes a per-rank
+    # counter track spanning exactly the rank's capture window
+    from pytorch_distributed_tpu.obs import memory
+    mled = memory.MemLedger(
+        step="fixture", mesh_shape={"data": 2}, argument_bytes=512,
+        output_bytes=256, donated_bytes=0, peak_bytes=1024, peak_index=2,
+        n_instructions=5, measured_peak_bytes=1024.0,
+        watermark=[[0, 768], [2, 1024], [4, 800]], buffers=[])
+    trace = tlmod.to_chrome_trace([(0, tl), (1, tl)], mem_ledgers=[mled])
+    ctr = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+    assert len(ctr) == 6, len(ctr)  # 3 change points x 2 ranks
+    for e in ctr:
+        assert e["name"] == "hbm_watermark · fixture", e
+    r0 = sorted((e for e in ctr if e["pid"] == 0), key=lambda e: e["ts"])
+    t0 = min(s.start_ns for s in tl.spans) / 1e3
+    t1 = max(s.end_ns for s in tl.spans) / 1e3
+    assert abs(r0[0]["ts"] - t0) < 1e-6 and abs(r0[-1]["ts"] - t1) < 1e-6
+    assert [e["args"]["bytes"] for e in r0] == [768, 1024, 800], r0
+
     print("obs_timeline selftest OK: parse/analyze/marry/align/export all "
           "verified on the checked-in fixture")
     return 0
@@ -200,6 +221,10 @@ def main(argv=None) -> int:
     ap.add_argument("--step", default=None,
                     help="ledger step name (default: sole entry, else "
                          "required)")
+    ap.add_argument("--mem-ledger", default=None, metavar="PATH",
+                    help="mem_ledger.json (scripts/shardlint.py "
+                         "--mem-ledger); merges each step's HBM watermark "
+                         "into --out as a Perfetto counter track")
     ap.add_argument("--hb-dir", default=None, metavar="DIR",
                     help="heartbeat dir for cross-rank clock alignment")
     ap.add_argument("--out", default=None, metavar="PATH",
@@ -238,6 +263,18 @@ def main(argv=None) -> int:
             raise SystemExit(f"--ledger has {len(ledgers)} steps; pick one "
                              f"with --step (has: {sorted(ledgers)})")
 
+    mem_ledgers = None
+    if args.mem_ledger:
+        from pytorch_distributed_tpu.obs import memory
+        by_step = memory.load_ledgers(args.mem_ledger)
+        if args.step:
+            if args.step not in by_step:
+                raise SystemExit(f"step {args.step!r} not in "
+                                 f"{args.mem_ledger}; has: {sorted(by_step)}")
+            mem_ledgers = [by_step[args.step]]
+        else:
+            mem_ledgers = [by_step[k] for k in sorted(by_step)]
+
     files = _collect_captures(args.captures)
     timelines = [(rank, tlmod.parse_xspace(f)) for rank, f in
                  enumerate(files)]
@@ -273,7 +310,8 @@ def main(argv=None) -> int:
         })
 
     if args.out:
-        trace = tlmod.to_chrome_trace(timelines, offsets)
+        trace = tlmod.to_chrome_trace(timelines, offsets,
+                                      mem_ledgers=mem_ledgers)
         with open(args.out, "w") as f:
             json.dump(trace, f)
             f.write("\n")

@@ -14,6 +14,8 @@ fused-CE mode, all three pipeline schedules, greedy decode) on a simulated
 - dtype-promotion          large bf16/f16 -> f32 materialized upcasts
 - collective-regression    per-step collective count/bytes vs the
                            checked-in analysis/baseline.json budget
+- memory-budget            per-device peak HBM (temp+argument+output) vs
+                           the checked-in per-step byte budget
 - host-sync                blocking float()/np.asarray/.block_until_ready()
                            inside registered training hot loops (AST pass)
 
@@ -28,6 +30,10 @@ Usage:
   python scripts/shardlint.py --comm-ledger comm_ledger.json
                                                  # itemized per-collective
                                                  # receipt (obs.comms)
+  python scripts/shardlint.py --mem-ledger mem_ledger.json
+                                                 # per-buffer HBM watermark
+                                                 # + peak attribution
+                                                 # (obs.memory)
   python scripts/shardlint.py --selftest         # planted-hazard checks
 """
 
@@ -81,6 +87,10 @@ def main() -> int:
                     help="write the itemized communication ledger (every "
                          "collective with bytes/fan-out/scope attribution) "
                          "for the analyzed steps to PATH")
+    ap.add_argument("--mem-ledger", default=None, metavar="PATH",
+                    help="write the static HBM memory ledger (live-range "
+                         "watermark, top buffers at peak, class/phase "
+                         "breakdown) for the analyzed steps to PATH")
     ap.add_argument("--min-replicated-bytes", type=int,
                     default=core.DEFAULT_MIN_REPLICATED_BYTES)
     ap.add_argument("--min-promotion-bytes", type=int,
@@ -135,6 +145,15 @@ def main() -> int:
         comms.write_ledgers(args.comm_ledger, ledgers)
         print(f"wrote comm ledger for {len(ledgers)} steps to "
               f"{args.comm_ledger}")
+
+    if args.mem_ledger:
+        # Same deal: the watermark is computed from the already-lowered
+        # HLO text, so the memory receipt adds zero compiles too.
+        from pytorch_distributed_tpu.obs import memory  # noqa: E402
+        mledgers = core.sweep_mem_ledgers(names)
+        memory.write_ledgers(args.mem_ledger, mledgers)
+        print(f"wrote mem ledger for {len(mledgers)} steps to "
+              f"{args.mem_ledger}")
 
     print(render_table(reports))
     if args.json:

@@ -77,16 +77,45 @@ def lm_wus_ref_fit(lm_world32):
 
 
 @pytest.fixture(scope="session")
-def get_lowering():
+def get_lowering(tmp_path_factory):
     """Session-shared compiled recipe lowerings.
 
-    Hands back ``analysis.core.get_lowering`` — the memoized
-    lower+compile sweep over the shardlint RECIPES — so everything that
-    needs a recipe's HLO (test_shardlint's detector fences, test_comms'
-    ledger parity checks) pays one compile per step for the whole
-    session instead of one per test.  Threshold variations and ledger
-    extraction are pure functions of the cached Lowering record.
-    """
-    from pytorch_distributed_tpu.analysis import core
+    Hands back a thin wrapper over ``analysis.core.get_lowering`` — the
+    memoized lower+compile sweep over the shardlint RECIPES — so
+    everything that needs a recipe's HLO (test_shardlint's detector
+    fences, test_comms' and test_memory's ledger parity checks) pays one
+    compile per step for the whole session instead of one per test.
+    Threshold variations and ledger extraction are pure functions of the
+    cached Lowering record.
 
-    return core.get_lowering
+    On first build per step the wrapper also drops the compiled artifacts
+    (HLO text + measured peak/mesh/arg-classes JSON) under the session
+    tmp dir — ``<name>.hlo`` / ``<name>.json`` in ``wrapper.cache_dir``
+    — so subprocess consumers (the obs_memory CLI test) and pure-text
+    re-analyses read files instead of recompiling.  ``wrapper.
+    compile_count()`` exposes the process-wide AOT compile counter for
+    the zero-extra-compiles asserts."""
+    import json
+
+    from pytorch_distributed_tpu.analysis import core
+    from pytorch_distributed_tpu.obs import comms, memory
+
+    cache_dir = tmp_path_factory.mktemp("hlo_cache")
+
+    def wrapper(name: str):
+        low = core.get_lowering(name)
+        hlo_path = cache_dir / f"{name}.hlo"
+        if not hlo_path.exists():
+            hlo_path.write_text(low.text)
+            (cache_dir / f"{name}.json").write_text(json.dumps({
+                "name": name,
+                "mesh_shape": low.mesh_shape,
+                "measured_peak_bytes":
+                    comms.compiled_peak_bytes(low.compiled),
+                "arg_classes": memory.arg_classes_of(low.args),
+            }))
+        return low
+
+    wrapper.cache_dir = cache_dir
+    wrapper.compile_count = core.compile_count
+    return wrapper
