@@ -136,17 +136,54 @@ def _probe_devices_with_retry(total_budget_s: float = 600.0,
     _emit_failure(last_err)
 
 
-def _save_lkg(value: float, vs_baseline: float) -> None:
+def _save_lkg(value: float, vs_baseline: float, extra: dict = None) -> str:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    rec = {
+        "metric": METRIC,
+        "value": value,
+        "vs_baseline": vs_baseline,
+        "captured_at": stamp,
+    }
+    rec.update(extra or {})
     tmp = LKG_PATH + ".tmp"
     with open(tmp, "w") as f:
-        json.dump({
-            "metric": METRIC,
-            "value": value,
-            "vs_baseline": vs_baseline,
-            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-        }, f)
+        json.dump(rec, f)
         f.write("\n")
     os.replace(tmp, LKG_PATH)
+    return stamp
+
+
+def _plan_prediction(n_chips: int, batch: int, image: int,
+                     imgs_per_sec_per_chip: float) -> dict:
+    """The autoplan cost model's predicted MFU for the exact benched
+    config, next to the MFU the measured rate implies — stamped into the
+    payload, the LKG, and a ``captured`` bench_event so the staleness
+    report (scripts/obs_report.py) can show prediction drift over time.
+    Best-effort: a planner error must never block the headline number."""
+    try:
+        from pytorch_distributed_tpu.obs.flops import (
+            chip_peak_flops,
+            image_step_cost,
+        )
+        from pytorch_distributed_tpu.plan import predicted_mfu, resnet50_spec
+
+        chip = os.environ.get("PTD_BENCH_CHIP") or None
+        spec = resnet50_spec(batch=batch, image_size=image)
+        predicted = predicted_mfu("resnet50", n_chips, chip=chip, spec=spec)
+        if predicted is None:
+            return {}
+        out = {"predicted_mfu": round(predicted, 2)}
+        if imgs_per_sec_per_chip > 0:
+            step_s = batch / (imgs_per_sec_per_chip * n_chips)
+            cost = image_step_cost("resnet50", batch, image)
+            measured = (100.0 * cost.model_flops
+                        / (step_s * n_chips * chip_peak_flops(chip)))
+            out["measured_mfu"] = round(measured, 2)
+            out["prediction_drift_pct"] = round(
+                100.0 * (measured - predicted) / predicted, 1)
+        return out
+    except Exception:  # noqa: BLE001 — prediction is observability only
+        return {}
 
 
 def main() -> None:
@@ -246,7 +283,10 @@ def main() -> None:
     value = round(imgs_per_sec_per_chip, 1)
     vs_baseline = round(
         imgs_per_sec_per_chip / REFERENCE_IMGS_PER_SEC_PER_DEVICE, 3)
-    _save_lkg(value, vs_baseline)
+    prediction = _plan_prediction(jax.device_count(), batch, image,
+                                  imgs_per_sec_per_chip)
+    stamp = _save_lkg(value, vs_baseline, extra=prediction)
+    _bench_event("captured", value=value, captured_at=stamp, **prediction)
     payload = {
         "metric": METRIC,
         "value": value,
@@ -256,6 +296,7 @@ def main() -> None:
                    if fused_rate and fused_rate > baseline else "baseline"),
         "unfused_img_s": round(baseline, 1),
     }
+    payload.update(prediction)
     if fused_rate is not None:
         payload["fused_img_s"] = round(fused_rate, 1)
     print(json.dumps(payload))

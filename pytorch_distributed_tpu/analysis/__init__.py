@@ -5,7 +5,10 @@ scripts/shardlint.py for the CLI).
 Import layering: ``hlo`` and ``report`` are pure text/dataclass modules
 (no jax import — unit-testable on string fixtures); ``jaxpr``, ``astlint``
 and ``core`` import jax lazily so that merely importing the package never
-initializes a backend."""
+initializes a backend.  ``lowering`` is the shared AOT sweep service
+(one compile per recipe, persisted ``<name>.hlo``/``<name>.json``
+artifacts, the process-wide compile-count budget) that every static
+consumer — detectors, both ledgers, autoplan validation — rides."""
 
 from pytorch_distributed_tpu.analysis.report import (  # noqa: F401
     Finding,

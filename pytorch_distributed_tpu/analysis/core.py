@@ -124,10 +124,9 @@ class Lowering:
 def lower_jitted(jitted, args: Sequence[Any], *, name: str, mesh=None,
                  donate: Optional[Sequence[int]] = None) -> Lowering:
     """The expensive half of the analysis: lower + compile + jaxpr."""
-    global _COMPILE_COUNT
     import jax
 
-    _COMPILE_COUNT += 1
+    count_compile()
     compiled = jitted.lower(*args).compile()
     return Lowering(
         name=name, jitted=jitted, args=tuple(args),
@@ -143,8 +142,18 @@ _COMPILE_COUNT = 0
 def compile_count() -> int:
     """AOT lower+compile sweeps paid by this process so far.  The
     zero-extra-compiles fence: tests snapshot it around the memory-ledger
-    sweep to prove ledgering rides the cached lowerings."""
+    sweep to prove ledgering rides the cached lowerings, and
+    analysis/lowering.py's budget assert fences the process total."""
     return _COMPILE_COUNT
+
+
+def count_compile() -> None:
+    """Book one AOT compile against the process-wide counter.  External
+    lower+compile paths (the trainers' ledger emission via
+    ``lowering.aot_ledgers``) call this so the compile budget sees every
+    sweep in the process, not just the recipe cache's."""
+    global _COMPILE_COUNT
+    _COMPILE_COUNT += 1
 
 
 def get_lowering(name: str) -> Lowering:

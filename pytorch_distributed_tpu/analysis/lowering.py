@@ -1,0 +1,354 @@
+"""The first-class lowering service: one AOT sweep, persisted, shared.
+
+Every consumer of compiled-step truth in the repo — test_shardlint's
+detector fences, test_comms'/test_memory's ledger parity checks, the
+``shardlint --comm-ledger/--mem-ledger`` receipts, the trainers' opt-in
+ledger emission, and ``scripts/autoplan.py``'s top-k validation — is a
+pure function of one lowered+compiled step.  This module promotes the
+session-scoped ``get_lowering`` conftest fixture into a process-wide
+service so all of them provably ride ONE sweep:
+
+- ``LoweringService.get(name)`` memoizes lower+compile per recipe
+  (delegating to ``analysis.core``'s in-memory cache) and persists the
+  artifacts on first build;
+- ``persist``/``load`` define the on-disk **artifact layout**:
+
+      <cache_dir>/<name>.hlo    post-optimization HLO text
+      <cache_dir>/<name>.json   {"name", "mesh_shape",
+                                 "measured_peak_bytes", "arg_classes"}
+
+  Subprocess consumers (the obs_memory CLI, report tooling, autoplan
+  re-runs) read these files instead of recompiling — ``CachedLowering``
+  rebuilds both ledgers from text alone, no jax required;
+- ``aot_ledgers`` is the trainers' path: one *counted* AOT compile of
+  the live train step feeding both opt-in receipts (``--comm-ledger`` +
+  ``--mem-ledger``), optionally persisted to the same layout;
+- ``compile_count()`` / ``compile_budget()`` / ``assert_compile_budget``
+  expose the process-wide compile counter and the tier-1 budget fence:
+  static analyses beyond the sweep itself must pay ZERO extra compiles.
+
+Cache-reuse contract: a ``.hlo``/``.json`` pair is written once per step
+per cache dir and never invalidated within a process — recipes are
+deterministic functions of the checked-in step builders, so the first
+build is authoritative for the session.  Cross-session reuse is safe
+only for text re-analysis (ledgers, detectors); anything needing the
+live ``compiled`` object recompiles via ``get``.
+
+Persistent *compilation* caching (jax's ``jax_compilation_cache_dir``)
+is separate and version-gated here: on jaxlib 0.4.x re-executing a
+deserialized cached executable on the CPU backend aborts the process
+("Fatal Python error: Aborted", observed on jax 0.4.37 in
+test_trainer's train step), so ``maybe_enable_persistent_cache`` hard-
+disables it for the known-bad range and on newer jaxlibs only enables
+after a populate+warm round-trip self-check passes in subprocesses
+(the failure mode is a process abort — it cannot be try/except'd).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.analysis import core
+
+# Extra counted compiles tier-1 tolerates beyond the recipe sweep itself:
+# the shardlint selftest's planted synthetic-bad steps and the handful of
+# analyze_jitted probes tests run against non-recipe steps.  The budget
+# assert (tests/test_plan.py) fails CI when a change sneaks per-consumer
+# recompiles back in.
+EXTRA_COMPILE_ALLOWANCE = 8
+
+
+def compile_count() -> int:
+    """Process-wide AOT lower+compile sweeps paid so far (analysis.core's
+    counter: the recipe sweep, analyze_jitted probes, and the trainers'
+    ``aot_ledgers`` all increment it)."""
+    return core.compile_count()
+
+
+def compile_budget() -> int:
+    """The tier-1 ceiling: one compile per recipe plus the fixed probe
+    allowance.  Shardlint detectors + comm ledger + mem ledger + autoplan
+    top-k validation must all fit under it together."""
+    return len(core.RECIPES) + EXTRA_COMPILE_ALLOWANCE
+
+
+def assert_compile_budget() -> None:
+    n, budget = compile_count(), compile_budget()
+    assert n <= budget, (
+        f"compile_count {n} exceeds the tier-1 budget {budget}: a static "
+        f"consumer (shardlint/ledger/autoplan fence) stopped riding the "
+        f"shared lowering sweep (analysis/lowering.py)")
+
+
+# ------------------------------------------------------------ persistence
+
+def persist(cache_dir, name: str, *, text: str, mesh_shape: Dict[str, int],
+            measured_peak_bytes: int, arg_classes: Dict[str, Any]) -> None:
+    """Write one step's artifact pair (idempotent: first build wins)."""
+    os.makedirs(str(cache_dir), exist_ok=True)
+    hlo_path = os.path.join(str(cache_dir), f"{name}.hlo")
+    if os.path.exists(hlo_path):
+        return
+    with open(hlo_path, "w") as f:
+        f.write(text)
+    with open(os.path.join(str(cache_dir), f"{name}.json"), "w") as f:
+        json.dump({
+            "name": name,
+            "mesh_shape": mesh_shape,
+            "measured_peak_bytes": int(measured_peak_bytes),
+            "arg_classes": arg_classes,
+        }, f)
+
+
+@dataclasses.dataclass
+class CachedLowering:
+    """A persisted lowering re-read from disk: enough for every pure-text
+    analysis (both ledgers, the HLO detectors) with no jax import and no
+    recompile — what subprocess consumers and post-hoc tooling use."""
+
+    name: str
+    text: str
+    mesh_shape: Dict[str, int]
+    measured_peak_bytes: int
+    arg_classes: Dict[str, Any]
+
+    @classmethod
+    def load(cls, cache_dir, name: str) -> "CachedLowering":
+        with open(os.path.join(str(cache_dir), f"{name}.hlo")) as f:
+            text = f.read()
+        with open(os.path.join(str(cache_dir), f"{name}.json")) as f:
+            meta = json.load(f)
+        return cls(name=name, text=text,
+                   mesh_shape=dict(meta.get("mesh_shape") or {}),
+                   measured_peak_bytes=int(meta.get("measured_peak_bytes", 0)),
+                   arg_classes=meta.get("arg_classes") or {})
+
+    def comm_ledger(self):
+        from pytorch_distributed_tpu.obs import comms
+
+        return comms.ledger_from_hlo_text(self.text, step=self.name,
+                                          mesh_shape=self.mesh_shape)
+
+    def mem_ledger(self):
+        from pytorch_distributed_tpu.obs import memory
+
+        return memory.ledger_from_hlo_text(
+            self.text, step=self.name, mesh_shape=self.mesh_shape,
+            arg_classes=self.arg_classes,
+            measured_peak_bytes=self.measured_peak_bytes)
+
+
+class LoweringService:
+    """The shared sweep with on-disk persistence.
+
+    ``get`` returns the live ``core.Lowering`` (compiling at most once per
+    step per process via core's memo) and drops the artifact pair under
+    ``cache_dir`` on first build.  ``load`` hands back the disk view.
+    """
+
+    def __init__(self, cache_dir: Optional[str] = None):
+        if cache_dir is None:
+            cache_dir = os.environ.get("PTD_LOWERING_CACHE") or os.path.join(
+                tempfile.gettempdir(), "ptd_lowering_cache")
+        self.cache_dir = str(cache_dir)
+
+    def get(self, name: str) -> core.Lowering:
+        from pytorch_distributed_tpu.obs import comms, memory
+
+        low = core.get_lowering(name)
+        persist(self.cache_dir, name, text=low.text,
+                mesh_shape=low.mesh_shape,
+                measured_peak_bytes=comms.compiled_peak_bytes(low.compiled),
+                arg_classes=memory.arg_classes_of(low.args))
+        return low
+
+    def load(self, name: str) -> CachedLowering:
+        return CachedLowering.load(self.cache_dir, name)
+
+    def has(self, name: str) -> bool:
+        return os.path.exists(os.path.join(self.cache_dir, f"{name}.hlo"))
+
+    def names(self) -> List[str]:
+        try:
+            return sorted(f[:-4] for f in os.listdir(self.cache_dir)
+                          if f.endswith(".hlo"))
+        except OSError:
+            return []
+
+    # Budget plumbing, re-exported so fixtures can hand out one object.
+    compile_count = staticmethod(compile_count)
+    compile_budget = staticmethod(compile_budget)
+
+
+_SERVICE: Optional[LoweringService] = None
+
+
+def service(cache_dir: Optional[str] = None) -> LoweringService:
+    """The process singleton.  The first caller pins the cache dir; later
+    callers passing a different one get a fresh non-singleton instance
+    (tests with tmp dirs) rather than silently retargeting the shared one."""
+    global _SERVICE
+    if _SERVICE is None:
+        _SERVICE = LoweringService(cache_dir)
+        return _SERVICE
+    if cache_dir is not None and str(cache_dir) != _SERVICE.cache_dir:
+        return LoweringService(cache_dir)
+    return _SERVICE
+
+
+# ------------------------------------------------- trainer ledger path
+
+def aot_ledgers(jitted, args: Sequence[Any], *, step: str,
+                mesh_shape: Dict[str, int], want_comm: bool = True,
+                want_mem: bool = True, cache_dir: Optional[str] = None):
+    """One counted AOT compile of a live train step feeding both opt-in
+    receipts — the trainers' ``--comm-ledger``/``--mem-ledger`` path.
+
+    Returns ``(comm_ledger_or_None, mem_ledger_or_None)``.  Unlike the
+    recipe sweep this lowers the *trainer's own* jitted step against its
+    real shardings; it still books against the same process-wide compile
+    counter so the budget fence sees every AOT compile in the process,
+    and with ``cache_dir`` set it persists the same artifact layout the
+    recipe sweep writes (step name as the stem)."""
+    from pytorch_distributed_tpu.obs import comms, memory
+
+    core.count_compile()
+    compiled = jitted.lower(*args).compile()
+    text = compiled.as_text()
+    measured = comms.compiled_peak_bytes(compiled)
+    arg_classes = memory.arg_classes_of(args)
+    comm_ledger = mem_ledger = None
+    if want_comm:
+        comm_ledger = comms.ledger_from_hlo_text(text, step=step,
+                                                 mesh_shape=mesh_shape)
+        comm_ledger.peak_hbm_bytes = measured
+    if want_mem:
+        mem_ledger = memory.ledger_from_compiled(
+            compiled, step=step, mesh_shape=mesh_shape,
+            arg_classes=arg_classes, hlo_text=text)
+    if cache_dir:
+        persist(cache_dir, step, text=text, mesh_shape=mesh_shape,
+                measured_peak_bytes=measured, arg_classes=arg_classes)
+    return comm_ledger, mem_ledger
+
+
+# ------------------------------------- persistent compilation cache guard
+
+# jaxlib versions where the round-trip is KNOWN to abort the process:
+# the whole 0.4.x line (observed on jaxlib 0.4.36 / jax 0.4.37, CPU
+# backend — re-executing a deserialized executable dies with "Fatal
+# Python error: Aborted").  Kept as a range, not a list: every 0.4.x we
+# tried fails, and probing one costs a crashed subprocess anyway.
+_KNOWN_BAD_BELOW = (0, 5, 0)
+
+_SELFCHECK_SNIPPET = """\
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", {cache_dir!r})
+try:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
+f = jax.jit(lambda x: (x * 2.0 + 1.0).sum())
+print(float(f(jnp.arange(64.0))))
+"""
+
+
+def jaxlib_version_tuple(version: Optional[str] = None) -> Tuple[int, ...]:
+    if version is None:
+        import jaxlib
+
+        version = jaxlib.__version__
+    parts: List[int] = []
+    for tok in str(version).split(".")[:3]:
+        digits = "".join(c for c in tok if c.isdigit())
+        parts.append(int(digits) if digits else 0)
+    return tuple(parts)
+
+
+def persistent_cache_known_bad(version: Optional[str] = None) -> bool:
+    return jaxlib_version_tuple(version) < _KNOWN_BAD_BELOW
+
+
+def persistent_cache_selfcheck(cache_dir: str, *, timeout: float = 120.0,
+                               _runner=None) -> bool:
+    """Populate + warm round-trip in fresh subprocesses: run the snippet
+    twice against ``cache_dir``; the second run deserializes the first's
+    entry, which is exactly the path that aborts on bad jaxlibs — only a
+    subprocess survives probing it.  Verdict is memoized per jaxlib
+    version in ``<cache_dir>/selfcheck.json`` so the pair of interpreter
+    launches is paid once per cache dir, not once per session."""
+    os.makedirs(cache_dir, exist_ok=True)
+    ver = ".".join(map(str, jaxlib_version_tuple()))
+    memo_path = os.path.join(cache_dir, "selfcheck.json")
+    try:
+        with open(memo_path) as f:
+            memo = json.load(f)
+        if memo.get("jaxlib") == ver:
+            return bool(memo.get("ok"))
+    except (OSError, ValueError):
+        pass
+    snippet = _SELFCHECK_SNIPPET.format(cache_dir=cache_dir)
+    runner = _runner or (lambda: subprocess.run(
+        [sys.executable, "-c", snippet], timeout=timeout,
+        capture_output=True, text=True))
+    ok = True
+    outs = []
+    try:
+        for _ in range(2):  # populate, then warm (deserialize + execute)
+            r = runner()
+            if r.returncode != 0:
+                ok = False
+                break
+            outs.append(r.stdout.strip())
+        else:
+            ok = len(outs) == 2 and outs[0] == outs[1] and outs[0] != ""
+    except (subprocess.TimeoutExpired, OSError):
+        ok = False
+    try:
+        with open(memo_path, "w") as f:
+            json.dump({"jaxlib": ver, "ok": ok}, f)
+    except OSError:
+        pass
+    return ok
+
+
+def maybe_enable_persistent_cache(
+        cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Version-gated re-attempt of jax's persistent compilation cache.
+
+    Known-bad jaxlibs (< 0.5.0) short-circuit to disabled WITHOUT running
+    the self-check — the failure mode is a process abort, so probing on a
+    version already documented bad buys nothing and costs two interpreter
+    launches.  On newer jaxlibs the populate+warm subprocess round-trip
+    must pass before the cache dir is handed to jax.  ``PTD_PERSISTENT_
+    CACHE=0`` force-disables; ``=1`` skips the version gate but NOT the
+    self-check.  Returns ``{"enabled": bool, "reason": str}``."""
+    env = os.environ.get("PTD_PERSISTENT_CACHE", "")
+    if env == "0":
+        return {"enabled": False, "reason": "disabled by PTD_PERSISTENT_CACHE=0"}
+    ver = ".".join(map(str, jaxlib_version_tuple()))
+    if env != "1" and persistent_cache_known_bad():
+        return {"enabled": False, "reason": (
+            f"jaxlib {ver} is in the known-bad range (< "
+            f"{'.'.join(map(str, _KNOWN_BAD_BELOW))}): deserialized CPU "
+            "executables abort the process (see tests/conftest.py NOTE)")}
+    if cache_dir is None:
+        cache_dir = os.environ.get("PTD_JAX_CACHE_DIR") or os.path.join(
+            tempfile.gettempdir(), "ptd_jax_compilation_cache")
+    if not persistent_cache_selfcheck(cache_dir):
+        return {"enabled": False, "reason": (
+            f"jaxlib {ver}: populate+warm round-trip self-check failed "
+            f"in {cache_dir}")}
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    return {"enabled": True,
+            "reason": f"jaxlib {ver}: round-trip self-check passed",
+            "cache_dir": cache_dir}

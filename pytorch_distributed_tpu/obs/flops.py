@@ -54,6 +54,36 @@ PEAK_FLOPS_PER_CHIP: Dict[str, float] = {
 # not a measurement — CI asserts plumbing, never CPU utilization).
 CPU_FALLBACK_PEAK = 50e9
 
+# Per-chip HBM capacity, bytes.  The planner's feasibility pruning
+# (plan/cost.py) rejects layouts whose predicted MemCost peak exceeds
+# this; same device_kind-prefix keying as the FLOPs table.
+HBM_BYTES_PER_CHIP: Dict[str, float] = {
+    "tpu v2": 8e9,
+    "tpu v3": 16e9,
+    "tpu v4": 32e9,
+    "tpu v5 lite": 16e9,
+    "tpu v5e": 16e9,
+    "tpu v5p": 95e9,
+    "tpu v6e": 32e9,
+    "tpu v6 lite": 32e9,
+}
+CPU_FALLBACK_HBM = 4e9
+
+# Nominal aggregate ICI bandwidth per chip, bytes/s — a *scoring*
+# denominator for predicted comm time (plan/cost.py), not a measurement;
+# figures are the published per-chip interconnect aggregates.
+LINK_BYTES_PER_CHIP: Dict[str, float] = {
+    "tpu v2": 62.5e9,
+    "tpu v3": 87.5e9,
+    "tpu v4": 300e9,
+    "tpu v5 lite": 200e9,
+    "tpu v5e": 200e9,
+    "tpu v5p": 600e9,
+    "tpu v6e": 448e9,
+    "tpu v6 lite": 448e9,
+}
+CPU_FALLBACK_LINK = 10e9
+
 
 def device_peak_flops(device=None) -> float:
     """Peak FLOP/s for one chip.  ``PTD_TPU_PEAK_FLOPS`` overrides (chips
@@ -72,6 +102,43 @@ def device_peak_flops(device=None) -> float:
         if kind.startswith(prefix):
             return peak
     return CPU_FALLBACK_PEAK
+
+
+def _chip_table_lookup(table: Dict[str, float], kind: Optional[str],
+                       fallback: float, env: str) -> float:
+    """Shared device_kind-prefix lookup for the capability tables.
+    ``kind=None`` stays jax-free (the planner's analytic path): the env
+    override or the fallback, never a device query."""
+    env_val = os.environ.get(env)
+    if env_val:
+        return float(env_val)
+    kind = (kind or "").lower()
+    for prefix, value in table.items():
+        if kind.startswith(prefix):
+            return value
+    return fallback
+
+
+def chip_hbm_bytes(kind: Optional[str] = None) -> float:
+    """Per-chip HBM bytes for a device_kind string (``PTD_TPU_HBM_BYTES``
+    overrides); unknown/absent kinds get the CPU placeholder."""
+    return _chip_table_lookup(HBM_BYTES_PER_CHIP, kind, CPU_FALLBACK_HBM,
+                              "PTD_TPU_HBM_BYTES")
+
+
+def chip_link_bytes(kind: Optional[str] = None) -> float:
+    """Nominal aggregate ICI bytes/s per chip (``PTD_TPU_LINK_BYTES``
+    overrides)."""
+    return _chip_table_lookup(LINK_BYTES_PER_CHIP, kind, CPU_FALLBACK_LINK,
+                              "PTD_TPU_LINK_BYTES")
+
+
+def chip_peak_flops(kind: Optional[str] = None) -> float:
+    """Peak FLOP/s per chip from a device_kind *string* — the jax-free twin
+    of ``device_peak_flops`` the planner uses (``PTD_TPU_PEAK_FLOPS``
+    overrides)."""
+    return _chip_table_lookup(PEAK_FLOPS_PER_CHIP, kind, CPU_FALLBACK_PEAK,
+                              "PTD_TPU_PEAK_FLOPS")
 
 
 # ---------------------------------------------------------------- step costs

@@ -1,0 +1,37 @@
+"""Static layout planning (``scripts/autoplan.py``'s engine).
+
+Layering contract: ``space``, ``cost``, and ``planner`` are jax-free —
+they run on a login node or in CI with no accelerator, importing only
+the analytic halves of ``obs`` (flops tables, EQuARX wire arithmetic).
+``validate`` is the one jax-dependent module (it lowers top-k candidates
+on the simulated mesh via the shared ``analysis.lowering`` service) and
+is imported lazily by ``planner.autoplan(validate=True)`` only.
+"""
+
+from pytorch_distributed_tpu.plan.space import (  # noqa: F401
+    MODELS,
+    ModelSpec,
+    Plan,
+    elastic_worlds,
+    enumerate_plans,
+    lm_spec,
+    resnet50_spec,
+    tiny_lm_spec,
+)
+from pytorch_distributed_tpu.plan.cost import (  # noqa: F401
+    HW,
+    PlanScore,
+    comm_entries,
+    comm_totals,
+    feasibility,
+    hw_for,
+    mem_cost_for,
+    plan_complexity,
+    score_plan,
+)
+from pytorch_distributed_tpu.plan.planner import (  # noqa: F401
+    autoplan,
+    best_plan,
+    predicted_mfu,
+    rank_plans,
+)

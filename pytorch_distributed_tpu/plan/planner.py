@@ -1,0 +1,133 @@
+"""The planner: enumerate → prune → score → rank → (optionally) validate.
+
+``autoplan`` is the module's one entry point and what ``scripts/
+autoplan.py`` drives: for a model and a chip count it enumerates every
+recipe-expressible plan (plan/space.py), statically prunes the infeasible
+ones with itemized reasons (plan/cost.py ``feasibility``), scores the
+survivors analytically, and emits a ranked ``plan.json`` payload whose
+top entries carry predicted MFU, the full per-step prediction breakdown,
+and the exact recipe CLI line that runs the plan.
+
+Ranking is (predicted step time, knob complexity, predicted peak HBM,
+key): fastest wins; at a tie the plan with FEWER non-default knobs wins
+(simpler recipes have more proven fences and fewer failure modes — at
+tiny shapes ZeRO-1 ties plain DP on wire bytes by construction, and the
+tie-break keeps the fenced plain-DP recipe on top); remaining ties go to
+the lower memory plan, then the stable key.  Elastic worlds
+(plan/space.py ``elastic_worlds``) are pre-planned so a re-mesh after
+rank loss has a ready layout.
+
+Everything here is jax-free; only ``validate=True`` touches the
+simulated mesh, via plan/validate.py off the shared lowering sweep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from pytorch_distributed_tpu.plan import cost as cost_mod
+from pytorch_distributed_tpu.plan.space import (
+    MODELS,
+    ModelSpec,
+    Plan,
+    elastic_worlds,
+    enumerate_plans,
+)
+
+PLAN_SCHEMA_VERSION = 1
+
+
+def rank_key(plan: Plan, score: cost_mod.PlanScore) -> Tuple:
+    return (score.step_time_s, cost_mod.plan_complexity(plan),
+            score.peak_hbm_bytes, plan.key())
+
+
+def rank_plans(spec: ModelSpec, chips: int, hw: cost_mod.HW,
+               hbm_budget: Optional[float] = None
+               ) -> Tuple[List[Tuple[Plan, cost_mod.PlanScore]],
+                          Dict[str, int]]:
+    """(ranked feasible plans with scores, pruned-reason histogram)."""
+    ranked: List[Tuple[Plan, cost_mod.PlanScore]] = []
+    pruned: Dict[str, int] = {}
+    for plan in enumerate_plans(spec, chips):
+        reasons = cost_mod.feasibility(plan, hw, hbm_budget=hbm_budget)
+        if reasons:
+            for r in reasons:
+                # histogram by reason class, not the full message
+                if "exceeds" in r:
+                    key = "peak HBM over budget"
+                elif "not divisible" in r or "no microbatch" in r:
+                    key = "indivisible shape"
+                else:
+                    key = r.split(";")[0]
+                pruned[key] = pruned.get(key, 0) + 1
+            continue
+        ranked.append((plan, cost_mod.score_plan(plan, hw)))
+    ranked.sort(key=lambda ps: rank_key(*ps))
+    return ranked, pruned
+
+
+def plan_entry(plan: Plan, score: cost_mod.PlanScore) -> Dict[str, Any]:
+    return {"plan": plan.to_dict(), "predicted": score.to_dict()}
+
+
+def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
+             top_k: int = 5, elastic: bool = True, validate: bool = False,
+             validate_k: int = 3, hbm_budget: Optional[float] = None,
+             spec: Optional[ModelSpec] = None) -> Dict[str, Any]:
+    """The full pipeline for one (model, world size).  Returns the
+    ``plan.json`` payload; never imports jax unless ``validate=True``."""
+    if spec is None:
+        if model not in MODELS:
+            raise KeyError(f"unknown model {model!r}; known: "
+                           f"{sorted(MODELS)}")
+        spec = MODELS[model]()
+    hw = cost_mod.hw_for(chip)
+    ranked, pruned = rank_plans(spec, chips, hw, hbm_budget=hbm_budget)
+    payload: Dict[str, Any] = {
+        "schema_version": PLAN_SCHEMA_VERSION,
+        "model": spec.name,
+        "family": spec.family,
+        "chips": chips,
+        "hw": {"name": hw.name, "peak_flops": hw.peak_flops,
+               "hbm_bytes": hw.hbm_bytes, "link_bytes": hw.link_bytes},
+        "enumerated": len(ranked) + sum(pruned.values()),
+        "feasible": len(ranked),
+        "pruned": pruned,
+        "ranked": [plan_entry(p, s) for p, s in ranked[:top_k]],
+    }
+    if elastic:
+        worlds: Dict[str, Any] = {}
+        for w in elastic_worlds(chips):
+            if w == chips:
+                continue
+            sub, _ = rank_plans(spec, w, hw, hbm_budget=hbm_budget)
+            worlds[str(w)] = (plan_entry(*sub[0]) if sub else None)
+        payload["elastic"] = worlds
+    if validate:
+        from pytorch_distributed_tpu.plan import validate as validate_mod
+
+        records = validate_mod.validate_top_k(
+            [p for p, _ in ranked], k=validate_k)
+        payload["validation"] = records
+        payload["validation_ok"] = all(
+            r["ok"] is not False for r in records)
+    return payload
+
+
+def best_plan(model: str, chips: int,
+              chip: Optional[str] = None) -> Optional[Plan]:
+    """Just the winning Plan (None when nothing is feasible)."""
+    spec = MODELS[model]()
+    ranked, _ = rank_plans(spec, chips, cost_mod.hw_for(chip))
+    return ranked[0][0] if ranked else None
+
+
+def predicted_mfu(model: str, chips: int, *, chip: Optional[str] = None,
+                  spec: Optional[ModelSpec] = None) -> Optional[float]:
+    """Predicted MFU (%) of the top-ranked plan — what bench.py stamps
+    into its events so the staleness report can show prediction drift."""
+    if spec is None:
+        spec = MODELS[model]()
+    ranked, _ = rank_plans(spec, chips, cost_mod.hw_for(chip))
+    return ranked[0][1].mfu_pct if ranked else None

@@ -1,0 +1,236 @@
+"""Plan space: workload specs, the Plan point, and enumeration.
+
+A ``Plan`` is one point in the dp x tp x pp x fsdp x remat x
+fused-ce-mode x zero x grad-compress lattice for a concrete ``ModelSpec``
+at a concrete world size.  Enumeration is family-aware — the image
+trainer's flag surface (train/config.py) has no tp/pp/fsdp axes, and the
+LM recipe (recipes/lm_pretrain.py) is where tensor/pipeline parallelism
+and ZeRO-3 live — so each family only generates points its real CLI can
+express, and ``Plan.flags()`` emits exactly those spellings.
+
+Axis naming note: the repo carries TWO ZeRO axes, matching lm_pretrain's
+flags — ``zero='wus'`` is weight-update sharding (ZeRO-1: momentum 1/N,
+parallel/zero.py) and ``fsdp=True`` is the parameter+optimizer sharding
+(ZeRO-3 layout, parallel/fsdp.py).  They are separate plan dimensions
+because they are separate flags with different comm/memory signatures.
+
+This module is jax-free by design: enumeration and flag emission run in
+the analytic autoplan path with no accelerator or backend import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """One concrete workload the planner lays out.
+
+    ``family`` selects the cost models and the flag surface: "image"
+    (obs.flops image_step_cost + train/config.py flags) or "lm"
+    (lm_step_cost + recipes/lm_pretrain.py flags).  Shape fields unused
+    by a family stay at their zero defaults."""
+
+    name: str
+    family: str                 # "image" | "lm"
+    batch: int                  # GLOBAL batch (reference semantics)
+    arch: str = ""              # image: obs.flops analytic-model key
+    image_size: int = 224
+    num_classes: int = 1000
+    vocab: int = 0
+    d_model: int = 0
+    n_layers: int = 0
+    n_heads: int = 0
+    seq: int = 0
+    mlp_ratio: int = 4
+
+
+def resnet50_spec(batch: int = 256, image_size: int = 224) -> ModelSpec:
+    """The headline bench config (bench.py: global batch 256, bf16)."""
+    return ModelSpec(name="resnet50", family="image", batch=batch,
+                     arch="resnet50", image_size=image_size)
+
+
+def lm_spec(vocab: int = 32000, d_model: int = 2048, n_layers: int = 16,
+            n_heads: int = 16, seq: int = 2048,
+            batch: int = 256) -> ModelSpec:
+    """A GPT-2-large-ish pretraining config — big enough that the planner
+    has real memory/comm trade-offs to rank at pod scale."""
+    return ModelSpec(name="lm", family="lm", batch=batch, vocab=vocab,
+                     d_model=d_model, n_layers=n_layers, n_heads=n_heads,
+                     seq=seq)
+
+
+def tiny_lm_spec() -> ModelSpec:
+    """The shardlint sweep's tiny LM (analysis/core.py ``_LM``): the
+    shapes the top-k validation cross-checks against the real lowered
+    recipes on the simulated CPU mesh."""
+    return ModelSpec(name="lm-tiny", family="lm", batch=8, vocab=64,
+                     d_model=32, n_layers=1, n_heads=4, seq=16)
+
+
+MODELS = {
+    "resnet50": resnet50_spec,
+    "lm": lm_spec,
+    "lm-tiny": tiny_lm_spec,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    """One candidate layout: mesh factorization + the recipe knobs."""
+
+    spec: ModelSpec
+    chips: int                  # world size this plan runs on
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    fsdp: bool = False          # ZeRO-3 param+opt sharding (--fsdp, LM)
+    remat: bool = False
+    fused_ce_mode: str = "none"  # "none"|"replicated"|"dp"|"tp"
+    zero: str = "none"           # "none"|"wus" (ZeRO-1 WUS, --zero)
+    grad_compress: str = "none"  # "none"|"bf16"|"int8"|"fp8" (image)
+
+    @property
+    def microbatches(self) -> int:
+        """Pipeline microbatches: the largest divisor of the per-data-
+        shard batch at or under the 4x-stages gpipe rule of thumb (enough
+        to drown the bubble without fragmenting the matmuls).  0 when no
+        count >= pp divides the shard — feasibility pruning rejects the
+        plan on that."""
+        if self.pp <= 1:
+            return 1
+        per_dp = self.spec.batch // max(1, self.dp)
+        for m in range(min(4 * self.pp, per_dp), self.pp - 1, -1):
+            if m > 0 and per_dp % m == 0:
+                return m
+        return 0
+
+    def axes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "tp": self.tp, "pp": self.pp}
+
+    def key(self) -> str:
+        """Stable short id for logs/tables."""
+        bits = [f"c{self.chips}", f"dp{self.dp}"]
+        if self.tp > 1:
+            bits.append(f"tp{self.tp}")
+        if self.pp > 1:
+            bits.append(f"pp{self.pp}")
+        if self.fsdp:
+            bits.append("fsdp")
+        if self.remat:
+            bits.append("remat")
+        if self.fused_ce_mode != "none":
+            bits.append(f"ce-{self.fused_ce_mode}")
+        if self.zero != "none":
+            bits.append(f"zero-{self.zero}")
+        if self.grad_compress != "none":
+            bits.append(self.grad_compress)
+        return "/".join(bits)
+
+    def flags(self, fused_ce_chunks: int = 8) -> List[str]:
+        """The exact recipe CLI flags for this plan — spellings match
+        train/config.py (image) / recipes/lm_pretrain.py (LM) verbatim,
+        so the emitted line is runnable as-is."""
+        spec = self.spec
+        if spec.family == "image":
+            out = ["-a", spec.arch, "--batch-size", str(spec.batch),
+                   "--image-size", str(spec.image_size)]
+            if self.zero != "none":
+                out += ["--zero", self.zero]
+            if self.grad_compress != "none":
+                out += ["--grad-compress", self.grad_compress]
+            return out
+        out = ["--vocab", str(spec.vocab), "--d-model", str(spec.d_model),
+               "--n-layers", str(spec.n_layers),
+               "--n-heads", str(spec.n_heads), "--seq-len", str(spec.seq),
+               "--batch-size", str(spec.batch)]
+        if self.tp > 1:
+            out += ["--tp", str(self.tp)]
+        if self.pp > 1:
+            out += ["--pp", str(self.pp),
+                    "--microbatches", str(self.microbatches)]
+        if self.fsdp:
+            out += ["--fsdp"]
+        if self.remat:
+            out += ["--remat"]
+        if self.fused_ce_mode != "none":
+            out += ["--fused-ce", str(fused_ce_chunks),
+                    "--fused-ce-mode", self.fused_ce_mode]
+        if self.zero != "none":
+            out += ["--zero", self.zero]
+        if self.grad_compress != "none":
+            out += ["--grad-compress", self.grad_compress]
+        return out
+
+    def cli(self) -> str:
+        prog = ("pytorch_distributed_tpu.recipes.lm_pretrain"
+                if self.spec.family == "lm" else "main.py")
+        if self.spec.family == "lm":
+            return "python -m " + prog + " " + " ".join(self.flags())
+        return "python " + prog + " " + " ".join(self.flags())
+
+    def to_dict(self) -> Dict:
+        return {
+            "chips": self.chips, "dp": self.dp, "tp": self.tp,
+            "pp": self.pp, "fsdp": self.fsdp, "remat": self.remat,
+            "fused_ce_mode": self.fused_ce_mode, "zero": self.zero,
+            "grad_compress": self.grad_compress, "key": self.key(),
+            "microbatches": self.microbatches,
+            "flags": self.flags(), "cli": self.cli(),
+        }
+
+
+def _factorizations(n: int, ways: int) -> Iterator[Tuple[int, ...]]:
+    """All ordered tuples of ``ways`` positive ints whose product is n."""
+    if ways == 1:
+        yield (n,)
+        return
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, ways - 1):
+                yield (d,) + rest
+
+
+def elastic_worlds(chips: int, min_ranks: int = 1) -> List[int]:
+    """World sizes the elastic layer (ft/elastic.py) might land on: the
+    requested world, the one-rank-loss survivor count, and the half-pod
+    shrink — the planner pre-plans each so a re-mesh has a ready layout
+    instead of a human mid-incident."""
+    worlds = {chips}
+    if chips - 1 >= min_ranks:
+        worlds.add(chips - 1)
+    if chips // 2 >= max(1, min_ranks):
+        worlds.add(chips // 2)
+    return sorted(worlds, reverse=True)
+
+
+def enumerate_plans(spec: ModelSpec, chips: int) -> List[Plan]:
+    """Every lattice point the family's CLI can express at this world
+    size.  Feasibility is NOT applied here — plan/cost.py prunes — but
+    structurally-inexpressible combos (image tp/pp, tp without the
+    vocab-sharded fused head) are never generated."""
+    plans: List[Plan] = []
+    if spec.family == "image":
+        for zero, gc in itertools.product(
+                ("none", "wus"), ("none", "bf16", "int8", "fp8")):
+            plans.append(Plan(spec=spec, chips=chips, dp=chips, zero=zero,
+                              grad_compress=gc))
+        return plans
+    for dp, tp, pp in _factorizations(chips, 3):
+        for fsdp, remat, ce, zero in itertools.product(
+                (False, True), (False, True),
+                ("none", "replicated", "dp", "tp"), ("none", "wus")):
+            if tp > 1 and ce != "tp":
+                continue  # Megatron TP requires the vocab-sharded head
+            if tp == 1 and ce == "tp":
+                continue
+            if fsdp and zero == "wus":
+                continue  # ZeRO-3 already shards what WUS would
+            plans.append(Plan(spec=spec, chips=chips, dp=dp, tp=tp, pp=pp,
+                              fsdp=fsdp, remat=remat, fused_ce_mode=ce,
+                              zero=zero))
+    return plans

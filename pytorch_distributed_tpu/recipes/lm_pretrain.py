@@ -234,6 +234,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "stamp mem_peak_bytes into each metrics record; "
                         "rides the --comm-ledger AOT lowering so the pair "
                         "costs one shared compile")
+    p.add_argument("--lowering-cache", type=str, default=None,
+                   dest="lowering_cache", metavar="DIR",
+                   help="persist the ledger AOT lowering's artifacts "
+                        "(<step>.hlo + <step>.json, analysis/lowering.py "
+                        "layout) under DIR for post-hoc text-only "
+                        "re-analysis")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -483,6 +489,7 @@ def main(argv=None) -> float:
             watch_recompiles=args.watch_recompiles,
             comm_ledger=args.comm_ledger,
             mem_ledger=args.mem_ledger,
+            lowering_cache=args.lowering_cache,
             save_steps=args.save_steps, resume=args.resume,
             nan_guard=args.nan_guard, ft_rollback_k=args.ft_rollback_k,
             ft_check_every=args.ft_check_every,

@@ -142,6 +142,11 @@ class Config:
     # both), with top-buffers-at-peak attribution and class/phase
     # breakdown written as JSON next to the run.
     mem_ledger: Optional[str] = None
+    # Lowering-service artifact dir (analysis/lowering.py): the ledger
+    # AOT compile additionally persists the step's <name>.hlo/<name>.json
+    # pair here so post-hoc tooling re-analyzes text instead of
+    # recompiling.
+    lowering_cache: Optional[str] = None
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -348,6 +353,12 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "mem_peak_bytes into each metrics record; rides the "
                    "--comm-ledger AOT lowering, so together they cost one "
                    "extra compile, not two")
+    p.add_argument("--lowering-cache", default=d.lowering_cache, type=str,
+                   dest="lowering_cache", metavar="DIR",
+                   help="persist the ledger AOT lowering's artifacts "
+                   "(<step>.hlo + <step>.json: HLO text, mesh shape, "
+                   "measured peak, arg classes; analysis/lowering.py "
+                   "layout) under DIR for post-hoc text-only re-analysis")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

@@ -542,6 +542,7 @@ class LMTrainer:
         watch_recompiles: bool = False,
         comm_ledger: Optional[str] = None,
         mem_ledger: Optional[str] = None,
+        lowering_cache: Optional[str] = None,
         save_steps: int = 0,
         resume: Optional[str] = None,
         nan_guard: bool = False,
@@ -694,6 +695,7 @@ class LMTrainer:
         # pair costs one extra step compile, shared between them.
         self._comm_ledger_path = comm_ledger
         self._mem_ledger_path = mem_ledger
+        self._lowering_cache = lowering_cache
         self._comm_fields: Optional[dict] = None
 
         # ---- fault tolerance (ft/) ----
@@ -996,32 +998,32 @@ class LMTrainer:
     def _emit_ledgers(self, tokens, lr) -> None:
         """AOT-compile the live LM step once against the first batch's
         real shardings and itemize both opt-in receipts off that single
-        lowering: the collective ledger and the static HBM memory
-        ledger.  The cached metrics fields ride every subsequent
-        record."""
+        lowering (``analysis.lowering.aot_ledgers`` — counted against
+        the process-wide compile budget and, with ``lowering_cache``
+        set, persisted in the service's artifact layout): the collective
+        ledger and the static HBM memory ledger.  The cached metrics
+        fields ride every subsequent record."""
+        from pytorch_distributed_tpu.analysis import lowering
         from pytorch_distributed_tpu.obs import comms
 
         args = (self.state, tokens, lr)
-        compiled = self.step_fn.lower(*args).compile()
-        text = compiled.as_text()
-        mesh_shape = dict(self.mesh.shape)
+        ledger, mled = lowering.aot_ledgers(
+            self.step_fn, args, step="lm_step",
+            mesh_shape=dict(self.mesh.shape),
+            want_comm=self._comm_ledger_path is not None,
+            want_mem=self._mem_ledger_path is not None,
+            cache_dir=self._lowering_cache)
         self._comm_fields = {}
-        if self._comm_ledger_path is not None:
-            ledger = comms.ledger_from_hlo_text(
-                text, step="lm_step", mesh_shape=mesh_shape)
-            ledger.peak_hbm_bytes = comms.compiled_peak_bytes(compiled)
+        if ledger is not None:
             self._comm_fields.update(ledger.metrics_fields())
             if self.is_primary:
                 comms.write_ledgers(self._comm_ledger_path, [ledger])
                 print(f"=> wrote comm ledger ({ledger.count} collectives, "
                       f"{ledger.total_bytes} B/step payload) to "
                       f"{self._comm_ledger_path}", flush=True)
-        if self._mem_ledger_path is not None:
+        if mled is not None:
             from pytorch_distributed_tpu.obs import memory
 
-            mled = memory.ledger_from_compiled(
-                compiled, step="lm_step", mesh_shape=mesh_shape,
-                arg_classes=memory.arg_classes_of(args), hlo_text=text)
             self._comm_fields.update(mled.metrics_fields())
             if self.is_primary:
                 memory.write_ledgers(self._mem_ledger_path, [mled])

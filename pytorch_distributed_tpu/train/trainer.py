@@ -684,33 +684,36 @@ class Trainer:
         """AOT-compile the live train step once against the first batch's
         real shardings and itemize both opt-in receipts off that single
         lowering: the communication ledger (``--comm-ledger``) and the
-        static HBM memory ledger (``--mem-ledger``).  Sharing the compile
-        keeps the pair at one extra compile, not two; the cached metrics
-        fields ride every subsequent ``log_step`` record."""
+        static HBM memory ledger (``--mem-ledger``).  The compile goes
+        through ``analysis.lowering.aot_ledgers`` so it shares the
+        process-wide compile counter (the tier-1 budget fence sees it)
+        and, under ``--lowering-cache DIR``, persists the standard
+        ``<step>.hlo``/``<step>.json`` artifact pair for post-hoc
+        re-analysis; the cached metrics fields ride every subsequent
+        ``log_step`` record."""
+        from pytorch_distributed_tpu.analysis import lowering
         from pytorch_distributed_tpu.obs import comms
 
         cfg = self.cfg
         args = (self.state, batch, lr_arr)
-        compiled = self.train_step.lower(*args).compile()
-        text = compiled.as_text()
-        mesh_shape = dict(self.mesh.shape)
+        want_comm = bool(getattr(cfg, "comm_ledger", None))
+        want_mem = bool(getattr(cfg, "mem_ledger", None))
+        ledger, mled = lowering.aot_ledgers(
+            self.train_step, args, step="train_step",
+            mesh_shape=dict(self.mesh.shape), want_comm=want_comm,
+            want_mem=want_mem,
+            cache_dir=getattr(cfg, "lowering_cache", None))
         self._comm_fields = {}
-        if getattr(cfg, "comm_ledger", None):
-            ledger = comms.ledger_from_hlo_text(
-                text, step="train_step", mesh_shape=mesh_shape)
-            ledger.peak_hbm_bytes = comms.compiled_peak_bytes(compiled)
+        if ledger is not None:
             self._comm_fields.update(ledger.metrics_fields())
             if self.ctx.process_index == 0:
                 comms.write_ledgers(cfg.comm_ledger, [ledger])
                 print(f"=> wrote comm ledger ({ledger.count} collectives, "
                       f"{ledger.total_bytes} B/step payload) to "
                       f"{cfg.comm_ledger}", flush=True)
-        if getattr(cfg, "mem_ledger", None):
+        if mled is not None:
             from pytorch_distributed_tpu.obs import memory
 
-            mled = memory.ledger_from_compiled(
-                compiled, step="train_step", mesh_shape=mesh_shape,
-                arg_classes=memory.arg_classes_of(args), hlo_text=text)
             self._comm_fields.update(mled.metrics_fields())
             if self.ctx.process_index == 0:
                 memory.write_ledgers(cfg.mem_ledger, [mled])

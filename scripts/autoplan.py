@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""autoplan: static layout planning across the recipe matrix.
+
+Enumerates every recipe-expressible dp x tp x pp x fsdp x remat x
+fused-ce-mode x zero x grad-compress plan for a model at one or more
+chip counts, prunes statically infeasible points (per-chip peak HBM over
+budget, indivisible vocab/head/stage shapes), scores the survivors
+analytically (compute time, wire bytes, predicted exposed comm, peak
+HBM — obs/flops.py's fenced cost models over plan/cost.py), and emits a
+ranked ``plan.json`` with predicted MFU and the exact recipe CLI flags.
+
+The default path is purely analytic: no backend, no mesh, no compiles —
+it runs on a login node in milliseconds.  ``--validate`` additionally
+lowers each top-k candidate's recipe twin on the simulated CPU mesh and
+cross-checks the predictions against the real comm/memory ledgers
+(plan/validate.py), riding the shared lowering service
+(analysis/lowering.py) so an already-swept process pays zero extra
+compiles.
+
+Usage:
+  python scripts/autoplan.py lm --chips 32 --chip v5p
+  python scripts/autoplan.py resnet50 --chips 4,8,32 --out plan.json
+  python scripts/autoplan.py lm-tiny --chips 4 --validate
+  python scripts/autoplan.py --selftest       # resnet50 + LM at 4/8/32
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _setup_mesh_backend() -> None:
+    """--validate needs the simulated mesh; flags must precede jax."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+def _render(payload) -> str:
+    lines = [f"== {payload['model']} @ {payload['chips']} chips "
+             f"({payload['hw']['name']}): {payload['feasible']} feasible / "
+             f"{payload['enumerated']} enumerated =="]
+    for reason, n in sorted(payload["pruned"].items()):
+        lines.append(f"   pruned {n:4d}  {reason}")
+    lines.append(f"   {'#':>2} {'plan':<34} {'MFU%':>6} {'step_ms':>10} "
+                 f"{'wire_MB':>8} {'peak_GB':>8}")
+    for i, entry in enumerate(payload["ranked"], 1):
+        p, s = entry["plan"], entry["predicted"]
+        lines.append(
+            f"   {i:>2} {p['key']:<34} {s['mfu_pct']:>6.2f} "
+            f"{s['step_time_ms']:>10.4f} {s['wire_bytes'] / 1e6:>8.3f} "
+            f"{s['peak_hbm_bytes'] / 1e9:>8.4f}")
+    if payload["ranked"]:
+        lines.append(f"   run: {payload['ranked'][0]['plan']['cli']}")
+    for world, entry in sorted(payload.get("elastic", {}).items(),
+                               key=lambda kv: -int(kv[0])):
+        key = entry["plan"]["key"] if entry else "(none feasible)"
+        lines.append(f"   elastic {world}: {key}")
+    for rec in payload.get("validation", []):
+        verdict = {True: "ok", False: "FAIL", None: "n/a"}[rec["ok"]]
+        lines.append(f"   validate {rec['plan']} -> "
+                     f"{rec['recipe'] or '(no recipe twin)'}: {verdict}")
+        for name, c in (rec.get("checks") or {}).items():
+            if "residual_pct" in c:
+                fence = "" if c.get("fenced", True) else " (unfenced)"
+                lines.append(f"      {name}: residual "
+                             f"{c['residual_pct']:.2f}% of "
+                             f"{c['fence_pct']:.0f}%{fence}")
+    return "\n".join(lines)
+
+
+def selftest() -> int:
+    """The acceptance sweep: ranked plans with predicted MFU + runnable
+    flags for resnet50 and the LM at 4, 8, and 32 chips — analytically,
+    with zero compiles."""
+    from pytorch_distributed_tpu.plan import autoplan
+
+    for model in ("resnet50", "lm"):
+        for chips in (4, 8, 32):
+            out = autoplan(model, chips, chip="v5p", top_k=3)
+            assert out["enumerated"] > 0, (model, chips)
+            assert out["feasible"] > 0, (model, chips, out["pruned"])
+            top = out["ranked"][0]
+            assert top["predicted"]["mfu_pct"] > 0, top
+            assert top["plan"]["flags"], top
+            assert "--batch-size" in top["plan"]["cli"], top
+            print(f"  [selftest] {model}@{chips}: top "
+                  f"{top['plan']['key']} "
+                  f"mfu={top['predicted']['mfu_pct']:.1f}%")
+    # tiny LM must rank the fenced plain-DP recipe first (the tie-break
+    # contract the validation fences depend on)
+    out = autoplan("lm-tiny", 4, top_k=1)
+    assert out["ranked"][0]["plan"]["key"] == "c4/dp4", out["ranked"][0]
+    print("autoplan selftest OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("model", nargs="?", default=None,
+                    help="model to plan for (resnet50 | lm | lm-tiny)")
+    ap.add_argument("--chips", default="4,8,32",
+                    help="comma-separated world sizes (default: 4,8,32)")
+    ap.add_argument("--chip", default=None,
+                    help="chip generation for the capability tables "
+                         "(v4, v5e, v5p, v6e, ...; default: CPU-nominal)")
+    ap.add_argument("--top-k", type=int, default=5)
+    ap.add_argument("--hbm-budget", type=float, default=None,
+                    help="override the per-chip HBM byte budget")
+    ap.add_argument("--no-elastic", action="store_true",
+                    help="skip pre-planning the shrunk elastic worlds")
+    ap.add_argument("--validate", action="store_true",
+                    help="lower the top-k candidates' recipe twins on the "
+                         "simulated mesh and fence predictions vs ledgers")
+    ap.add_argument("--validate-k", type=int, default=3)
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the ranked plan.json to PATH")
+    ap.add_argument("--format", choices=("table", "json"), default="table")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the zero-compile acceptance sweep and exit")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if args.model is None:
+        ap.error("model is required (or --selftest)")
+    if args.validate:
+        _setup_mesh_backend()
+
+    from pytorch_distributed_tpu.plan import MODELS, autoplan
+
+    if args.model not in MODELS:
+        ap.error(f"unknown model {args.model!r}; known: {sorted(MODELS)}")
+
+    sweeps = []
+    rc = 0
+    for chips in [int(c) for c in args.chips.split(",") if c]:
+        payload = autoplan(
+            args.model, chips, chip=args.chip, top_k=args.top_k,
+            elastic=not args.no_elastic, validate=args.validate,
+            validate_k=args.validate_k, hbm_budget=args.hbm_budget)
+        sweeps.append(payload)
+        if args.format == "table":
+            print(_render(payload))
+        if args.validate and not payload.get("validation_ok", True):
+            rc = 1
+    doc = sweeps[0] if len(sweeps) == 1 else {
+        "schema_version": sweeps[0]["schema_version"],
+        "model": args.model, "sweeps": sweeps}
+    if args.format == "json":
+        print(json.dumps(doc, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    if rc:
+        print("autoplan: top-k validation failed its fences",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,7 +114,10 @@ def bench_staleness(lkg_path=None, events_path=None, now=None):
     missing events log is the common case on a fresh checkout, and with no
     parseable timestamp anywhere the answer is ``None`` rather than a
     guess.  Returns ``{"metric", "last_good", "days_stale",
-    "stale_events"}``."""
+    "stale_events"}``, plus the planner-drift fields bench.py stamps on a
+    fresh capture (``predicted_mfu``/``measured_mfu``/
+    ``prediction_drift_pct`` — plan/planner.py ``predicted_mfu`` vs the
+    measured step) when the freshest capture carries them."""
     if lkg_path is None:
         lkg_path = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -122,12 +125,20 @@ def bench_staleness(lkg_path=None, events_path=None, now=None):
     if events_path is None:
         events_path = _default_events_path()
     metric, last_good_t, last_good = None, None, None
+    drift = {}
+
+    def _drift_fields(rec):
+        return {k: rec[k] for k in ("predicted_mfu", "measured_mfu",
+                                    "prediction_drift_pct")
+                if rec.get(k) is not None}
+
     try:
         with open(lkg_path) as f:
             lkg = json.load(f)
         metric = lkg.get("metric")
         last_good = lkg.get("captured_at")
         last_good_t = parse_lkg_time(last_good)
+        drift = _drift_fields(lkg)
     except (OSError, ValueError):
         pass
     stale_events = 0
@@ -147,6 +158,7 @@ def bench_staleness(lkg_path=None, events_path=None, now=None):
                     t = float(rec["t"])
                     if last_good_t is None or t > last_good_t:
                         last_good_t, last_good = t, rec.get("captured_at")
+                        drift = _drift_fields(rec)
                     metric = rec.get("metric", metric)
     except OSError:
         pass
@@ -154,9 +166,11 @@ def bench_staleness(lkg_path=None, events_path=None, now=None):
         return None
     if now is None:
         now = time.time()
-    return {
+    out = {
         "metric": metric,
         "last_good": last_good,
         "days_stale": max(0.0, (now - last_good_t) / 86400.0),
         "stale_events": stale_events,
     }
+    out.update(drift)
+    return out

@@ -190,11 +190,125 @@ def summarize_bench(records: List[dict],
               if staleness.get("stale_events") else "")
         lines.append(f"  last good         {staleness['days_stale']:.1f} "
                      f"days ago ({staleness.get('last_good')}){ev}")
+        if staleness.get("predicted_mfu") is not None:
+            meas = staleness.get("measured_mfu")
+            drift = staleness.get("prediction_drift_pct")
+            tail = (f"  measured {meas:.1f}%  drift {drift:+.1f}%"
+                    if meas is not None and drift is not None else "")
+            lines.append(f"  plan mfu          predicted "
+                         f"{staleness['predicted_mfu']:.1f}%{tail}")
         if staleness.get("warn"):
             lines.append(f"  WARN              benchmark stale "
                          f"> {staleness['max_stale_days']:g} days — "
                          f"re-run bench.py for a fresh capture")
     return lines
+
+
+# ------------------------------------------------------------- plan.json
+def load_plan(path: str) -> Dict:
+    """One autoplan sweep payload (scripts/autoplan.py).  A multi-chip
+    file ({"sweeps": [...]}) folds to its first sweep — the primary world
+    size; pass a single-sweep file to report on another."""
+    with open(path) as f:
+        obj = json.load(f)
+    if "sweeps" in obj:
+        sweeps = obj["sweeps"]
+        if not sweeps:
+            raise ValueError(f"{path}: empty sweeps list")
+        return sweeps[0]
+    return obj
+
+
+def plan_stats(payload: Dict) -> Optional[Dict]:
+    """The chosen (top-ranked) plan's identity + predictions, or None for
+    a sweep where nothing was feasible."""
+    ranked = payload.get("ranked") or []
+    if not ranked:
+        return None
+    top = ranked[0]
+    pred = top.get("predicted", {})
+    return {
+        "model": payload.get("model"),
+        "chips": payload.get("chips"),
+        "hw": (payload.get("hw") or {}).get("name"),
+        "key": top.get("plan", {}).get("key"),
+        "cli": top.get("plan", {}).get("cli"),
+        "predicted_mfu_pct": pred.get("mfu_pct"),
+        "predicted_step_time_ms": pred.get("step_time_ms"),
+        "predicted_wire_bytes": pred.get("wire_bytes"),
+        "predicted_peak_hbm_bytes": pred.get("peak_hbm_bytes"),
+        "validation_ok": payload.get("validation_ok"),
+    }
+
+
+def _residual(predicted: Optional[float],
+              measured: Optional[float]) -> Optional[float]:
+    if predicted is None or measured is None or not predicted:
+        return None
+    return 100.0 * (measured - predicted) / predicted
+
+
+def summarize_plan(payload: Dict, records: List[dict]) -> List[str]:
+    """The ``== plan ==`` section: the chosen plan + its predicted
+    MFU/wire-bytes/peak-HBM, and — when a metrics stream is on hand —
+    the measured values next to each prediction with the drift residual.
+    Drift here is informational (the hard fences live in the validation
+    pass autoplan --validate already ran against the lowered ledgers)."""
+    ps = plan_stats(payload)
+    lines = ["== plan =="]
+    if ps is None:
+        lines.append(f"  (no feasible plan for {payload.get('model')} "
+                     f"at {payload.get('chips')} chips)")
+        return lines
+    lines.append(f"  chosen            {ps['key']}  "
+                 f"({ps['model']} @ {ps['chips']} chips, {ps['hw']})")
+    if ps["cli"]:
+        lines.append(f"  cli               {ps['cli']}")
+    cs = comm_stats(records)
+    mfu = [r["mfu"] for r in records
+           if "mfu" in r and "ft_event" not in r and "bench_event" not in r]
+    measured_mfu = sum(mfu) / len(mfu) if mfu else None
+    for label, pred, meas, fmt in (
+            ("mfu", ps["predicted_mfu_pct"], measured_mfu,
+             lambda v: f"{v:.1f}%"),
+            ("wire bytes", ps["predicted_wire_bytes"],
+             cs["comm_wire_bytes"], lambda v: f"{v:.0f} B"),
+            ("peak hbm", ps["predicted_peak_hbm_bytes"],
+             cs["peak_hbm_bytes"], lambda v: f"{_mib(v)} MiB")):
+        if pred is None:
+            continue
+        res = _residual(pred, meas)
+        tail = (f"  measured {fmt(meas)}  drift {res:+.1f}%"
+                if res is not None else
+                ("  measured --" if meas is None else ""))
+        lines.append(f"  {label:<16}  predicted {fmt(pred)}{tail}")
+    if ps["validation_ok"] is not None:
+        lines.append("  validation        "
+                     + ("ok (lowered-ledger fences hold)"
+                        if ps["validation_ok"]
+                        else "FAILED (predicted vs ledger fence exceeded)"))
+    return lines
+
+
+def plan_json_section(payload: Dict, records: List[dict]) -> Dict:
+    """Machine-readable twin of ``summarize_plan``."""
+    ps = plan_stats(payload)
+    if ps is None:
+        return {"model": payload.get("model"),
+                "chips": payload.get("chips"), "chosen": None}
+    cs = comm_stats(records)
+    mfu = [r["mfu"] for r in records
+           if "mfu" in r and "ft_event" not in r and "bench_event" not in r]
+    measured_mfu = sum(mfu) / len(mfu) if mfu else None
+    ps["measured_mfu_pct"] = measured_mfu
+    ps["measured_wire_bytes"] = cs["comm_wire_bytes"]
+    ps["measured_peak_hbm_bytes"] = cs["peak_hbm_bytes"]
+    ps["mfu_drift_pct"] = _residual(ps["predicted_mfu_pct"], measured_mfu)
+    ps["wire_drift_pct"] = _residual(ps["predicted_wire_bytes"],
+                                     cs["comm_wire_bytes"])
+    ps["peak_hbm_drift_pct"] = _residual(ps["predicted_peak_hbm_bytes"],
+                                         cs["peak_hbm_bytes"])
+    return ps
 
 
 _COMM_FIELDS = ("model_comm_bytes", "comm_wire_bytes", "collective_count",
@@ -451,6 +565,7 @@ def summarize_heartbeats(hb_dir: str, now: Optional[float],
 
 def report(args) -> str:
     sections = []
+    records: List[dict] = []
     if args.metrics_jsonl:
         records, malformed = load_metrics(args.metrics_jsonl)
         sections.append("== steps ==")
@@ -472,6 +587,8 @@ def report(args) -> str:
                                         getattr(args, "comm_predicted", None))
         if getattr(args, "mem_ledger", None):
             sections += summarize_memory([], args.mem_ledger)
+    if getattr(args, "plan", None):
+        sections += summarize_plan(load_plan(args.plan), records)
     if args.telemetry_csv:
         sections.append("== devices ==")
         sections += summarize_telemetry(args.telemetry_csv)
@@ -489,6 +606,7 @@ def report_json(args) -> Dict:
     """Machine-readable twin of ``report()``: every section as structured
     data (``--format json``)."""
     out: Dict = {}
+    records: List[dict] = []
     if args.metrics_jsonl:
         records, malformed = load_metrics(args.metrics_jsonl)
         steps = [r for r in records
@@ -539,6 +657,8 @@ def report_json(args) -> Dict:
     if getattr(args, "mem_ledger", None):
         out.setdefault("memory", {})["ledger"] = _load_mem_ledger_json(
             args.mem_ledger)
+    if getattr(args, "plan", None):
+        out["plan"] = plan_json_section(load_plan(args.plan), records)
     if args.telemetry_csv:
         n_rows, peak, limit = telemetry_stats(args.telemetry_csv)
         out["devices"] = {
@@ -696,23 +816,57 @@ def diff_report(a_records: List[dict], b_records: List[dict],
     return "\n".join(lines), d["regressed"]
 
 
+def plan_diff_rows(plan: Optional[Dict], a_records: List[dict],
+                   b_records: List[dict]) -> Tuple[List[str], Dict]:
+    """The predicted-vs-measured residual rows a ``--plan`` adds to the
+    diff: how far each run's measured MFU sits from the planner's
+    prediction.  Like bench staleness, a note — prediction drift means
+    the cost model needs recalibrating, not that run B regressed."""
+    if plan is None:
+        return [], {}
+    ps = plan_stats(plan)
+    if ps is None or ps.get("predicted_mfu_pct") is None:
+        return [], {}
+    sa, sb = run_stats(a_records), run_stats(b_records)
+    pred = ps["predicted_mfu_pct"]
+    drift = {"predicted_mfu_pct": pred, "plan_key": ps["key"],
+             "mfu_drift_a_pct": _residual(pred, sa["mfu"]),
+             "mfu_drift_b_pct": _residual(pred, sb["mfu"])}
+    fa = (f"{drift['mfu_drift_a_pct']:+.1f}%"
+          if drift["mfu_drift_a_pct"] is not None else "--")
+    fb = (f"{drift['mfu_drift_b_pct']:+.1f}%"
+          if drift["mfu_drift_b_pct"] is not None else "--")
+    lines = [f"  {'plan_mfu_drift':<16} {fa:>10} {fb:>10} "
+             f"{'--':>9}  (vs predicted {pred:.1f}%, plan {ps['key']}; "
+             "note, not a fence)"]
+    return lines, drift
+
+
 def run_diff(path_a: str, path_b: str, threshold_pct: float,
              goodput_threshold_pp: float, fmt: str = "text",
-             staleness: Optional[Dict] = None) -> int:
+             staleness: Optional[Dict] = None,
+             plan: Optional[Dict] = None) -> int:
     a, mal_a = load_metrics(path_a)
     b, mal_b = load_metrics(path_b)
     kw = dict(threshold_pct=threshold_pct,
               goodput_threshold_pp=goodput_threshold_pp,
               label_a=os.path.basename(path_a),
               label_b=os.path.basename(path_b))
+    plan_lines, plan_drift = plan_diff_rows(plan, a, b)
     if fmt == "json":
         d = diff_data(a, b, **kw)
         d["malformed_lines"] = {"a": mal_a, "b": mal_b}
         if staleness is not None:
             d["bench_staleness"] = staleness
+        if plan_drift:
+            d["plan"] = plan_drift
         print(json.dumps(d, indent=2))
         return 1 if d["regressed"] else 0
     text, regressed = diff_report(a, b, **kw)
+    if plan_lines:
+        # splice the drift row above the overall verdict line
+        body = text.splitlines()
+        text = "\n".join(body[:-1] + plan_lines + body[-1:])
     if mal_a or mal_b:
         text += f"\n(malformed lines: A {mal_a}, B {mal_b})"
     if staleness is not None and staleness.get("warn"):
@@ -826,18 +980,30 @@ def _selftest() -> int:
         with open(bench_lkg, "w") as f:
             json.dump({"metric": "resnet50_train_images_per_sec_per_chip",
                        "value": 2511.3, "vs_baseline": 9.3,
-                       "captured_at": stamp}, f)
+                       "captured_at": stamp,
+                       # bench.py stamps the planner prediction on capture
+                       "predicted_mfu": 42.0, "measured_mfu": 39.5,
+                       "prediction_drift_pct": -6.0}, f)
         bench_events = os.path.join(d, "bench_events.jsonl")
         with open(bench_events, "w") as f:
             f.write(json.dumps({"bench_event": "stale", "t": now - 3600,
                                 "reason": "tunnel unreachable"}) + "\n")
+
+        # a real autoplan payload (plan/ is jax-free on this path) for
+        # the plan section + the --diff drift row
+        from pytorch_distributed_tpu.plan import autoplan
+
+        ppath = os.path.join(d, "plan.json")
+        with open(ppath, "w") as f:
+            json.dump(autoplan("lm-tiny", 4, top_k=3), f)
 
         ns = argparse.Namespace(
             metrics_jsonl=mpath, hb_dir=hb_dir, telemetry_csv=tpath,
             now=now, max_step_lag=3, max_beat_age=60.0,
             comm_ledger=lpath, comm_predicted=66000.0,
             mem_ledger=mlpath, bench_lkg=bench_lkg,
-            bench_events=bench_events, bench_max_stale_days=14.0)
+            bench_events=bench_events, bench_max_stale_days=14.0,
+            plan=ppath)
         out = report(ns)
         for needle in ("== steps ==", "steps logged      20", "p95",
                        "throughput", "loss", "grad_norm",
@@ -856,8 +1022,14 @@ def _selftest() -> int:
                        "== memory ==", "per-step peak",
                        "residual 2.5% [ok]", "by class (MiB):",
                        "by phase (MiB):", "top: fusion.7",
+                       "== plan ==", "chosen            c4/dp4",
+                       "cli               python -m "
+                       "pytorch_distributed_tpu.recipes.lm_pretrain",
+                       "predicted", "drift",
                        "== bench ==", "stale", "last good",
                        "days ago", "1 stale event(s)",
+                       "plan mfu          predicted 42.0%",
+                       "drift -6.0%",
                        "WARN", "benchmark stale",
                        "== devices ==", "device 0", "device 1",
                        "== heartbeats ==", "STRAGGLER", "step lag",
@@ -867,8 +1039,12 @@ def _selftest() -> int:
         # json twin: every section present and structurally sane
         js = report_json(ns)
         for key in ("steps", "ft_events", "goodput", "bench", "comms",
-                    "memory", "bench_staleness", "devices", "heartbeats"):
+                    "memory", "bench_staleness", "devices", "heartbeats",
+                    "plan"):
             assert key in js, f"selftest: {key!r} missing from json: {js}"
+        assert js["plan"]["key"] == "c4/dp4", js["plan"]
+        assert js["plan"]["predicted_mfu_pct"] > 0, js["plan"]
+        assert js["plan"]["mfu_drift_pct"] is not None, js["plan"]
         assert js["steps"]["model_comm_bytes"] == 66952.0, js["steps"]
         assert abs(js["comms"]["residual_pct"]) < 15.0, js["comms"]
         assert js["comms"]["ledger"]["lm_train_dp"]["total_bytes"] == 66952
@@ -878,6 +1054,8 @@ def _selftest() -> int:
         assert mled["class_peaks"]["params"] == 400, mled
         assert js["bench_staleness"]["warn"], js["bench_staleness"]
         assert 19.5 < js["bench_staleness"]["days_stale"] < 20.5, (
+            js["bench_staleness"])
+        assert js["bench_staleness"]["prediction_drift_pct"] == -6.0, (
             js["bench_staleness"])
         assert js["heartbeats"]["1"]["straggler"], js["heartbeats"]
         assert not js["heartbeats"]["0"]["straggler"], js["heartbeats"]
@@ -978,6 +1156,17 @@ def _selftest() -> int:
         assert rc == 0, f"selftest: stale bench must not fail --diff:\n{noted}"
         assert "note: benchmark baseline stale 20.0 days" in noted, noted
         assert "overall: PASS" in noted, noted
+
+        # ---- plan drift row in --diff: also a note, never a failure ----
+        buf2 = io.StringIO()
+        with contextlib.redirect_stdout(buf2):
+            rc2 = run_diff(fast, fast, 10.0, 5.0, plan=load_plan(ppath))
+        drifted = buf2.getvalue()
+        assert rc2 == 0, (
+            f"selftest: plan drift must not fail --diff:\n{drifted}")
+        assert "plan_mfu_drift" in drifted, drifted
+        assert "not a fence" in drifted, drifted
+        assert "overall: PASS" in drifted, drifted
     print("obs_report selftest: OK")
     return 0
 
@@ -1005,6 +1194,12 @@ def main(argv=None) -> int:
                     "--mem-ledger or a trainer's --mem-ledger) to itemize "
                     "in the memory section: watermark peak vs "
                     "memory_analysis, class/phase breakdown, top buffers")
+    ap.add_argument("--plan", type=str, default=None, metavar="PLAN_JSON",
+                    help="autoplan payload (scripts/autoplan.py --out) to "
+                    "fold in: the chosen plan + predicted vs measured "
+                    "MFU/wire-bytes/peak-HBM drift; in --diff, adds the "
+                    "predicted-vs-measured MFU residual row (a note, "
+                    "never a verdict)")
     ap.add_argument("--bench-lkg", type=str, default=None, dest="bench_lkg",
                     help="BENCH_LKG.json for staleness aging (default: the "
                     "checked-in repo-root file)")
@@ -1048,7 +1243,8 @@ def main(argv=None) -> int:
     if args.diff:
         return run_diff(args.diff[0], args.diff[1], args.threshold_pct,
                         args.goodput_threshold_pp, fmt=args.format,
-                        staleness=bench_staleness_info(args))
+                        staleness=bench_staleness_info(args),
+                        plan=(load_plan(args.plan) if args.plan else None))
     if args.format == "json":
         print(json.dumps(report_json(args), indent=2))
     else:

@@ -34,6 +34,10 @@ Usage:
                                                  # per-buffer HBM watermark
                                                  # + peak attribution
                                                  # (obs.memory)
+  python scripts/shardlint.py --hlo-cache hlo/   # persist the sweep's
+                                                 # lowering artifacts
+                                                 # (analysis/lowering.py
+                                                 # <name>.hlo/.json layout)
   python scripts/shardlint.py --selftest         # planted-hazard checks
 """
 
@@ -91,6 +95,11 @@ def main() -> int:
                     help="write the static HBM memory ledger (live-range "
                          "watermark, top buffers at peak, class/phase "
                          "breakdown) for the analyzed steps to PATH")
+    ap.add_argument("--hlo-cache", default=None, metavar="DIR",
+                    help="persist each analyzed step's lowering artifacts "
+                         "(<name>.hlo + <name>.json) under DIR via the "
+                         "shared lowering service (analysis/lowering.py) "
+                         "so later text-only consumers skip the compile")
     ap.add_argument("--min-replicated-bytes", type=int,
                     default=core.DEFAULT_MIN_REPLICATED_BYTES)
     ap.add_argument("--min-promotion-bytes", type=int,
@@ -136,6 +145,16 @@ def main() -> int:
                 continue
             for f in diff_against_baseline(r, baseline.get(r.name)):
                 r.add(f)
+
+    if args.hlo_cache:
+        # The analysis above already paid the compiles (core's memo);
+        # persisting is a pure write of the cached records.
+        from pytorch_distributed_tpu.analysis import lowering  # noqa: E402
+        svc = lowering.service(args.hlo_cache)
+        persisted = [n for n in (names or list(core.RECIPES))
+                     if n in core.RECIPES and svc.get(n)]
+        print(f"persisted {len(persisted)} lowering artifact pairs to "
+              f"{args.hlo_cache}")
 
     if args.comm_ledger:
         # Rides the same lowering cache as the analysis sweep above, so

@@ -30,12 +30,20 @@ jax.config.update("jax_threefry_partitionable", True)
 # (jax_compilation_cache_dir) was tried here and REVERTED: on this
 # jax/jaxlib (0.4.37, CPU backend) re-executing a deserialized cached
 # executable aborts the process ("Fatal Python error: Aborted" in
-# test_trainer's train step).  Don't re-enable without upgrading jaxlib
-# and re-running the full suite twice (populate + warm) to completion.
+# test_trainer's train step).  The re-attempt now lives behind
+# ``analysis.lowering.maybe_enable_persistent_cache`` (called below):
+# known-bad jaxlibs (< 0.5.0) short-circuit to disabled without probing,
+# and on newer jaxlibs the cache auto-enables only after a populate+warm
+# subprocess round-trip self-check passes — the abort cannot be
+# try/except'd in-process, so only a subprocess can prove it safe.
 
 # Installs the jax API compat shims (jax.shard_map / lax.axis_size on
 # 0.4.x) before any test module does ``from jax import shard_map``.
 import pytorch_distributed_tpu  # noqa: E402,F401
+
+from pytorch_distributed_tpu.analysis import lowering as _lowering  # noqa: E402
+
+_PERSISTENT_CACHE = _lowering.maybe_enable_persistent_cache()
 
 import pytest  # noqa: E402
 
@@ -88,34 +96,26 @@ def get_lowering(tmp_path_factory):
     Threshold variations and ledger extraction are pure functions of the
     cached Lowering record.
 
-    On first build per step the wrapper also drops the compiled artifacts
-    (HLO text + measured peak/mesh/arg-classes JSON) under the session
-    tmp dir — ``<name>.hlo`` / ``<name>.json`` in ``wrapper.cache_dir``
-    — so subprocess consumers (the obs_memory CLI test) and pure-text
+    The sweep and its on-disk artifact layout are owned by the first-class
+    service (``analysis.lowering.LoweringService``): on first build per
+    step the service drops ``<name>.hlo`` / ``<name>.json`` (HLO text +
+    measured peak/mesh/arg-classes) under ``wrapper.cache_dir`` so
+    subprocess consumers (the obs_memory CLI test) and pure-text
     re-analyses read files instead of recompiling.  ``wrapper.
     compile_count()`` exposes the process-wide AOT compile counter for
-    the zero-extra-compiles asserts."""
-    import json
-
-    from pytorch_distributed_tpu.analysis import core
-    from pytorch_distributed_tpu.obs import comms, memory
+    the zero-extra-compiles asserts, and ``wrapper.service`` the
+    underlying LoweringService (``.load(name)`` for the no-jax disk
+    view)."""
+    from pytorch_distributed_tpu.analysis import lowering
 
     cache_dir = tmp_path_factory.mktemp("hlo_cache")
+    svc = lowering.service(str(cache_dir))
 
     def wrapper(name: str):
-        low = core.get_lowering(name)
-        hlo_path = cache_dir / f"{name}.hlo"
-        if not hlo_path.exists():
-            hlo_path.write_text(low.text)
-            (cache_dir / f"{name}.json").write_text(json.dumps({
-                "name": name,
-                "mesh_shape": low.mesh_shape,
-                "measured_peak_bytes":
-                    comms.compiled_peak_bytes(low.compiled),
-                "arg_classes": memory.arg_classes_of(low.args),
-            }))
-        return low
+        return svc.get(name)
 
     wrapper.cache_dir = cache_dir
-    wrapper.compile_count = core.compile_count
+    wrapper.compile_count = lowering.compile_count
+    wrapper.compile_budget = lowering.compile_budget
+    wrapper.service = svc
     return wrapper
