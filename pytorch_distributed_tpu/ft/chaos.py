@@ -10,7 +10,10 @@ parameter (``Trainer``/``LMTrainer``), which calls
 - ``on_step(trainer, step)``   once per loop iteration, before the step —
   signal/kill/delay/lr faults;
 - ``on_batch(step, batch)``    on the device batch — data corruption (NaN
-  poisoning for float inputs).
+  poisoning for float inputs);
+- ``on_collective(trainer, step)``  inside the recorded collective region,
+  between the flight recorder's ``coll_enter`` and the compiled step call
+  — stalled-rank faults the hang watchdog must catch (``HangAt``).
 
 File-level corruption (``corrupt_file``) is trainer-independent; it backs
 ``scripts/chaoskit.py`` and the checkpoint-integrity tests.
@@ -35,6 +38,9 @@ class ChaosInjector:
 
     def on_batch(self, step: int, batch):  # noqa: ARG002
         return batch
+
+    def on_collective(self, trainer, step: int) -> None:  # noqa: ARG002
+        return None
 
 
 class SignalAt(ChaosInjector):
@@ -145,6 +151,34 @@ class DelayRank(ChaosInjector):
         time.sleep(self.seconds)
 
 
+class HangAt(ChaosInjector):
+    """Stall ``rank`` for ``seconds`` when the loop reaches ``at_step`` —
+    inside the collective region (after the flight recorder's
+    ``coll_enter``, before the compiled step call), so the stall is
+    exactly what a desynced/stuck collective looks like to the rest of
+    the stack.  The hang watchdog must flag it within its window, emit a
+    ``hang`` ft_event, and dump the ring pre-mortem; ``postmortem.py``
+    must then name the rank.  Fires once (latched), like ``SignalAt``."""
+
+    def __init__(self, at_step: int, seconds: float,
+                 rank: Optional[int] = None):
+        self.at_step = int(at_step)
+        self.seconds = float(seconds)
+        self.rank = rank  # None = every rank
+        self.fired = False
+
+    def on_collective(self, trainer, step: int) -> None:  # noqa: ARG002
+        if self.fired or step != self.at_step:
+            return
+        if self.rank is not None:
+            import jax
+
+            if jax.process_index() != self.rank:
+                return
+        self.fired = True
+        time.sleep(self.seconds)
+
+
 class ChaosSchedule(ChaosInjector):
     """Compose injectors; trainers call the schedule, it fans out."""
 
@@ -159,6 +193,10 @@ class ChaosSchedule(ChaosInjector):
         for inj in self.injectors:
             batch = inj.on_batch(step, batch)
         return batch
+
+    def on_collective(self, trainer, step: int) -> None:
+        for inj in self.injectors:
+            inj.on_collective(trainer, step)
 
 
 def corrupt_file(path: str, mode: str = "flip", seed: int = 0,

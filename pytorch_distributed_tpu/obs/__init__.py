@@ -22,6 +22,11 @@ is three ``.item()`` calls per batch plus a 500 ms nvidia-smi CSV).
   compiled step with payload/wire bytes, replica-group fan-out, and jax
   scope attribution (``CommLedger``), emitted per run as
   ``comm_ledger.json`` and stamped into the metrics JSONL.
+- ``flightrec`` — per-rank crash forensics: a bounded in-memory event ring
+  (step/collective/ft/membership events, ~zero hot-path cost) dumped
+  atomically to ``flightrec_rank<k>.json`` on any death path, plus the
+  collective-hang watchdog daemon; ``scripts/postmortem.py`` merges the
+  per-rank dumps into a cross-rank root-cause report.
 - ``timeline``  — the runtime side: a pure-python XPlane decoder turning
   profiler captures into per-stream spans, per-step comm/compute/overlap
   accounting (exposed-comm), heartbeat-based cross-rank clock alignment,
@@ -65,6 +70,11 @@ from pytorch_distributed_tpu.obs.timeline import (
     marry_ledger,
     parse_xspace,
     to_chrome_trace,
+)
+from pytorch_distributed_tpu.obs.flightrec import (
+    FlightRecorder,
+    FlightSignalDump,
+    HangWatchdog,
 )
 from pytorch_distributed_tpu.obs.goodput import (
     GoodputTracker,
@@ -115,6 +125,9 @@ __all__ = [
     "compute_goodput",
     "summarize_goodput",
     "RecompileWatchdog",
+    "FlightRecorder",
+    "FlightSignalDump",
+    "HangWatchdog",
     "CommEntry",
     "CommLedger",
     "ledger_from_hlo_text",

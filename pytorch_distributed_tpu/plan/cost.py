@@ -28,7 +28,9 @@ from pytorch_distributed_tpu.plan.space import ModelSpec, Plan
 
 # Fraction of compute time backward-phase gradient collectives can hide
 # under (bucketed sync overlaps the tail of backward; arXiv:1810.11112).
-# Env PTD_PLAN_OVERLAP overrides for calibrated deployments.
+# Env PTD_PLAN_OVERLAP overrides everything; a measured value flows in
+# via ``autoplan.py --overlap-from <timeline.json>`` (the profiler's
+# observed overlap_pct_mean) through the ``overlap=`` kwarg below.
 DEFAULT_OVERLAP = 0.6
 
 # Fraction of per-chip HBM a plan may fill before pruning: headroom for

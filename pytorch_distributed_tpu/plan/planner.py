@@ -43,10 +43,15 @@ def rank_key(plan: Plan, score: cost_mod.PlanScore) -> Tuple:
 
 
 def rank_plans(spec: ModelSpec, chips: int, hw: cost_mod.HW,
-               hbm_budget: Optional[float] = None
+               hbm_budget: Optional[float] = None,
+               overlap: Optional[float] = None
                ) -> Tuple[List[Tuple[Plan, cost_mod.PlanScore]],
                           Dict[str, int]]:
-    """(ranked feasible plans with scores, pruned-reason histogram)."""
+    """(ranked feasible plans with scores, pruned-reason histogram).
+
+    ``overlap`` is the backward-overlap fraction for the scorer; None
+    keeps the cost model's assumed default (a measured value comes from
+    ``autoplan.py --overlap-from <timeline.json>``)."""
     ranked: List[Tuple[Plan, cost_mod.PlanScore]] = []
     pruned: Dict[str, int] = {}
     for plan in enumerate_plans(spec, chips):
@@ -62,7 +67,10 @@ def rank_plans(spec: ModelSpec, chips: int, hw: cost_mod.HW,
                     key = r.split(";")[0]
                 pruned[key] = pruned.get(key, 0) + 1
             continue
-        ranked.append((plan, cost_mod.score_plan(plan, hw)))
+        ranked.append((plan, cost_mod.score_plan(
+            plan, hw,
+            overlap=(cost_mod.DEFAULT_OVERLAP if overlap is None
+                     else overlap))))
     ranked.sort(key=lambda ps: rank_key(*ps))
     return ranked, pruned
 
@@ -74,16 +82,21 @@ def plan_entry(plan: Plan, score: cost_mod.PlanScore) -> Dict[str, Any]:
 def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
              top_k: int = 5, elastic: bool = True, validate: bool = False,
              validate_k: int = 3, hbm_budget: Optional[float] = None,
+             overlap: Optional[float] = None,
              spec: Optional[ModelSpec] = None) -> Dict[str, Any]:
     """The full pipeline for one (model, world size).  Returns the
-    ``plan.json`` payload; never imports jax unless ``validate=True``."""
+    ``plan.json`` payload; never imports jax unless ``validate=True``.
+
+    ``overlap`` replaces the assumed backward-overlap fraction with a
+    measured one (0-1); the payload records which was used."""
     if spec is None:
         if model not in MODELS:
             raise KeyError(f"unknown model {model!r}; known: "
                            f"{sorted(MODELS)}")
         spec = MODELS[model]()
     hw = cost_mod.hw_for(chip)
-    ranked, pruned = rank_plans(spec, chips, hw, hbm_budget=hbm_budget)
+    ranked, pruned = rank_plans(spec, chips, hw, hbm_budget=hbm_budget,
+                                overlap=overlap)
     payload: Dict[str, Any] = {
         "schema_version": PLAN_SCHEMA_VERSION,
         "model": spec.name,
@@ -91,6 +104,9 @@ def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
         "chips": chips,
         "hw": {"name": hw.name, "peak_flops": hw.peak_flops,
                "hbm_bytes": hw.hbm_bytes, "link_bytes": hw.link_bytes},
+        "overlap": (cost_mod.DEFAULT_OVERLAP if overlap is None
+                    else float(overlap)),
+        "overlap_source": "assumed" if overlap is None else "measured",
         "enumerated": len(ranked) + sum(pruned.values()),
         "feasible": len(ranked),
         "pruned": pruned,
@@ -101,7 +117,8 @@ def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
         for w in elastic_worlds(chips):
             if w == chips:
                 continue
-            sub, _ = rank_plans(spec, w, hw, hbm_budget=hbm_budget)
+            sub, _ = rank_plans(spec, w, hw, hbm_budget=hbm_budget,
+                                overlap=overlap)
             worlds[str(w)] = (plan_entry(*sub[0]) if sub else None)
         payload["elastic"] = worlds
     if validate:

@@ -240,6 +240,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "(<step>.hlo + <step>.json, analysis/lowering.py "
                         "layout) under DIR for post-hoc text-only "
                         "re-analysis")
+    p.add_argument("--flight-rec", type=str, default=None,
+                   dest="flight_rec", metavar="DIR",
+                   help="flight recorder (obs/flightrec.py): bounded "
+                        "in-memory ring of step/collective/ft events "
+                        "dumped to DIR/flightrec_rank<k>.json on any "
+                        "death path (signal, rollback, checkpoint "
+                        "corruption, unhandled exception, hang watchdog); "
+                        "merge dumps with scripts/postmortem.py")
+    p.add_argument("--hang-timeout", type=float, default=30.0,
+                   dest="hang_timeout", metavar="SEC",
+                   help="hang-watchdog floor: flag a step exceeding "
+                        "max(SEC, 4×p95), emit a `hang` ft_event with the "
+                        "last-entered collective, and dump the flight "
+                        "ring pre-mortem (needs --flight-rec)")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -501,6 +515,8 @@ def main(argv=None) -> float:
                                 min_ranks=args.min_ranks)
                      if args.elastic else None),
             rescale_lr=args.rescale_lr,
+            flight_rec=args.flight_rec,
+            hang_timeout=args.hang_timeout,
         )
         try:
             final_loss = trainer.fit(args.steps, print_freq=args.print_freq)

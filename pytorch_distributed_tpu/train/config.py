@@ -147,6 +147,15 @@ class Config:
     # pair here so post-hoc tooling re-analyzes text instead of
     # recompiling.
     lowering_cache: Optional[str] = None
+    # Flight recorder (obs/flightrec.py): per-rank bounded event ring
+    # dumped to flightrec_rank<k>.json in this directory on any death
+    # path (signal / rollback / checkpoint corruption / unhandled fit
+    # exception / hang watchdog); merge with scripts/postmortem.py.
+    flight_rec: Optional[str] = None
+    # Collective-hang watchdog floor: a step exceeding
+    # max(hang_timeout, 4×p95) triggers a `hang` ft_event + pre-mortem
+    # ring dump.  Only active with flight_rec set.
+    hang_timeout: float = 30.0
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -359,6 +368,21 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "(<step>.hlo + <step>.json: HLO text, mesh shape, "
                    "measured peak, arg classes; analysis/lowering.py "
                    "layout) under DIR for post-hoc text-only re-analysis")
+    p.add_argument("--flight-rec", default=d.flight_rec, type=str,
+                   dest="flight_rec", metavar="DIR",
+                   help="flight recorder (obs/flightrec.py): keep a "
+                   "bounded in-memory ring of step/collective/ft events "
+                   "(~zero hot-path cost) and dump it to DIR/"
+                   "flightrec_rank<k>.json on any death path — signal, "
+                   "rollback, checkpoint corruption, unhandled exception, "
+                   "or the collective-hang watchdog; merge dumps with "
+                   "scripts/postmortem.py")
+    p.add_argument("--hang-timeout", default=d.hang_timeout, type=float,
+                   dest="hang_timeout", metavar="SEC",
+                   help="hang-watchdog floor: flag a step exceeding "
+                   "max(SEC, 4×p95 of completed steps), emit a `hang` "
+                   "ft_event with the last-entered collective, and dump "
+                   "the flight ring pre-mortem (needs --flight-rec)")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

@@ -554,6 +554,8 @@ class LMTrainer:
         zero: Optional[str] = None,
         elastic=None,
         rescale_lr: str = "none",
+        flight_rec: Optional[str] = None,
+        hang_timeout: float = 30.0,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -604,7 +606,14 @@ class LMTrainer:
         rule across a world change: ``none`` holds the *global* batch
         constant (LR untouched — the parity-fence default), ``linear`` /
         ``sqrt`` hold the *per-rank* batch constant and scale the LR by
-        (new/old) or sqrt(new/old)."""
+        (new/old) or sqrt(new/old).
+
+        Crash forensics (obs/flightrec.py): ``flight_rec`` is a directory
+        receiving this rank's ``flightrec_rank<k>.json`` ring dump on any
+        death path (signal / rollback / checkpoint corruption / unhandled
+        exception / hang watchdog); ``hang_timeout`` is the watchdog's
+        floor — a step exceeding ``max(hang_timeout, 4×p95)`` emits a
+        ``hang`` ft_event and dumps the ring pre-mortem."""
         from pytorch_distributed_tpu.parallel import zero as zero_lib
         from pytorch_distributed_tpu.parallel.tp import (
             replicated_like,
@@ -697,6 +706,27 @@ class LMTrainer:
         self._mem_ledger_path = mem_ledger
         self._lowering_cache = lowering_cache
         self._comm_fields: Optional[dict] = None
+        # Dominant ledger collective labelling the flight ring's
+        # coll_enter events; None until a ledger lowering runs.
+        self._flight_coll: Optional[dict] = None
+
+        # ---- crash forensics (obs/flightrec.py) ----
+        self.flight = None
+        self._hang_wd = None
+        if flight_rec:
+            from pytorch_distributed_tpu.obs.flightrec import (
+                FlightRecorder,
+                HangWatchdog,
+                attach_to_metrics,
+            )
+
+            self.flight = FlightRecorder(flight_rec,
+                                         rank=jax.process_index())
+            self._hang_wd = HangWatchdog(self.flight, obs=self.obs,
+                                         timeout=float(hang_timeout))
+            attach_to_metrics(self.flight, self.obs)
+            self.flight.set_membership(dict(mesh.shape).get("data", 1),
+                                       self._membership_epoch)
 
         # ---- fault tolerance (ft/) ----
         self.save_steps = int(save_steps)
@@ -836,6 +866,8 @@ class LMTrainer:
         self._membership_epoch += 1
         if self.hb is not None:
             self.hb.set_membership(new_world, self._membership_epoch)
+        if self.flight is not None:
+            self.flight.set_membership(new_world, self._membership_epoch)
         return resume
 
     def _apply_remesh(self, chg, at_step: int) -> int:
@@ -981,6 +1013,8 @@ class LMTrainer:
             is_best=is_best, is_primary=self.is_primary,
             ft=self._ft_record(completed),
         )
+        if self.flight is not None:
+            self.flight.event("checkpoint", completed)
 
     def _rollback(self, step: int) -> None:
         """Divergence recovery: restore the last-good snapshot and back
@@ -994,6 +1028,10 @@ class LMTrainer:
         scale = self.ft_guard.note_rollback(step, restored_step)
         print(f"=> divergence rollback at step {step}: restored state from "
               f"step {restored_step}, lr scale now {scale:g}", flush=True)
+        if self.flight is not None:
+            # The rollback itself is forensic: snapshot the ring (the
+            # `rollback` ft_event is already in it via attach_to_metrics).
+            self.flight.dump("rollback")
 
     def _emit_ledgers(self, tokens, lr) -> None:
         """AOT-compile the live LM step once against the first batch's
@@ -1016,6 +1054,10 @@ class LMTrainer:
         self._comm_fields = {}
         if ledger is not None:
             self._comm_fields.update(ledger.metrics_fields())
+            if ledger.entries:
+                top = max(ledger.entries, key=lambda e: e.wire_bytes)
+                self._flight_coll = {"kind": top.kind, "bytes": top.bytes,
+                                     "name": top.name}
             if self.is_primary:
                 comms.write_ledgers(self._comm_ledger_path, [ledger])
                 print(f"=> wrote comm ledger ({ledger.count} collectives, "
@@ -1078,6 +1120,25 @@ class LMTrainer:
             self._keeper.update(self.state, start)
         lr_val = None  # cached: jnp.float32() only when the value changes
         lr = jnp.float32(self.lr)
+        # Flight recorder death paths: signal-dump chain (chains to the
+        # caller's PreemptionGuard handler when both hold the same
+        # signals) + the collective-hang watchdog daemon.
+        flight_sig = None
+        if self.flight is not None:
+            import signal as _signal
+            import threading as _threading
+
+            if _threading.current_thread() is _threading.main_thread():
+                from pytorch_distributed_tpu.obs.flightrec import (
+                    FlightSignalDump,
+                )
+
+                sigs = (getattr(self.preempt, "_signals", None)
+                        or (_signal.SIGTERM,))
+                flight_sig = FlightSignalDump(self.flight,
+                                              signals=sigs).install()
+            if self._hang_wd is not None:
+                self._hang_wd.start()
         try:
             meters.restart_clock()
             i = start
@@ -1124,8 +1185,22 @@ class LMTrainer:
                         or self._mem_ledger_path is not None)
                         and self._comm_fields is None):
                     self._emit_ledgers(tokens, lr)
+                if self.flight is not None:
+                    # Ring: step window + collective region (labelled with
+                    # the ledger's dominant entry when the AOT lowering
+                    # ran) — two deque appends, no sync/I/O.
+                    self.flight.step_begin(i)
+                    fc = self._flight_coll or {}
+                    self.flight.coll_enter(i, kind=fc.get("kind"),
+                                           bytes=fc.get("bytes"),
+                                           name=fc.get("name"))
+                if self.chaos is not None:
+                    self.chaos.on_collective(self, i)
                 with scope("lm_step"), self._wd_watch("lm_step", i):
                     self.state, metrics = self.step_fn(self.state, tokens, lr)
+                if self.flight is not None:
+                    self.flight.coll_exit(i)
+                    self.flight.step_end(i)
                 completed = i + 1
                 dt = meters.update(metrics, self.batch_size)
                 extra = (dict(self._mfu.fields(dt))
@@ -1144,6 +1219,10 @@ class LMTrainer:
                     self.hb.beat(i, step_time_ema=self.obs.ema,
                                  last_ft=self.obs.last_event_kind,
                                  mem_bytes=sample_process_memory())
+                    if self.flight is not None:
+                        self.flight.heartbeat(
+                            {"step": i,
+                             "last_ft": self.obs.last_event_kind})
                 meters.maybe_display(i, print_freq)
                 at_save = (self.save_steps > 0
                            and completed % self.save_steps == 0)
@@ -1182,8 +1261,24 @@ class LMTrainer:
                 # resolve before the end-of-fit checkpoint can capture a
                 # diverged state.
                 self._rollback(completed)
+        except BaseException as e:
+            if self.flight is not None:
+                from pytorch_distributed_tpu.ft.integrity import (
+                    CheckpointCorruptError,
+                )
+
+                self.flight.record("exception", completed,
+                                   error=type(e).__name__)
+                self.flight.dump("checkpoint_corrupt"
+                                 if isinstance(e, CheckpointCorruptError)
+                                 else f"exception:{type(e).__name__}")
+            raise
         finally:
             token_iter.close()  # unblocks the producer on early exit
+            if self._hang_wd is not None:
+                self._hang_wd.stop()
+            if flight_sig is not None:
+                flight_sig.uninstall()
             if self.watchdog is not None:
                 self.watchdog.uninstall()
             if self.hb is not None:

@@ -313,11 +313,34 @@ class Trainer:
             )
 
             self.watchdog = RecompileWatchdog(obs=self.obs).install()
+        # Flight recorder (obs/flightrec.py): bounded per-rank event ring
+        # + collective-hang watchdog, dumped on any death path; the
+        # signal-dump chain and the watchdog thread start in fit().
+        self.flight = None
+        self._hang_wd = None
+        if getattr(cfg, "flight_rec", None):
+            from pytorch_distributed_tpu.obs.flightrec import (
+                FlightRecorder,
+                HangWatchdog,
+                attach_to_metrics,
+            )
+
+            self.flight = FlightRecorder(cfg.flight_rec,
+                                         rank=self.ctx.process_index)
+            self._hang_wd = HangWatchdog(
+                self.flight, obs=self.obs,
+                timeout=float(getattr(cfg, "hang_timeout", 30.0)))
+            # Every ft_event the metrics logger sees (skip/rollback/
+            # preempt/remesh, incl. DivergenceGuard's) lands in the ring.
+            attach_to_metrics(self.flight, self.obs)
         # Communication + memory ledgers (obs/comms.py, obs/memory.py):
         # emitted lazily on the first train batch (real shardings in
         # hand), opt-in because the AOT lowering does not share the jit
         # call cache — one extra compile shared by both receipts.
         self._comm_fields: Optional[dict] = None
+        # Dominant ledger collective (kind/bytes/name) labelling the flight
+        # ring's coll_enter events; None until a ledger lowering runs.
+        self._flight_coll: Optional[dict] = None
         # Monotonic logged-train-step counter; a resume restores it so the
         # metrics JSONL step axis continues instead of restarting at 0.
         self._global_step = self._resume_global
@@ -343,6 +366,10 @@ class Trainer:
         if self.hb is not None:
             self.hb.set_membership(dict(self.mesh.shape)[self.data_axis],
                                    self._membership_epoch)
+        if self.flight is not None:
+            self.flight.set_membership(
+                dict(self.mesh.shape)[self.data_axis],
+                self._membership_epoch)
 
     def _build_for_mesh(self, mesh: Mesh) -> None:
         """Build (or rebuild) every mesh-shape-dependent piece against
@@ -496,6 +523,8 @@ class Trainer:
         self._membership_epoch += 1
         if self.hb is not None:
             self.hb.set_membership(new_world, self._membership_epoch)
+        if self.flight is not None:
+            self.flight.set_membership(new_world, self._membership_epoch)
         return resume_global
 
     def _apply_remesh(self, chg, epoch: int) -> int:
@@ -663,6 +692,9 @@ class Trainer:
             is_best=False, is_primary=self.ctx.is_primary,
             backend=cfg.ckpt_backend, metric=0.0, ft=ft,
         )
+        if self.flight is not None:
+            self.flight.event("checkpoint", self._global_step,
+                              epoch=e, step_in_epoch=ft["step"])
         if self._keeper is not None:
             self._keeper.update(self.state, self._global_step)
 
@@ -678,6 +710,10 @@ class Trainer:
         print(f"=> divergence rollback at epoch {epoch} step "
               f"{step_in_epoch}: restored state from global step "
               f"{restored}, lr scale now {scale:g}", flush=True)
+        if self.flight is not None:
+            # The rollback itself is forensic: snapshot the ring (the
+            # `rollback` ft_event is already in it via attach_to_metrics).
+            self.flight.dump("rollback")
         return scale
 
     def _emit_ledgers(self, batch, lr_arr) -> None:
@@ -706,6 +742,10 @@ class Trainer:
         self._comm_fields = {}
         if ledger is not None:
             self._comm_fields.update(ledger.metrics_fields())
+            if ledger.entries:
+                top = max(ledger.entries, key=lambda e: e.wire_bytes)
+                self._flight_coll = {"kind": top.kind, "bytes": top.bytes,
+                                     "name": top.name}
             if self.ctx.process_index == 0:
                 comms.write_ledgers(cfg.comm_ledger, [ledger])
                 print(f"=> wrote comm ledger ({ledger.count} collectives, "
@@ -799,9 +839,24 @@ class Trainer:
                     or getattr(cfg, "mem_ledger", None))
                     and self._comm_fields is None):
                 self._emit_ledgers(batch, lr_arr)
+            if self.flight is not None:
+                # Ring: step window + collective region (labelled with the
+                # ledger's dominant entry when the AOT lowering ran) —
+                # two deque appends, no sync/I/O.
+                self.flight.step_begin(self._global_step)
+                fc = self._flight_coll or {}
+                self.flight.coll_enter(self._global_step,
+                                       kind=fc.get("kind"),
+                                       bytes=fc.get("bytes"),
+                                       name=fc.get("name"))
+            if self.chaos is not None:
+                self.chaos.on_collective(self, self._global_step)
             with scope("train_step"), self._wd_watch("train_step",
                                                      self._global_step):
                 self.state, metrics = self.train_step(self.state, batch, lr_arr)
+            if self.flight is not None:
+                self.flight.coll_exit(self._global_step)
+                self.flight.step_end(self._global_step)
             completed = i + 1
             # Unready device scalars: meters and the metrics logger convert
             # lazily, so no per-step host sync (SURVEY.md §7.4 item 1).
@@ -820,6 +875,10 @@ class Trainer:
                 self.hb.beat(self._global_step, step_time_ema=self.obs.ema,
                              last_ft=self.obs.last_event_kind,
                              mem_bytes=sample_process_memory())
+                if self.flight is not None:
+                    self.flight.heartbeat(
+                        {"step": self._global_step,
+                         "last_ft": self.obs.last_event_kind})
             self._global_step += 1
             meters.maybe_display(i, cfg.print_freq)
             at_save = (cfg.save_steps > 0 and completed % cfg.save_steps == 0
@@ -919,12 +978,43 @@ class Trainer:
                 signals=parse_signals(cfg.preempt_signals)).install()
         if self.watchdog is not None:
             self.watchdog.install()  # idempotent (re-fit after a fit)
+        # Flight recorder death paths: signal-dump chain (installed after
+        # the preemption guard so the dump happens first, then chains to
+        # it) + the collective-hang watchdog daemon.
+        flight_sig = None
+        if self.flight is not None:
+            if threading.current_thread() is threading.main_thread():
+                from pytorch_distributed_tpu.obs.flightrec import (
+                    FlightSignalDump,
+                )
+
+                flight_sig = FlightSignalDump(
+                    self.flight,
+                    signals=parse_signals(cfg.preempt_signals)).install()
+            if self._hang_wd is not None:
+                self._hang_wd.start()
         try:
             return self._fit_epochs()
+        except BaseException as e:
+            if self.flight is not None:
+                from pytorch_distributed_tpu.ft.integrity import (
+                    CheckpointCorruptError,
+                )
+
+                self.flight.record("exception", self._global_step,
+                                   error=type(e).__name__)
+                self.flight.dump("checkpoint_corrupt"
+                                 if isinstance(e, CheckpointCorruptError)
+                                 else f"exception:{type(e).__name__}")
+            raise
         finally:
             if installed:
                 self.preempt.uninstall()
                 self.preempt = None
+            if self._hang_wd is not None:
+                self._hang_wd.stop()
+            if flight_sig is not None:
+                flight_sig.uninstall()
             if self.watchdog is not None:
                 self.watchdog.uninstall()
             if self.hb is not None:

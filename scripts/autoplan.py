@@ -17,9 +17,16 @@ cross-checks the predictions against the real comm/memory ledgers
 (analysis/lowering.py) so an already-swept process pays zero extra
 compiles.
 
+``--overlap-from timeline.json`` closes the measurement loop: the
+backward-overlap fraction the scorer assumes (DEFAULT_OVERLAP, env
+PTD_PLAN_OVERLAP) is replaced by the overlap the profiler actually
+measured on this deployment (obs_timeline.py report), so re-planning
+after a calibration run scores comm-bound plans with real numbers.
+
 Usage:
   python scripts/autoplan.py lm --chips 32 --chip v5p
   python scripts/autoplan.py resnet50 --chips 4,8,32 --out plan.json
+  python scripts/autoplan.py lm --chips 32 --overlap-from timeline.json
   python scripts/autoplan.py lm-tiny --chips 4 --validate
   python scripts/autoplan.py --selftest       # resnet50 + LM at 4/8/32
 """
@@ -44,10 +51,31 @@ def _setup_mesh_backend() -> None:
     jax.config.update("jax_threefry_partitionable", True)
 
 
+def overlap_from_timeline(path: str) -> float:
+    """Measured backward-overlap fraction (0-1) from an obs_timeline.py
+    report: the mean of every capture's ``aggregate.overlap_pct_mean``.
+    Replaces the cost model's assumed ``DEFAULT_OVERLAP`` so plan scores
+    reflect how much collective time *this* deployment actually hides
+    under compute, instead of the literature constant."""
+    with open(path) as f:
+        doc = json.load(f)
+    vals = [c["aggregate"]["overlap_pct_mean"]
+            for c in (doc.get("captures") or [])
+            if c.get("aggregate", {}).get("steps")]
+    if not vals:
+        raise ValueError(
+            f"no step aggregates in '{path}' — expected an obs_timeline.py "
+            "report (captures[].aggregate.overlap_pct_mean)")
+    return min(1.0, max(0.0, sum(vals) / len(vals) / 100.0))
+
+
 def _render(payload) -> str:
     lines = [f"== {payload['model']} @ {payload['chips']} chips "
              f"({payload['hw']['name']}): {payload['feasible']} feasible / "
              f"{payload['enumerated']} enumerated =="]
+    if payload.get("overlap_source") == "measured":
+        lines.append(f"   overlap: {100.0 * payload['overlap']:.1f}% "
+                     "(measured from timeline)")
     for reason, n in sorted(payload["pruned"].items()):
         lines.append(f"   pruned {n:4d}  {reason}")
     lines.append(f"   {'#':>2} {'plan':<34} {'MFU%':>6} {'step_ms':>10} "
@@ -117,6 +145,10 @@ def main(argv=None) -> int:
     ap.add_argument("--top-k", type=int, default=5)
     ap.add_argument("--hbm-budget", type=float, default=None,
                     help="override the per-chip HBM byte budget")
+    ap.add_argument("--overlap-from", default=None, metavar="TIMELINE_JSON",
+                    help="replace the assumed backward-overlap fraction "
+                         "with the measured overlap_pct_mean from an "
+                         "obs_timeline.py report")
     ap.add_argument("--no-elastic", action="store_true",
                     help="skip pre-planning the shrunk elastic worlds")
     ap.add_argument("--validate", action="store_true",
@@ -142,13 +174,20 @@ def main(argv=None) -> int:
     if args.model not in MODELS:
         ap.error(f"unknown model {args.model!r}; known: {sorted(MODELS)}")
 
+    overlap = None
+    if args.overlap_from:
+        overlap = overlap_from_timeline(args.overlap_from)
+        print(f"measured overlap {100.0 * overlap:.1f}% from "
+              f"'{args.overlap_from}' (assumed default was 60%)")
+
     sweeps = []
     rc = 0
     for chips in [int(c) for c in args.chips.split(",") if c]:
         payload = autoplan(
             args.model, chips, chip=args.chip, top_k=args.top_k,
             elastic=not args.no_elastic, validate=args.validate,
-            validate_k=args.validate_k, hbm_budget=args.hbm_budget)
+            validate_k=args.validate_k, hbm_budget=args.hbm_budget,
+            overlap=overlap)
         sweeps.append(payload)
         if args.format == "table":
             print(_render(payload))

@@ -11,12 +11,14 @@ Commands:
   also 0 with a note when no sidecar exists — legacy file);
 - ``seal PATH``      write/refresh the sidecar for an existing file (adopt
   a pre-FT checkpoint into the verified world);
-- ``drill shrink|grow``  run an end-to-end elastic membership drill
-  (ISSUE 10) on a tiny synthetic LM: ``shrink`` loses a rank at a
-  seed-deterministic step and continues at world N−1; ``grow`` re-admits
-  it later and finishes back at world N.  Exit 0 iff every expected
-  ``remesh`` event was committed.  The only command that builds a mesh
-  (jax imported lazily inside it);
+- ``drill shrink|grow|hang``  run an end-to-end drill on a tiny
+  synthetic LM: ``shrink`` loses a rank at a seed-deterministic step and
+  continues at world N−1; ``grow`` re-admits it later and finishes back
+  at world N (exit 0 iff every expected ``remesh`` event was committed);
+  ``hang`` (ISSUE 13) stalls a rank inside the collective region and
+  passes iff the hang watchdog flags it, the flight recorder dumps
+  pre-mortem, and ``postmortem.py`` names the stalled rank.  The only
+  command that builds a mesh (jax imported lazily inside it);
 - ``--selftest``     the fast no-mesh CI path (tier-1, like
   ``shardlint.py --selftest`` / ``obs_report.py --selftest``): sidecar
   round-trip, flip/truncate detection, corruption determinism, retry
@@ -92,8 +94,6 @@ def drill_plan(seed: int, steps: int):
 def cmd_drill(args) -> int:
     """End-to-end elastic drill on the tiny synthetic LM (the only
     chaoskit command that touches devices; jax imported here, lazily)."""
-    import tempfile
-
     import jax
 
     from pytorch_distributed_tpu.ft import (
@@ -109,6 +109,8 @@ def cmd_drill(args) -> int:
         SyntheticTokenDataset,
     )
 
+    if args.kind == "hang":
+        return _drill_hang(args)
     world = args.world
     if world < 2 or world > len(jax.devices()):
         print(f"need 2 <= --world <= {len(jax.devices())} devices, "
@@ -143,6 +145,81 @@ def cmd_drill(args) -> int:
         print(f"FAIL: expected {want}")
         return 1
     print(f"drill {args.kind}: OK")
+    return 0
+
+
+def _drill_hang(args) -> int:
+    """Stalled-collective drill (ISSUE 13): ``HangAt`` stalls rank 0
+    inside the collective region for several watchdog windows; the hang
+    watchdog must emit a ``hang`` ft_event, dump the flight ring
+    pre-mortem, and ``postmortem.py`` must name the rank with its
+    last-entered collective."""
+    import tempfile
+
+    import jax
+
+    from pytorch_distributed_tpu.ft import ChaosSchedule
+    from pytorch_distributed_tpu.ft.chaos import HangAt
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.obs import flightrec
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.lm import (
+        LMTrainer,
+        SyntheticTokenDataset,
+    )
+
+    world = min(args.world, len(jax.devices()))
+    # reuse the seeded elastic plan: the lose step doubles as the stall
+    # step, so `--seed` drives every drill kind the same way
+    hang_step, _ = drill_plan(args.seed, args.steps)
+    timeout = args.hang_timeout
+    stall = max(4.0 * timeout, 0.5)  # several watchdog windows
+    out = args.out or tempfile.mkdtemp(prefix="hang-drill-")
+    print(f"drill hang: world {world}, stall rank 0 at step {hang_step} "
+          f"for {stall:.1f}s (watchdog timeout {timeout:.1f}s), dumps in "
+          f"'{out}'")
+
+    mesh = build_mesh(MeshSpec(("data",), (world,)),
+                      devices=jax.devices()[:world])
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(length=256, seq_len=16, vocab=64,
+                               seed=args.seed)
+    t = LMTrainer(model, mesh, ds, batch_size=world, lr=1e-2,
+                  seed=args.seed, prefetch=0, hb_dir=out,
+                  chaos=ChaosSchedule(HangAt(hang_step, stall, rank=0)),
+                  # the comm ledger labels the ring's collective region,
+                  # so the verdict can name the dominant collective
+                  comm_ledger=os.path.join(out, "comm_ledger.json"),
+                  flight_rec=out, hang_timeout=timeout)
+    loss = t.fit(args.steps, print_freq=max(1, args.steps // 4))
+
+    ok = True
+    if t._hang_wd is None or t._hang_wd.hangs < 1:
+        print("FAIL: hang watchdog never fired")
+        ok = False
+    dumps = flightrec.find_dumps(out)
+    if 0 not in dumps:
+        print(f"FAIL: no flight dump for rank 0 in '{out}'")
+        ok = False
+    if ok:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import postmortem as pm
+
+        report = pm.postmortem(out)
+        print(pm.render_text(report))
+        if report.get("hang_ranks") != [0]:
+            print(f"FAIL: expected hang_ranks [0], got "
+                  f"{report.get('hang_ranks')}")
+            ok = False
+        if "rank 0" not in (report.get("verdict") or ""):
+            print(f"FAIL: verdict does not name rank 0: "
+                  f"{report.get('verdict')!r}")
+            ok = False
+    if not ok:
+        return 1
+    print(f"final loss {loss:.4f}; hang flagged at step {hang_step}, "
+          f"{len(dumps)} rank dump(s)")
+    print("drill hang: OK")
     return 0
 
 
@@ -252,6 +329,20 @@ def _selftest() -> int:
         assert lose.fired and join.fired
         # a trainer without an elastic controller ignores the injection
         LoseRankAt(0, rank=0).on_step(object(), 0)
+
+        # 9. HangAt latches once, stalls only via the collective hook,
+        #    and only at its step — no jax needed with rank=None.
+        from pytorch_distributed_tpu.ft.chaos import HangAt
+
+        h = HangAt(3, seconds=0.0)
+        h.on_step(None, 3)          # wrong hook: must not fire
+        assert not h.fired
+        h.on_collective(None, 2)    # wrong step: must not fire
+        assert not h.fired
+        h.on_collective(None, 3)
+        assert h.fired, "HangAt must fire at its step"
+        h.on_collective(None, 3)    # latched: second visit is a no-op
+        assert h.fired
     print("chaoskit selftest: OK")
     return 0
 
@@ -274,14 +365,21 @@ def main(argv=None) -> int:
     s.add_argument("path")
     d = sub.add_parser("drill",
                        help="run an end-to-end elastic membership drill")
-    d.add_argument("kind", choices=("shrink", "grow"),
+    d.add_argument("kind", choices=("shrink", "grow", "hang"),
                    help="shrink: lose a rank and continue; grow: lose "
-                        "then re-admit it")
+                        "then re-admit it; hang: stall a rank inside a "
+                        "collective and let the watchdog catch it")
     d.add_argument("--world", type=int, default=4,
                    help="starting data-parallel world size")
     d.add_argument("--steps", type=int, default=12)
     d.add_argument("--seed", type=int, default=0,
                    help="drives the injection schedule (deterministic)")
+    d.add_argument("--hang-timeout", type=float, default=1.0,
+                   help="hang-drill watchdog timeout in seconds (the "
+                        "injected stall is 4x this)")
+    d.add_argument("--out", metavar="DIR", default=None,
+                   help="hang-drill flight-recorder dump dir (default: "
+                        "a fresh temp dir, printed)")
     args = ap.parse_args(argv)
     if args.selftest:
         return _selftest()

@@ -82,7 +82,38 @@ def cmd_status(args) -> int:
     joins = sorted(co.pending_joins())
     if joins:
         print(f"pending joins: {joins}")
+    _print_flight_dumps(args)
     return 1 if unhealthy else 0
+
+
+def _print_flight_dumps(args) -> None:
+    """Point the operator at fresh flight-recorder dumps (ISSUE 13):
+    when any rank died or hung with ``--flight-rec`` on, its ring dump
+    sits next to the heartbeats — surface it plus the one command that
+    merges them, instead of making the operator ls around."""
+    from pytorch_distributed_tpu.obs.flightrec import find_dumps
+
+    flight_dir = getattr(args, "flight_dir", None) or args.hb_dir
+    try:
+        dumps = find_dumps(flight_dir)
+    except OSError:
+        return
+    if not dumps:
+        return
+    print(f"flight-recorder dumps in '{flight_dir}':")
+    for r in sorted(dumps):
+        path = dumps[r]
+        reason, age = "?", "?"
+        try:
+            with open(path) as f:
+                reason = json.load(f).get("reason", "?")
+            age = f"{time.time() - os.path.getmtime(path):.0f}s ago"
+        except (OSError, ValueError):
+            pass
+        print(f"  rank {r}: {os.path.basename(path)} "
+              f"(reason={reason}, {age})")
+    print(f"merge them: python scripts/postmortem.py {flight_dir} "
+          f"--hb-dir {args.hb_dir}")
 
 
 def cmd_watch(args) -> int:
@@ -186,6 +217,11 @@ def _selftest() -> int:
         assert cmd_status(ns) == 0
         beat_file(3, time.time() - 3600.0)  # rank 3 goes dead
         assert cmd_status(ns) == 1
+        # a flight dump next to the beats is surfaced; the pointer path
+        # must survive the bare Namespace above (no flight_dir attr)
+        with open(os.path.join(hb, "flightrec_rank3.json"), "w") as f:
+            f.write(json.dumps({"rank": 3, "reason": "hang"}))
+        assert cmd_status(ns) == 1
         assert cmd_join(ns) == 0
         assert co.pending_joins() == {9}
     print("elastic_agent selftest: OK")
@@ -212,6 +248,10 @@ def main(argv=None) -> int:
 
     s = sub.add_parser("status", help="one-shot membership + liveness report")
     common(s)
+    s.add_argument("--flight-dir", default=None,
+                   help="where --flight-rec dumps land (default: the "
+                        "heartbeat dir); fresh dumps are surfaced with "
+                        "the postmortem merge command")
     w = sub.add_parser("watch", help="run the coordinator decision loop")
     common(w)
     w.add_argument("--interval", type=float, default=10.0,

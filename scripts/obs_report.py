@@ -8,7 +8,10 @@ Inputs (any subset):
 - ``--hb-dir``         per-process heartbeats from ``obs.HeartbeatWriter``
   (``--hb-dir``), with straggler flagging by step lag / beat age;
 - ``--telemetry-csv``  the 500 ms device-memory CSV from
-  ``utils.telemetry.TelemetrySampler`` (``--telemetry-csv``).
+  ``utils.telemetry.TelemetrySampler`` (``--telemetry-csv``);
+- ``--flight-dir``     flight-recorder ring dumps (``--flight-rec`` on
+  either trainer), folded in as the ``== postmortem ==`` cross-rank
+  root-cause section (scripts/postmortem.py).
 
 Output: step-time percentiles + throughput + MFU + loss/grad-norm
 trajectory, the goodput/badput ledger (ft_event + recompile records),
@@ -19,7 +22,9 @@ final line after a SIGKILL is the common case).
 ``--diff A B`` compares two metrics JSONL files — step-time p50/p95,
 throughput, MFU, goodput — and prints a thresholded PASS/REGRESS verdict
 per metric (exit code 1 on overall REGRESS): the perf-regression fence a
-CI job can gate on.
+CI job can gate on.  ``--strict`` additionally promotes the
+bench-staleness WARN (``--bench-max-stale-days``) from a note to a
+failing fence on both the report and the diff.
 
 ``--selftest`` synthesizes the artifacts in a temp dir, runs the report
 and both diff verdicts on them, and asserts the output — the fast tier-1
@@ -563,6 +568,30 @@ def summarize_heartbeats(hb_dir: str, now: Optional[float],
     return lines
 
 
+def _postmortem_mod():
+    """scripts/postmortem.py as a module (same dir as this file)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import postmortem
+
+    return postmortem
+
+
+def postmortem_section(flight_dir: str,
+                       hb_dir: Optional[str] = None) -> List[str]:
+    """The ``== postmortem ==`` fold (ISSUE 13): merge per-rank flight-
+    recorder dumps into the cross-rank root-cause report, clock-aligned
+    against the heartbeats when available."""
+    pm = _postmortem_mod()
+    try:
+        rep = pm.postmortem(flight_dir, hb_dir=hb_dir)
+    except Exception as e:  # a torn dump must not kill the report
+        return ["== postmortem ==", f"  (unreadable: {e})"]
+    if not rep.get("n_ranks"):
+        return ["== postmortem ==",
+                f"  (no flightrec_rank*.json in '{flight_dir}')"]
+    return pm.render_text(rep).splitlines()
+
+
 def report(args) -> str:
     sections = []
     records: List[dict] = []
@@ -596,6 +625,9 @@ def report(args) -> str:
         sections.append("== heartbeats ==")
         sections += summarize_heartbeats(args.hb_dir, args.now,
                                          args.max_step_lag, args.max_beat_age)
+    if getattr(args, "flight_dir", None):
+        sections += postmortem_section(args.flight_dir,
+                                       getattr(args, "hb_dir", None))
     if not sections:
         sections.append("nothing to report: pass --metrics-jsonl, "
                         "--hb-dir, and/or --telemetry-csv")
@@ -678,6 +710,12 @@ def report_json(args) -> Dict:
         member = read_membership(args.hb_dir)
         if member is not None:
             out["membership"] = member
+    if getattr(args, "flight_dir", None):
+        try:
+            out["postmortem"] = _postmortem_mod().postmortem(
+                args.flight_dir, hb_dir=getattr(args, "hb_dir", None))
+        except Exception as e:
+            out["postmortem"] = {"error": str(e)}
     return out
 
 
@@ -845,7 +883,8 @@ def plan_diff_rows(plan: Optional[Dict], a_records: List[dict],
 def run_diff(path_a: str, path_b: str, threshold_pct: float,
              goodput_threshold_pp: float, fmt: str = "text",
              staleness: Optional[Dict] = None,
-             plan: Optional[Dict] = None) -> int:
+             plan: Optional[Dict] = None,
+             strict: bool = False) -> int:
     a, mal_a = load_metrics(path_a)
     b, mal_b = load_metrics(path_b)
     kw = dict(threshold_pct=threshold_pct,
@@ -853,15 +892,19 @@ def run_diff(path_a: str, path_b: str, threshold_pct: float,
               label_a=os.path.basename(path_a),
               label_b=os.path.basename(path_b))
     plan_lines, plan_drift = plan_diff_rows(plan, a, b)
+    stale_fail = bool(strict and staleness is not None
+                      and staleness.get("warn"))
     if fmt == "json":
         d = diff_data(a, b, **kw)
         d["malformed_lines"] = {"a": mal_a, "b": mal_b}
         if staleness is not None:
             d["bench_staleness"] = staleness
+        if stale_fail:
+            d["stale_fence_failed"] = True
         if plan_drift:
             d["plan"] = plan_drift
         print(json.dumps(d, indent=2))
-        return 1 if d["regressed"] else 0
+        return 1 if (d["regressed"] or stale_fail) else 0
     text, regressed = diff_report(a, b, **kw)
     if plan_lines:
         # splice the drift row above the overall verdict line
@@ -870,13 +913,16 @@ def run_diff(path_a: str, path_b: str, threshold_pct: float,
     if mal_a or mal_b:
         text += f"\n(malformed lines: A {mal_a}, B {mal_b})"
     if staleness is not None and staleness.get("warn"):
-        # A note, never a verdict: a stale benchmark capture makes the
-        # comparison context-poor but does not make run B a regression.
-        text += (f"\nnote: benchmark baseline stale "
+        # By default a note, never a verdict: a stale benchmark capture
+        # makes the comparison context-poor but does not make run B a
+        # regression.  --strict promotes it to a failing fence (the CI
+        # posture: refuse to certify a diff against unrefreshed numbers).
+        kind = "STRICT" if strict else "note"
+        text += (f"\n{kind}: benchmark baseline stale "
                  f"{staleness['days_stale']:.1f} days "
                  f"(> {staleness['max_stale_days']:g}) — re-run bench.py")
     print(text)
-    return 1 if regressed else 0
+    return 1 if (regressed or stale_fail) else 0
 
 
 def _selftest() -> int:
@@ -1167,6 +1213,49 @@ def _selftest() -> int:
         assert "plan_mfu_drift" in drifted, drifted
         assert "not a fence" in drifted, drifted
         assert "overall: PASS" in drifted, drifted
+
+        # ---- --strict: the same stale capture IS a failure (ISSUE 13
+        # S4: the CI posture refuses to certify against old numbers) ----
+        buf3 = io.StringIO()
+        with contextlib.redirect_stdout(buf3):
+            rc3 = run_diff(fast, fast, 10.0, 5.0, staleness={
+                "warn": True, "days_stale": 20.0, "max_stale_days": 14.0},
+                strict=True)
+        strict_out = buf3.getvalue()
+        assert rc3 == 1, "selftest: --strict must fail a stale diff"
+        assert "STRICT: benchmark baseline stale" in strict_out, strict_out
+        # report path: same 20-day LKG, strict fails, default stays 0
+        buf3b = io.StringIO()
+        with contextlib.redirect_stdout(buf3b):
+            rc4 = main(["--metrics-jsonl", mpath, "--bench-lkg", bench_lkg,
+                        "--bench-events", bench_events, "--strict"])
+            rc5 = main(["--metrics-jsonl", mpath, "--bench-lkg", bench_lkg,
+                        "--bench-events", bench_events])
+        assert rc4 == 1, "selftest: strict report must fail on stale LKG"
+        assert rc5 == 0, "selftest: non-strict report must stay exit 0"
+
+        # ---- --flight-dir: the postmortem fold (ISSUE 13) ----
+        pm = _postmortem_mod()
+        fdir = os.path.join(d, "flight")
+        pm.make_fixture(fdir)
+        buf4 = io.StringIO()
+        with contextlib.redirect_stdout(buf4):
+            rc6 = main(["--flight-dir", fdir])
+        fold = buf4.getvalue()
+        assert rc6 == 0, fold
+        for needle in ("== postmortem ==", "stalled first", "hang"):
+            assert needle in fold, f"selftest: {needle!r} missing:\n{fold}"
+        js_f = report_json(argparse.Namespace(
+            metrics_jsonl=None, hb_dir=None, telemetry_csv=None,
+            flight_dir=fdir, now=now))
+        assert js_f["postmortem"]["n_ranks"] == 2, js_f
+        assert js_f["postmortem"]["stalled_rank"] == 1, js_f
+        json.dumps(js_f["postmortem"])
+        # an empty dir degrades to a note, never a crash
+        empty_f = os.path.join(d, "noflight")
+        os.makedirs(empty_f)
+        sec = postmortem_section(empty_f)
+        assert any("no flightrec_rank" in ln for ln in sec), sec
     print("obs_report selftest: OK")
     return 0
 
@@ -1210,9 +1299,19 @@ def main(argv=None) -> int:
                     "fine)")
     ap.add_argument("--bench-max-stale-days", type=float, default=14.0,
                     dest="bench_max_stale_days", metavar="DAYS",
-                    help="WARN in the bench section (and note in --diff, "
-                    "never a failure) when the last good benchmark capture "
-                    "is older than DAYS (default 14; 0 disables)")
+                    help="WARN in the bench section (and note in --diff) "
+                    "when the last good benchmark capture is older than "
+                    "DAYS (default 14; 0 disables); with --strict the "
+                    "WARN is a failing fence")
+    ap.add_argument("--strict", action="store_true",
+                    help="promote the bench-staleness WARN to a failure: "
+                    "exit 1 from the report and from --diff when the last "
+                    "good benchmark is older than --bench-max-stale-days")
+    ap.add_argument("--flight-dir", type=str, default=None,
+                    dest="flight_dir", metavar="DIR",
+                    help="directory with flight-recorder dumps "
+                    "(flightrec_rank*.json) to fold in as the "
+                    "'== postmortem ==' cross-rank root-cause section")
     ap.add_argument("--format", choices=("text", "json"), default="text",
                     help="output format; json emits every section (and "
                     "--diff verdicts) as one machine-readable object")
@@ -1244,11 +1343,20 @@ def main(argv=None) -> int:
         return run_diff(args.diff[0], args.diff[1], args.threshold_pct,
                         args.goodput_threshold_pp, fmt=args.format,
                         staleness=bench_staleness_info(args),
-                        plan=(load_plan(args.plan) if args.plan else None))
+                        plan=(load_plan(args.plan) if args.plan else None),
+                        strict=getattr(args, "strict", False))
     if args.format == "json":
         print(json.dumps(report_json(args), indent=2))
     else:
         print(report(args))
+    staleness = bench_staleness_info(args)
+    if (getattr(args, "strict", False) and staleness is not None
+            and staleness.get("warn")):
+        print(f"STRICT: benchmark baseline stale "
+              f"{staleness['days_stale']:.1f} days "
+              f"(> {staleness['max_stale_days']:g}) — failing",
+              file=sys.stderr)
+        return 1
     return 0
 
 

@@ -169,6 +169,55 @@ def test_rank_tiebreak_prefers_fewer_knobs():
     assert cost_mod.plan_complexity(ranked[0][0]) == 0
 
 
+# ------------------------------------------------ measured overlap fold
+
+def test_overlap_from_timeline_folds_into_scores(tmp_path):
+    """ISSUE 13 S2: ``--overlap-from`` replaces the assumed backward-
+    overlap fraction with the profiler's measured overlap_pct_mean, and
+    the fold is visible in the score — a lower measured overlap exposes
+    more comm, so no plan's predicted step gets faster."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    import autoplan as autoplan_cli
+
+    report = {"captures": [
+        {"file": "a.xplane.pb",
+         "aggregate": {"steps": 2, "overlap_pct_mean": 30.0}},
+        {"file": "b.xplane.pb",
+         "aggregate": {"steps": 2, "overlap_pct_mean": 50.0}},
+        {"file": "idle.xplane.pb", "aggregate": {"steps": 0}},  # skipped
+    ]}
+    path = tmp_path / "timeline.json"
+    path.write_text(json.dumps(report))
+    frac = autoplan_cli.overlap_from_timeline(str(path))
+    assert frac == pytest.approx(0.40)  # mean of the step-bearing captures
+
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"captures": []}))
+    with pytest.raises(ValueError):
+        autoplan_cli.overlap_from_timeline(str(empty))
+
+    assumed = planner.autoplan("lm", 32, chip="v5p", top_k=3,
+                               elastic=False)
+    measured = planner.autoplan("lm", 32, chip="v5p", top_k=3,
+                                elastic=False, overlap=frac)
+    assert assumed["overlap_source"] == "assumed"
+    assert assumed["overlap"] == cost_mod.DEFAULT_OVERLAP
+    assert measured["overlap_source"] == "measured"
+    assert measured["overlap"] == pytest.approx(frac)
+    by_key = {e["plan"]["key"]: e["predicted"]["step_time_ms"]
+              for e in assumed["ranked"]}
+    for e in measured["ranked"]:
+        if e["plan"]["key"] in by_key:
+            assert (e["predicted"]["step_time_ms"]
+                    >= by_key[e["plan"]["key"]] - 1e-9)
+
+    # CLI end to end: measured overlap flows through the sweep
+    assert autoplan_cli.main(["lm-tiny", "--chips", "4", "--no-elastic",
+                              "--overlap-from", str(path)]) == 0
+
+
 # ------------------------------------------------- rank stability table
 
 def test_rank_stability_against_checked_in_table():

@@ -115,3 +115,21 @@ def test_elastic_flags_parse_to_their_own_dests():
     assert (args.elastic, args.min_ranks, args.rescale_lr) == \
         (True, 2, "linear")
     assert args.precision == "bf16"  # the PR-9 symptom, pinned
+
+
+def test_flight_recorder_flags_parse_to_their_own_dests():
+    """ISSUE-13 flags: ``--flight-rec``/``--hang-timeout`` land in their
+    own dests on both surfaces, default to off/30 s, and collide with
+    nothing (the _lint tests above cover the collision half)."""
+    cfg = config_mod.parse_config(
+        ["--flight-rec", "/tmp/fr", "--hang-timeout", "5"])
+    assert (cfg.flight_rec, cfg.hang_timeout) == ("/tmp/fr", 5.0)
+    cfg = config_mod.parse_config([])
+    assert (cfg.flight_rec, cfg.hang_timeout) == (None, 30.0)
+    args = lm_pretrain.build_parser().parse_args(
+        ["--flight-rec", "/tmp/fr", "--hang-timeout", "2.5",
+         "--precision", "bf16"])
+    assert (args.flight_rec, args.hang_timeout) == ("/tmp/fr", 2.5)
+    assert args.precision == "bf16"
+    args = lm_pretrain.build_parser().parse_args([])
+    assert (args.flight_rec, args.hang_timeout) == (None, 30.0)

@@ -20,6 +20,9 @@ from pytorch_distributed_tpu.train.steps import make_eval_step, make_train_step
 
 
 def _setup(num_devices=8, image=32, classes=10, batch=16, seed=0):
+    # Private compile, deliberately NOT on the shared lowering sweep:
+    # resnet18 (BN) at 32x32 on the 8-way mesh has no recipe twin in
+    # analysis.core.RECIPES (the matrix is the BN-free TinyMLP at 4-way).
     mesh = build_mesh(MeshSpec(("data",), (num_devices,)), jax.devices()[:num_devices])
     model = models.create_model("resnet18", num_classes=classes)
     rng = jax.random.PRNGKey(seed)
@@ -72,6 +75,9 @@ class _MLP(__import__("flax").linen.Module):
 
 
 def _setup_mlp(num_devices=8, image=8, classes=10, batch=16, seed=0):
+    # Still needed where the assertion depends on a shape the recipe
+    # matrix doesn't carry (the padded-batch test re-steps at batch 8,
+    # which would force a second compile of the shared twin anyway).
     mesh = build_mesh(MeshSpec(("data",), (num_devices,)), jax.devices()[:num_devices])
     model = _MLP(classes=classes)
     variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, image, image, 3)))
@@ -85,17 +91,34 @@ def _setup_mlp(num_devices=8, image=8, classes=10, batch=16, seed=0):
     return mesh, model, state, batch_data
 
 
-def test_explicit_shard_map_matches_gspmd_without_bn():
-    """With no BatchNorm the two gradient-sync formulations must agree."""
-    mesh, model, state, batch = _setup_mlp()
-    step_g = make_train_step(model, mesh)
-    step_e = make_train_step(model, mesh, explicit_collectives=True)
-    sg, mg = step_g(state, batch, jnp.float32(0.1))
-    _, _, state2, _ = _setup_mlp()
-    se, me = step_e(state2, batch, jnp.float32(0.1))
+def test_explicit_shard_map_matches_gspmd_without_bn(get_lowering):
+    """With no BatchNorm the two gradient-sync formulations must agree.
+
+    Rides the session-shared lowering sweep (ISSUE 13 S3): the BN-free
+    recipe twins ``train_image_gspmd`` / ``train_image_explicit`` are
+    already compiled once per session for the shardlint/ledger fences,
+    so the semantics check re-executes those compiled steps on fresh
+    (undonated) states instead of paying two private compiles.  The
+    resnet18/BN tests below keep their private ``_setup`` compiles —
+    their model and 8-way mesh are not in the recipe matrix."""
+    from pytorch_distributed_tpu.analysis import core
+
+    low_g = get_lowering("train_image_gspmd")
+    low_e = get_lowering("train_image_explicit")
+    before = get_lowering.compile_count()
+    batch = core._image_batch()
+    sg, mg = low_g.jitted(core._image_state(core._tiny_image_model()),
+                          batch, jnp.float32(0.1))
+    se, me = low_e.jitted(
+        core._image_state(core._tiny_image_model(), explicit=True),
+        batch, jnp.float32(0.1))
     np.testing.assert_allclose(float(mg["loss"]), float(me["loss"]), rtol=1e-5)
     np.testing.assert_allclose(float(mg["acc1"]), float(me["acc1"]), atol=1e-5)
     _leaves_allclose(sg.params, se.params, rtol=1e-5)
+    # re-executing cached twins is free: zero new AOT compiles, and the
+    # process-wide sweep stays inside its budget
+    assert get_lowering.compile_count() == before
+    assert get_lowering.compile_count() <= get_lowering.compile_budget()
 
 
 def test_shard_map_bn_is_local_like_torch_ddp():
