@@ -325,11 +325,20 @@ class ElasticCoordinator:
     # -- decisions ----------------------------------------------------
     def decide(self, now: Optional[float] = None,
                beats: Optional[Dict[int, dict]] = None,
+               extra_dead: Optional[Set[int]] = None,
                ) -> Optional[MembershipChange]:
         """One coordination round → a committed ``MembershipChange`` or
         None.  ``beats`` is injectable for tests; by default the current
         epoch's heartbeats are read from ``hb_dir`` (older epochs are
-        stale incarnations and never count as live)."""
+        stale incarnations and never count as live).
+
+        ``extra_dead``: ranks an external observer already declared dead
+        — today the live alert plane (``obs/alerts.py`` ``dead_rank``
+        ft_events consumed by ``elastic_agent watch --alerts-from``).
+        They merge into the same eviction set this round computes from
+        heartbeats, so alert-driven eviction rides the one decision path
+        (floor check, epoch bump, commit) instead of growing a second
+        liveness policy."""
         from pytorch_distributed_tpu.obs.heartbeat import (
             find_stragglers,
             read_heartbeats,
@@ -343,6 +352,10 @@ class ElasticCoordinator:
             max_age_s=self.max_age_s,
             slow_ema_factor=self.slow_ema_factor)
         dead, _slow = split_liveness(flagged)
+        for r in (extra_dead or ()):
+            r = int(r)
+            dead.add(r)
+            flagged.setdefault(r, "alert: dead_rank ft_event")
         # A member with NO beat at the current epoch yet is in flight
         # (just re-meshed), not dead — only a stale beat marks death.
         leave = {r for r in cur.ranks if r in dead}

@@ -31,6 +31,14 @@ is three ``.item()`` calls per batch plus a 500 ms nvidia-smi CSV).
   profiler captures into per-stream spans, per-step comm/compute/overlap
   accounting (exposed-comm), heartbeat-based cross-rank clock alignment,
   and Chrome-trace/Perfetto export (``scripts/obs_timeline.py``).
+- ``export``    — the live plane, rank side: a stdlib HTTP exporter
+  serving the latest drained record as Prometheus text exposition on
+  ``--metrics-port`` (one daemon thread, zero hot-path syncs).
+- ``alerts``    — declarative alert rules over the same stream (step-time
+  / goodput / exposed-comm / memory ceilings, dead/slow rank, hang,
+  recompile anomaly, bench staleness), latched per episode and booked as
+  ``alert`` ft_events; ``scripts/obs_live.py`` is the fleet aggregator
+  (scrape every rank + heartbeats → dashboard, exit-1-on-alert for CI).
 
 ``scripts/obs_report.py`` folds a run's JSONL + heartbeats + telemetry CSV
 into one human-readable summary (``--format json`` for machines), and
@@ -71,6 +79,23 @@ from pytorch_distributed_tpu.obs.timeline import (
     parse_xspace,
     to_chrome_trace,
 )
+from pytorch_distributed_tpu.obs.alerts import (
+    Alert,
+    AlertEngine,
+    AlertRuleError,
+    Rule,
+    alerts_data,
+    dead_ranks_from_events,
+    default_rules,
+    evaluate_stream,
+    load_rules,
+    summarize_alerts,
+)
+from pytorch_distributed_tpu.obs.export import (
+    MetricsExporter,
+    parse_prometheus,
+    sample_value,
+)
 from pytorch_distributed_tpu.obs.flightrec import (
     FlightRecorder,
     FlightSignalDump,
@@ -84,6 +109,7 @@ from pytorch_distributed_tpu.obs.goodput import (
 from pytorch_distributed_tpu.obs.heartbeat import (
     HeartbeatWriter,
     find_stragglers,
+    fleet_rollup,
     read_heartbeats,
     sample_process_memory,
 )
@@ -148,4 +174,18 @@ __all__ = [
     "marry_ledger",
     "parse_xspace",
     "to_chrome_trace",
+    "fleet_rollup",
+    "Alert",
+    "AlertEngine",
+    "AlertRuleError",
+    "Rule",
+    "alerts_data",
+    "dead_ranks_from_events",
+    "default_rules",
+    "evaluate_stream",
+    "load_rules",
+    "summarize_alerts",
+    "MetricsExporter",
+    "parse_prometheus",
+    "sample_value",
 ]

@@ -1,0 +1,313 @@
+"""Per-rank live metric export: Prometheus text exposition over HTTP
+(ISSUE 14).
+
+One daemon thread per rank serves the **latest already-buffered**
+``MetricsLogger`` record plus heartbeat-class scalars on
+``--metrics-port`` (rank *k* binds ``port + k``).  Discipline matches
+the flight recorder: the training loop never does exporter work — the
+exporter is a flush-time sink (``exporter.update`` sees each drained
+record, a dict of host floats), and all rendering, socket I/O, and the
+process-memory sample happen on the scrape path inside the HTTP thread.
+Overhead is fenced <2% in ``RESULTS_obs_export.json`` with the same A/B
+methodology as ``RESULTS_flightrec.json``.
+
+Endpoints:
+
+- ``GET /metrics``  Prometheus text exposition (``ptd_`` prefix, every
+  gauge labelled with ``rank``);
+- ``GET /healthz``  ``ok`` + last-record age, 200/503.
+
+Stdlib-only and import-time jax-free: the fleet aggregator
+(``scripts/obs_live.py``) and the tests parse the same exposition via
+``parse_prometheus`` with no jax in the process.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+# record fields promoted to dedicated gauges; everything else numeric is
+# exported generically as ptd_metric{field="..."}
+_STAT_FIELDS = {
+    "step_time": "last",
+    "step_time_ema": "ema",
+    "step_time_p50": "p50",
+    "step_time_p95": "p95",
+    "step_time_max": "max",
+}
+_SKIP_FIELDS = {"step", "t", "process", "epoch"} | set(_STAT_FIELDS)
+
+
+def _heartbeat_mod():
+    """The sibling heartbeat module, without importing the top-level
+    package (whose ``__init__`` imports jax) into a jax-free process —
+    same discipline as ``obs/alerts.py``."""
+    import importlib
+    import importlib.util
+    import os
+    import sys
+
+    full = "pytorch_distributed_tpu.obs.heartbeat"
+    if full in sys.modules:
+        return sys.modules[full]
+    if "pytorch_distributed_tpu" in sys.modules:
+        return importlib.import_module(full)
+    alias = "_ptd_obs_heartbeat"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "heartbeat.py")
+    spec = importlib.util.spec_from_file_location(alias, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _esc(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _line(name: str, labels: Dict[str, Any], value: float) -> str:
+    lab = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{lab}}} {float(value):g}"
+
+
+class MetricsExporter:
+    """Serve the latest drained metrics record on an HTTP port.
+
+    Registered with ``MetricsLogger`` twice: once as an owned sink
+    (``start``/``stop`` → the logger starts it at ``register`` and stops
+    it at ``close``) and once via ``exporter.update`` as a per-record
+    step sink.  ``update`` only swaps a reference and bumps counters;
+    rendering happens at scrape time.
+    """
+
+    def __init__(self, port: int, host: str = "127.0.0.1", rank: int = 0,
+                 engine: Optional[Any] = None):
+        self.port = int(port)  # 0 → ephemeral; re-read after start()
+        self.host = host
+        self.rank = int(rank)
+        self.engine = engine  # optional AlertEngine: exposes firing gauges
+        self.running = False
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._record: Optional[dict] = None
+        self._record_at: float = 0.0
+        self._events: Dict[str, int] = {}
+        self._last_event: Optional[dict] = None
+        self._started_at: float = 0.0
+
+    # ------------------------------------------------------------ sink side
+    def update(self, record: dict) -> None:
+        """Flush-time step sink: remember the latest step record, count
+        ft_events by kind.  No I/O, no rendering."""
+        with self._lock:
+            if "ft_event" in record:
+                kind = str(record["ft_event"])
+                self._events[kind] = self._events.get(kind, 0) + 1
+                self._last_event = record
+            elif "step_time" in record:
+                self._record = record
+                self._record_at = time.time()
+
+    # --------------------------------------------------------- server side
+    def start(self) -> None:
+        if self.running:
+            return
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802 - silence per-request logs
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if self.path.split("?")[0] == "/metrics":
+                    body = exporter.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                elif self.path.split("?")[0] == "/healthz":
+                    age = exporter.record_age()
+                    ok = age is not None
+                    body = json.dumps(
+                        {"ok": ok, "rank": exporter.rank,
+                         "record_age_s": age}).encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "application/json")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"ptd-metrics-exporter-r{self.rank}", daemon=True)
+        self._thread.start()
+        self._started_at = time.time()
+        self.running = True
+
+    def stop(self) -> None:
+        if not self.running:
+            return
+        self.running = False
+        srv, self._server = self._server, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def record_age(self) -> Optional[float]:
+        with self._lock:
+            if self._record is None:
+                return None
+            return max(0.0, time.time() - self._record_at)
+
+    # ---------------------------------------------------------- exposition
+    def render(self) -> str:
+        """Prometheus text exposition of the latest record + counters.
+        Runs on the scrape thread only."""
+        with self._lock:
+            rec = dict(self._record) if self._record else None
+            rec_at = self._record_at
+            events = dict(self._events)
+        rank = {"rank": self.rank}
+        now = time.time()
+        lines = [
+            "# TYPE ptd_up gauge",
+            _line("ptd_up", rank, 1.0),
+            _line("ptd_uptime_seconds", rank,
+                  max(0.0, now - self._started_at)),
+        ]
+        try:
+            mem = _heartbeat_mod().sample_process_memory()
+            if mem is not None:
+                lines.append(_line("ptd_mem_rss_bytes", rank, float(mem)))
+        except Exception:
+            pass
+        if rec is not None:
+            lines.append("# TYPE ptd_step gauge")
+            lines.append(_line("ptd_step", rank,
+                               float(rec.get("step", -1))))
+            lines.append(_line("ptd_record_age_seconds", rank,
+                               max(0.0, now - rec_at)))
+            lines.append("# TYPE ptd_step_time_seconds gauge")
+            for field, stat in _STAT_FIELDS.items():
+                v = rec.get(field)
+                if isinstance(v, (int, float)):
+                    lines.append(_line("ptd_step_time_seconds",
+                                       dict(rank, stat=stat), float(v)))
+            for field in sorted(rec):
+                if field in _SKIP_FIELDS:
+                    continue
+                v = rec[field]
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                lines.append(_line("ptd_metric", dict(rank, field=field),
+                                   float(v)))
+        if events:
+            lines.append("# TYPE ptd_ft_events_total counter")
+            for kind in sorted(events):
+                lines.append(_line("ptd_ft_events_total",
+                                   dict(rank, kind=kind),
+                                   float(events[kind])))
+            lines.append(_line("ptd_alerts_total", rank,
+                               float(events.get("alert", 0))))
+        engine = self.engine
+        if engine is not None:
+            try:
+                for alert in engine.active():
+                    lines.append(_line(
+                        "ptd_alert_firing",
+                        dict(rank, rule=alert.name,
+                             severity=alert.severity), 1.0))
+            except Exception:
+                pass
+        return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------- scrape side
+
+def parse_prometheus(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse text exposition back into ``(name, labels, value)`` samples.
+    Handles exactly what ``render`` emits (and the common subset of the
+    format) — shared by ``obs_live`` and the tests."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            head, _, val = line.rpartition(" ")
+            labels: Dict[str, str] = {}
+            if "{" in head:
+                name, _, rest = head.partition("{")
+                body = rest.rsplit("}", 1)[0]
+                for part in _split_labels(body):
+                    k, _, v = part.partition("=")
+                    labels[k.strip()] = (
+                        v.strip().strip('"')
+                        .replace(r"\"", '"').replace(r"\n", "\n")
+                        .replace(r"\\", "\\"))
+            else:
+                name = head
+            out.append((name.strip(), labels, float(val)))
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def _split_labels(body: str) -> List[str]:
+    """Split ``a="x",b="y,z"`` on commas outside quotes."""
+    parts, cur, quoted, escape = [], [], False, False
+    for ch in body:
+        if escape:
+            cur.append(ch)
+            escape = False
+        elif ch == "\\":
+            cur.append(ch)
+            escape = True
+        elif ch == '"':
+            cur.append(ch)
+            quoted = not quoted
+        elif ch == "," and not quoted:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return [p for p in (s.strip() for s in parts) if p]
+
+
+def sample_value(samples: List[Tuple[str, Dict[str, str], float]],
+                 name: str, **labels: str) -> Optional[float]:
+    """First sample matching ``name`` whose labels include ``labels``."""
+    for n, lab, v in samples:
+        if n == name and all(lab.get(k) == str(w)
+                             for k, w in labels.items()):
+            return v
+    return None
+
+
+def scrape(url: str, timeout: float = 2.0
+           ) -> List[Tuple[str, Dict[str, str], float]]:
+    """GET one exporter endpoint and parse it (stdlib urllib)."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return parse_prometheus(resp.read().decode("utf-8", "replace"))

@@ -41,6 +41,10 @@ class GoodputReport:
     badput_s: Dict[str, float]
     counts: Dict[str, int]
     steps: int
+    # `alert` ft_events (obs/alerts.py) folded from the same stream.
+    # Alerts are a symptom channel, not a badput class — the wall time
+    # they describe is already booked by the kinds above.
+    alerts: int = 0
 
     @property
     def goodput_pct(self) -> float:
@@ -77,6 +81,7 @@ def compute_goodput(records: List[dict], stall_factor: float = 5.0,
                     key=lambda r: r.get("t", 0.0))
     badput = {k: 0.0 for k in BADPUT_KINDS}
     counts = {k: 0 for k in BADPUT_KINDS}
+    alerts = sum(1 for e in events if str(e["ft_event"]) == "alert")
 
     times = sorted(r["step_time"] for r in steps)
     median = _pct(times, 0.5)
@@ -158,7 +163,8 @@ def compute_goodput(records: List[dict], stall_factor: float = 5.0,
         # the first record's own step time happened before its timestamp
         wall = (last - first) + (steps[0].get("step_time", 0.0) if steps else 0.0)
     return GoodputReport(wall_s=wall, productive_s=max(0.0, productive),
-                         badput_s=badput, counts=counts, steps=len(steps))
+                         badput_s=badput, counts=counts, steps=len(steps),
+                         alerts=alerts)
 
 
 def summarize_goodput(records: List[dict]) -> List[str]:
@@ -179,6 +185,9 @@ def summarize_goodput(records: List[dict]) -> List[str]:
     if rep.untracked_s > 0.05 * rep.wall_s:
         lines.append(f"  untracked         {rep.untracked_s:.1f}s "
                      "(eval/ckpt/host overhead)")
+    if rep.alerts:
+        lines.append(f"  alerts fired      {rep.alerts} "
+                     "(see the alerts section)")
     return lines
 
 
@@ -209,5 +218,7 @@ class GoodputTracker:
                         if v > 0) or "none"
         tail = f" ({self._dropped} records past cap untracked)" \
             if self._dropped else ""
+        if rep.alerts:
+            tail += f"; {rep.alerts} alert(s) fired"
         return (f"goodput {rep.goodput_pct:.1f}% over {rep.wall_s:.1f}s "
                 f"({rep.steps} steps; badput: {bad}){tail}")

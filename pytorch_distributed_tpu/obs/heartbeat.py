@@ -196,6 +196,35 @@ def _median(vals):
     return s[len(s) // 2] if s else None
 
 
+def fleet_rollup(beats: Dict[int, dict],
+                 now: Optional[float] = None) -> Dict[str, object]:
+    """Aggregate one ``read_heartbeats`` snapshot into the fleet-level
+    scalars the live dashboard (``scripts/obs_live.py``) renders: rank
+    count, step front/back, oldest beat age, median step-time EMA, and
+    total sampled memory.  Empty snapshot → ``{}``."""
+    if not beats:
+        return {}
+    if now is None:
+        now = time.time()
+    steps = [int(b.get("step", 0)) for b in beats.values()]
+    ages = [max(0.0, now - float(b.get("t", now))) for b in beats.values()]
+    emas = [float(b["ema"]) for b in beats.values() if "ema" in b]
+    mems = [int(b["mem"]) for b in beats.values()
+            if b.get("mem") is not None]
+    return {
+        "ranks": len(beats),
+        "min_step": min(steps),
+        "max_step": max(steps),
+        "oldest_beat_age_s": max(ages),
+        "median_ema_s": _median(emas),
+        "total_mem_bytes": sum(mems) if mems else None,
+        "worlds": sorted({b["world"] for b in beats.values()
+                          if b.get("world") is not None}),
+        "epochs": sorted({int(b.get("epoch", 0))
+                          for b in beats.values()}),
+    }
+
+
 def find_stragglers(
     beats: Dict[int, dict],
     now: Optional[float] = None,

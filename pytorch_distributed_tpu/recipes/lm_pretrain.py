@@ -254,6 +254,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "max(SEC, 4×p95), emit a `hang` ft_event with the "
                         "last-entered collective, and dump the flight "
                         "ring pre-mortem (needs --flight-rec)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   dest="metrics_port", metavar="PORT",
+                   help="serve live Prometheus metrics on PORT + rank "
+                        "(obs/export.py; one daemon thread per rank, "
+                        "latest drained record; 0 disables; watch the "
+                        "fleet with scripts/obs_live.py)")
+    p.add_argument("--alerts", type=str, default=None, dest="alerts",
+                   metavar="RULES",
+                   help="declarative alert rules (obs/alerts.py): a JSON "
+                        "rules file or 'default' for the built-in set; "
+                        "firing alerts are booked as `alert` ft_events "
+                        "in the metrics JSONL and exported to /metrics")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -517,6 +529,8 @@ def main(argv=None) -> float:
             rescale_lr=args.rescale_lr,
             flight_rec=args.flight_rec,
             hang_timeout=args.hang_timeout,
+            metrics_port=args.metrics_port,
+            alerts=args.alerts,
         )
         try:
             final_loss = trainer.fit(args.steps, print_freq=args.print_freq)

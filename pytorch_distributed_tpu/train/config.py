@@ -156,6 +156,14 @@ class Config:
     # max(hang_timeout, 4×p95) triggers a `hang` ft_event + pre-mortem
     # ring dump.  Only active with flight_rec set.
     hang_timeout: float = 30.0
+    # Live telemetry plane (obs/export.py): serve the latest drained
+    # metrics record as Prometheus text exposition on this port (rank k
+    # binds metrics_port + k).  0 = off.  Scrape with scripts/obs_live.py.
+    metrics_port: int = 0
+    # Declarative alert rules (obs/alerts.py): a JSON rules file, or the
+    # literal "default" for the built-in anchor-free set.  Firing alerts
+    # are booked as `alert` ft_events in the metrics JSONL.
+    alerts: Optional[str] = None
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -383,6 +391,17 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "max(SEC, 4×p95 of completed steps), emit a `hang` "
                    "ft_event with the last-entered collective, and dump "
                    "the flight ring pre-mortem (needs --flight-rec)")
+    p.add_argument("--metrics-port", default=d.metrics_port, type=int,
+                   dest="metrics_port", metavar="PORT",
+                   help="serve live Prometheus metrics on PORT + rank "
+                   "(one daemon thread per rank, latest drained record; "
+                   "0 disables; watch the fleet with scripts/obs_live.py)")
+    p.add_argument("--alerts", default=d.alerts, type=str, dest="alerts",
+                   metavar="RULES",
+                   help="declarative alert rules: a JSON rules file or "
+                   "'default' for the built-in set (obs/alerts.py); "
+                   "firing alerts are booked as `alert` ft_events in the "
+                   "metrics JSONL and exported to /metrics")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

@@ -556,6 +556,8 @@ class LMTrainer:
         rescale_lr: str = "none",
         flight_rec: Optional[str] = None,
         hang_timeout: float = 30.0,
+        metrics_port: int = 0,
+        alerts: Optional[str] = None,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -728,6 +730,35 @@ class LMTrainer:
             self.flight.set_membership(dict(mesh.shape).get("data", 1),
                                        self._membership_epoch)
 
+        # ---- live telemetry plane (obs/export.py + obs/alerts.py) ----
+        # Both are flush-time sinks on the same logger — zero additions
+        # to the hot loop.  Rank k serves metrics_port + k; the exporter
+        # is an owned sink (started here, stopped at obs.close()).
+        self._exporter = None
+        if int(metrics_port or 0) > 0:
+            from pytorch_distributed_tpu.obs.export import MetricsExporter
+
+            self._exporter = MetricsExporter(
+                int(metrics_port) + jax.process_index(),
+                rank=jax.process_index())
+            self.obs.register(self._exporter)        # lifecycle
+            self.obs.register(self._exporter.update)  # per-record sink
+        self.alerts = None
+        if alerts:
+            from pytorch_distributed_tpu.obs.alerts import (
+                AlertEngine,
+                default_rules,
+                load_rules,
+            )
+
+            rules = (default_rules() if alerts == "default"
+                     else load_rules(alerts))
+            self.alerts = AlertEngine(rules, emit=self._emit_alert,
+                                      process_index=jax.process_index())
+            self.obs.register(self.alerts)
+            if self._exporter is not None:
+                self._exporter.engine = self.alerts  # ptd_alert_firing
+
         # ---- fault tolerance (ft/) ----
         self.save_steps = int(save_steps)
         self.chaos = chaos
@@ -792,6 +823,12 @@ class LMTrainer:
         self._span = None   # per-process row range: topology-keyed
         self._agree = None  # lazy PreemptionAgreement holds the old mesh
         self._comm_fields = None  # ledger re-emits against the new mesh
+
+    def _emit_alert(self, **fields) -> None:
+        """AlertEngine emit hook: book a firing as an ``alert`` ft_event
+        in the same JSONL, so goodput/postmortem/obs_report fold it (and
+        the flight ring records it via attach_to_metrics)."""
+        self.obs.log_event("alert", **fields)
 
     def _build_mfu(self) -> None:
         from pytorch_distributed_tpu.obs.flops import (
@@ -1095,6 +1132,10 @@ class LMTrainer:
 
         if self.watchdog is not None:
             self.watchdog.install()  # idempotent (re-fit after a fit)
+        if self._exporter is not None and not self._exporter.running:
+            # A prior fit's obs.close() stopped the owned exporter;
+            # re-register so this fit serves (and tears down) again.
+            self.obs.register(self._exporter)
 
         meters = StepMeters(
             steps,

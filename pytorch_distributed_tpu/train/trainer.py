@@ -333,6 +333,36 @@ class Trainer:
             # Every ft_event the metrics logger sees (skip/rollback/
             # preempt/remesh, incl. DivergenceGuard's) lands in the ring.
             attach_to_metrics(self.flight, self.obs)
+        # Live telemetry plane (obs/export.py + obs/alerts.py): the
+        # exporter and the rule engine are both flush-time sinks on the
+        # same logger — zero additions to the hot loop.  The exporter is
+        # an owned sink (started here, stopped at obs.close()); rank k
+        # serves metrics_port + k.
+        self._exporter = None
+        if int(getattr(cfg, "metrics_port", 0) or 0) > 0:
+            from pytorch_distributed_tpu.obs.export import MetricsExporter
+
+            self._exporter = MetricsExporter(
+                int(cfg.metrics_port) + self.ctx.process_index,
+                rank=self.ctx.process_index)
+            self.obs.register(self._exporter)        # lifecycle (start/stop)
+            self.obs.register(self._exporter.update)  # per-record sink
+        self.alerts = None
+        if getattr(cfg, "alerts", None):
+            from pytorch_distributed_tpu.obs.alerts import (
+                AlertEngine,
+                default_rules,
+                load_rules,
+            )
+
+            rules = (default_rules() if cfg.alerts == "default"
+                     else load_rules(cfg.alerts))
+            self.alerts = AlertEngine(
+                rules, emit=self._emit_alert,
+                process_index=self.ctx.process_index)
+            self.obs.register(self.alerts)
+            if self._exporter is not None:
+                self._exporter.engine = self.alerts  # ptd_alert_firing
         # Communication + memory ledgers (obs/comms.py, obs/memory.py):
         # emitted lazily on the first train batch (real shardings in
         # hand), opt-in because the AOT lowering does not share the jit
@@ -370,6 +400,12 @@ class Trainer:
             self.flight.set_membership(
                 dict(self.mesh.shape)[self.data_axis],
                 self._membership_epoch)
+
+    def _emit_alert(self, **fields) -> None:
+        """AlertEngine emit hook: book a firing as an ``alert`` ft_event
+        in the same JSONL, so goodput/postmortem/obs_report fold it (and
+        the flight ring records it via attach_to_metrics)."""
+        self.obs.log_event("alert", **fields)
 
     def _build_for_mesh(self, mesh: Mesh) -> None:
         """Build (or rebuild) every mesh-shape-dependent piece against
@@ -978,6 +1014,10 @@ class Trainer:
                 signals=parse_signals(cfg.preempt_signals)).install()
         if self.watchdog is not None:
             self.watchdog.install()  # idempotent (re-fit after a fit)
+        if self._exporter is not None and not self._exporter.running:
+            # A prior fit's obs.close() stopped the owned exporter;
+            # re-register so this fit serves (and tears down) again.
+            self.obs.register(self._exporter)
         # Flight recorder death paths: signal-dump chain (installed after
         # the preemption guard so the dump happens first, then chains to
         # it) + the collective-hang watchdog daemon.

@@ -11,14 +11,20 @@ Commands:
   also 0 with a note when no sidecar exists — legacy file);
 - ``seal PATH``      write/refresh the sidecar for an existing file (adopt
   a pre-FT checkpoint into the verified world);
-- ``drill shrink|grow|hang``  run an end-to-end drill on a tiny
+- ``drill shrink|grow|hang|alert``  run an end-to-end drill on a tiny
   synthetic LM: ``shrink`` loses a rank at a seed-deterministic step and
   continues at world N−1; ``grow`` re-admits it later and finishes back
   at world N (exit 0 iff every expected ``remesh`` event was committed);
   ``hang`` (ISSUE 13) stalls a rank inside the collective region and
   passes iff the hang watchdog flags it, the flight recorder dumps
-  pre-mortem, and ``postmortem.py`` names the stalled rank.  The only
-  command that builds a mesh (jax imported lazily inside it);
+  pre-mortem, and ``postmortem.py`` names the stalled rank; ``alert``
+  (ISSUE 14) injects a ``DelayRank`` slowdown under a step-time rule, a
+  silent phantom rank under a dead-rank rule, and a 20-day-stale bench
+  LKG under a staleness rule, and passes iff every one raises its
+  matching alert *live* (scraped off the rank's ``/metrics`` exporter or
+  booked by ``obs_live --once``) and lands as an ``alert`` ft_event that
+  goodput and ``obs_report`` fold.  The only commands that build a mesh
+  (jax imported lazily inside them);
 - ``--selftest``     the fast no-mesh CI path (tier-1, like
   ``shardlint.py --selftest`` / ``obs_report.py --selftest``): sidecar
   round-trip, flip/truncate detection, corruption determinism, retry
@@ -111,6 +117,8 @@ def cmd_drill(args) -> int:
 
     if args.kind == "hang":
         return _drill_hang(args)
+    if args.kind == "alert":
+        return _drill_alert(args)
     world = args.world
     if world < 2 or world > len(jax.devices()):
         print(f"need 2 <= --world <= {len(jax.devices())} devices, "
@@ -220,6 +228,190 @@ def _drill_hang(args) -> int:
     print(f"final loss {loss:.4f}; hang flagged at step {hang_step}, "
           f"{len(dumps)} rank dump(s)")
     print("drill hang: OK")
+    return 0
+
+
+def _drill_alert(args) -> int:
+    """Live telemetry-plane drill (ISSUE 14): three injected faults, each
+    of which must raise its matching declarative alert *while the run is
+    live*, not in a post-hoc report:
+
+    - ``DelayRank`` drags every step past a ``step_time_p95`` rule's
+      p50 ceiling → the alert must appear on the rank's ``/metrics``
+      exporter (``ptd_alert_firing``) mid-run and as an ``alert``
+      ft_event in the JSONL;
+    - a planted 20-day-stale ``BENCH_LKG.json`` under a ``bench_stale``
+      rule → booked by the trainer-side engine's lazy bench check;
+    - a phantom rank whose heartbeat went silent 120 s ago under a
+      ``dead_rank`` rule → a killed rank can never book its own death,
+      so ``obs_live --once`` must book it (exit 1) into the same JSONL.
+
+    Passes iff all three land and goodput + ``obs_report`` fold them.
+    """
+    import json as _json
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.request
+    from datetime import datetime, timedelta, timezone
+
+    import jax
+
+    from pytorch_distributed_tpu.ft import ChaosSchedule
+    from pytorch_distributed_tpu.ft.chaos import DelayRank
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.obs.alerts import (
+        dead_ranks_from_events,
+        summarize_alerts,
+    )
+    from pytorch_distributed_tpu.obs.export import parse_prometheus
+    from pytorch_distributed_tpu.obs.goodput import compute_goodput
+    from pytorch_distributed_tpu.obs.metrics import read_metrics
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.lm import (
+        LMTrainer,
+        SyntheticTokenDataset,
+    )
+
+    world = min(args.world, len(jax.devices()))
+    out = args.out or tempfile.mkdtemp(prefix="alert-drill-")
+    os.makedirs(out, exist_ok=True)
+
+    # fault 1 of 3: a benchmark LKG captured 20 days ago (events file
+    # deliberately absent so nothing can refresh it)
+    stamp = (datetime.now(timezone.utc)
+             - timedelta(days=20)).strftime("%Y-%m-%dT%H:%M:%S%z")
+    lkg = os.path.join(out, "BENCH_LKG.json")
+    with open(lkg, "w") as f:
+        _json.dump({"metric": "drill_tokens_per_s", "value": 1.0,
+                    "captured_at": stamp}, f)
+
+    delay = 0.15  # fault 2 of 3: DelayRank, lands in every measured step
+    rules_path = os.path.join(out, "rules.json")
+    with open(rules_path, "w") as f:
+        _json.dump({"rules": [
+            # p50 quantile + warmup: robust against the compile-step
+            # outlier; 60 ms ceiling vs a 150 ms injected floor
+            {"kind": "step_time_p95", "name": "step_time",
+             "severity": "warn", "quantile": "p50", "max_ms": 60.0,
+             "warmup_steps": 4},
+            {"kind": "dead_rank", "severity": "page", "max_age_s": 30.0},
+            {"kind": "bench_stale", "severity": "warn", "max_days": 14.0,
+             "lkg_path": lkg,
+             "events_path": os.path.join(out, "bench_events.jsonl")},
+        ]}, f, indent=2)
+
+    with socket.socket() as s:  # free localhost port for the exporter
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    mpath = os.path.join(out, "metrics.jsonl")
+    print(f"drill alert: world {world}, DelayRank({delay:.2f}s) vs 60ms "
+          f"p50 ceiling, exporter on :{port}, artifacts in '{out}'")
+
+    mesh = build_mesh(MeshSpec(("data",), (world,)),
+                      devices=jax.devices()[:world])
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(length=256, seq_len=16, vocab=64,
+                               seed=args.seed)
+    t = LMTrainer(model, mesh, ds, batch_size=world, lr=1e-2,
+                  seed=args.seed, prefetch=0, hb_dir=out,
+                  metrics_jsonl=mpath, metrics_port=port,
+                  alerts=rules_path,
+                  chaos=ChaosSchedule(DelayRank(delay)))
+    t.obs.flush_every = 1  # short run: sinks must see every step live
+
+    # scrape the rank-0 exporter concurrently with fit(): the step-time
+    # alert must be visible on /metrics while the run is still going
+    seen = {"firing": set(), "scrapes": 0}
+    stop = threading.Event()
+
+    def _scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1.0) as r:
+                    samples = parse_prometheus(
+                        r.read().decode("utf-8", "replace"))
+                seen["scrapes"] += 1
+                for name, lab, v in samples:
+                    if name == "ptd_alert_firing" and v:
+                        seen["firing"].add(lab.get("rule"))
+            except Exception:
+                pass
+            stop.wait(0.2)
+
+    th = threading.Thread(target=_scrape, daemon=True)
+    th.start()
+    loss = t.fit(args.steps, print_freq=max(1, args.steps // 4))
+    stop.set()
+    th.join(timeout=2.0)
+
+    ok = True
+    if "step_time" not in seen["firing"]:
+        print(f"FAIL: live scrape never saw ptd_alert_firing{{rule="
+              f"\"step_time\"}} ({seen['scrapes']} scrape(s), saw "
+              f"{sorted(seen['firing'])})")
+        ok = False
+    booked = {str(e.get("alert")) for e in read_metrics(mpath)
+              if e.get("ft_event") == "alert"}
+    for want in ("step_time", "bench_stale"):
+        if want not in booked:
+            print(f"FAIL: no '{want}' alert ft_event in '{mpath}' "
+                  f"(booked: {sorted(booked)})")
+            ok = False
+
+    # fault 3 of 3: a phantom rank that stopped beating 120 s ago — only
+    # the aggregator can book its death
+    phantom = world
+    with open(os.path.join(out, f"heartbeat-{phantom:05d}.jsonl"),
+              "w") as f:
+        f.write(_json.dumps({"pid": phantom, "step": 0,
+                             "t": _time.time() - 120.0,
+                             "world": world + 1}) + "\n")
+    live = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "obs_live.py")
+    proc = subprocess.run(
+        [sys.executable, live, "--hb-dir", out, "--rules", rules_path,
+         "--alerts-jsonl", mpath, "--once"],
+        capture_output=True, text=True)
+    print(proc.stdout, end="")
+    if proc.returncode != 1:
+        print(f"FAIL: obs_live --once exited {proc.returncode} (want 1 "
+              f"= alert firing); stderr: {proc.stderr.strip()}")
+        ok = False
+
+    records = read_metrics(mpath)
+    dead = dead_ranks_from_events(records)
+    if phantom not in dead:
+        print(f"FAIL: obs_live did not book a dead_rank alert for rank "
+              f"{phantom} (got {sorted(dead)})")
+        ok = False
+    gp = compute_goodput(records)
+    if gp.alerts < 3:
+        print(f"FAIL: goodput folded {gp.alerts} alert(s), want >= 3")
+        ok = False
+    summary = "\n".join(summarize_alerts(records))
+    if "== alerts ==" not in summary or "dead_rank" not in summary:
+        print(f"FAIL: alerts summary incomplete:\n{summary}")
+        ok = False
+    rep = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "obs_report.py"), "--metrics-jsonl", mpath],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if "== alerts ==" not in rep.stdout:
+        print(f"FAIL: obs_report did not fold the alerts section "
+              f"(rc {rep.returncode})")
+        ok = False
+    if not ok:
+        return 1
+    print(f"final loss {loss:.4f}; alerts live-scraped "
+          f"{sorted(seen['firing'])}, booked {sorted(booked | {'dead_rank'})}, "
+          f"goodput folded {gp.alerts}")
+    print("drill alert: OK")
     return 0
 
 
@@ -365,10 +557,12 @@ def main(argv=None) -> int:
     s.add_argument("path")
     d = sub.add_parser("drill",
                        help="run an end-to-end elastic membership drill")
-    d.add_argument("kind", choices=("shrink", "grow", "hang"),
+    d.add_argument("kind", choices=("shrink", "grow", "hang", "alert"),
                    help="shrink: lose a rank and continue; grow: lose "
                         "then re-admit it; hang: stall a rank inside a "
-                        "collective and let the watchdog catch it")
+                        "collective and let the watchdog catch it; "
+                        "alert: slow/dead/stale injections must each "
+                        "raise their matching live alert")
     d.add_argument("--world", type=int, default=4,
                    help="starting data-parallel world size")
     d.add_argument("--steps", type=int, default=12)

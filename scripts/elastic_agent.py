@@ -16,7 +16,10 @@ Commands:
   seconds run one ``decide()`` round — evict dead members, admit pending
   joins, commit the next epoch atomically.  ``--once`` for a single
   round (the cron idiom).  ``--min-ranks`` is the shrink floor below
-  which eviction is refused.
+  which eviction is refused.  ``--alerts-from metrics.jsonl``
+  additionally consumes ``dead_rank`` ``alert`` ft_events (the live
+  alert plane, obs/alerts.py) into the same eviction round — no second
+  liveness policy.
 - ``join --hb-dir D --rank R``  file an admission request for a
   restarted/new rank; the next ``decide()`` folds it in.
 - ``--selftest``          the fast no-mesh CI path (like
@@ -116,10 +119,40 @@ def _print_flight_dumps(args) -> None:
           f"--hb-dir {args.hb_dir}")
 
 
+def _alert_dead_ranks(path, since_t: float):
+    """Ranks declared dead by `alert` ft_events in a metrics JSONL that
+    are newer than ``since_t`` → {rank: newest event t}.  Tolerant of a
+    missing/partial file (the run may still be writing it)."""
+    from pytorch_distributed_tpu.obs.alerts import dead_ranks_from_events
+    from pytorch_distributed_tpu.obs.metrics import read_metrics
+
+    try:
+        records = read_metrics(path)
+    except (OSError, ValueError):
+        return {}
+    return dead_ranks_from_events(records, since_t=since_t)
+
+
 def cmd_watch(args) -> int:
     co = _coordinator(args)
+    # Alert-driven eviction (ISSUE 14): dead_rank alerts booked into the
+    # metrics JSONL by obs/alerts.py (trainer-side) or obs_live
+    # (aggregator-side) merge into the SAME decide() round the heartbeat
+    # evidence feeds — one liveness policy, one commit path.  Events are
+    # consumed once by timestamp so a re-admitted rank is not re-evicted
+    # by its own old alert.
+    alerts_from = getattr(args, "alerts_from", None)
+    seen_t = 0.0
     while True:
-        chg = co.decide()
+        extra_dead = None
+        if alerts_from:
+            flagged = _alert_dead_ranks(alerts_from, seen_t)
+            if flagged:
+                seen_t = max(seen_t, *flagged.values())
+                extra_dead = set(flagged)
+                print(f"alert-driven eviction candidates from "
+                      f"'{alerts_from}': {sorted(extra_dead)}", flush=True)
+        chg = co.decide(extra_dead=extra_dead)
         if chg is not None:
             print(f"epoch {chg.old.epoch} -> {chg.new.epoch} "
                   f"({chg.kind}): world {chg.old.world} -> "
@@ -224,6 +257,46 @@ def _selftest() -> int:
         assert cmd_status(ns) == 1
         assert cmd_join(ns) == 0
         assert co.pending_joins() == {9}
+
+        # 10. Alert-driven eviction (ISSUE 14): a dead_rank `alert`
+        #     ft_event routes into the SAME decide() path as heartbeat
+        #     evidence — here the beats are all fresh (the heartbeat
+        #     monitor alone would keep everyone), the alert evicts.
+        hb2 = os.path.join(d, "hb2")
+        co2 = ElasticCoordinator(hb2, world=4, min_ranks=2, max_age_s=5.0)
+        now = time.time()
+        fresh4 = {r: {"pid": r, "step": 20, "t": now, "epoch": 0}
+                  for r in range(4)}
+        chg3 = co2.decide(now=now, beats=fresh4, extra_dead={2})
+        assert chg3 is not None and chg3.kind == "shrink"
+        assert chg3.new.ranks == (0, 1, 3) and "alert" in chg3.reason
+
+        #     The floor still rules: alerts for 2 of the 3 survivors
+        #     would leave 1 < min_ranks — refused, epoch unmoved.
+        fresh3 = {r: {"pid": r, "step": 21, "t": now, "epoch": 1}
+                  for r in (0, 1, 3)}
+        assert co2.decide(now=now, beats=fresh3, extra_dead={1, 3}) is None
+        assert co2.membership().epoch == 1
+
+        #     CLI surface: `watch --once --alerts-from` reads the booked
+        #     event from a metrics JSONL and commits the eviction.
+        mpath = os.path.join(d, "metrics.jsonl")
+        with open(mpath, "w") as f:
+            f.write(json.dumps({"ft_event": "alert", "t": now,
+                                "alert": "dead_rank", "rule": "dead_rank",
+                                "severity": "page", "rank": 3,
+                                "detail": "rank 3: beat age 120s"}) + "\n")
+        for r in (0, 1, 3):
+            path = os.path.join(hb2, f"heartbeat-{r:05d}.jsonl")
+            with open(path, "w") as f:
+                f.write(json.dumps({"pid": r, "step": 22,
+                                    "t": time.time(), "epoch": 1}) + "\n")
+        ns2 = argparse.Namespace(hb_dir=hb2, world=4, min_ranks=2,
+                                 max_step_lag=3, max_age_s=5.0,
+                                 interval=0.0, once=True,
+                                 alerts_from=mpath)
+        assert cmd_watch(ns2) == 0
+        assert co2.membership().ranks == (0, 1)
     print("elastic_agent selftest: OK")
     return 0
 
@@ -258,6 +331,12 @@ def main(argv=None) -> int:
                    help="seconds between decide() rounds")
     w.add_argument("--once", action="store_true",
                    help="one decision round and exit (cron idiom)")
+    w.add_argument("--alerts-from", default=None, dest="alerts_from",
+                   metavar="JSONL",
+                   help="also consume `alert` ft_events from this metrics "
+                        "JSONL: dead_rank alerts (obs/alerts.py, booked "
+                        "by the trainer or obs_live) feed the same "
+                        "decide() eviction round as heartbeat evidence")
     j = sub.add_parser("join", help="file a join request for a rank")
     common(j)
     j.add_argument("--rank", type=int, required=True)

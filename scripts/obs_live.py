@@ -1,0 +1,480 @@
+#!/usr/bin/env python
+"""obs_live — fleet telemetry aggregator + live alert console (ISSUE 14).
+
+The read side of the live telemetry plane: every rank serves its latest
+metrics record on ``--metrics-port + rank`` (obs/export.py); this CLI
+scrapes those endpoints, tails the heartbeat dir, feeds both into the
+*same* declarative ``AlertEngine`` the trainers run (obs/alerts.py), and
+renders a terminal dashboard.  Runs on a login node with **no jax in the
+process** — the obs modules are loaded by file path, never through the
+package ``__init__`` (which imports jax for the shard_map bridge).
+
+Usage:
+
+    # watch a 4-rank local run (ports 9100..9103), 5 s cadence
+    obs_live.py --ports 9100 --world 4 --hb-dir /tmp/run/hb
+
+    # one aggregation cycle for cron/CI: exit 1 iff any alert is firing
+    obs_live.py --ports 9100 --world 4 --hb-dir /tmp/run/hb --once \\
+        --rules rules.json --alerts-jsonl /tmp/run/metrics.jsonl
+
+``--alerts-jsonl`` books each aggregator firing as an ``alert``
+ft_event into the shared JSONL (``process: -1`` marks the aggregator) —
+crucially ``dead_rank``, which a killed rank can never book for itself;
+``elastic_agent watch --alerts-from`` then routes it into the
+coordinator's one eviction path, and goodput/obs_report fold it like any
+other event.
+
+Default rules are ``alerts.default_rules()`` minus ``goodput_floor``:
+a sampled scrape sees only the newest record per interval, so a
+wall-span goodput estimate from scrapes would systematically undercount
+productive seconds (the trainer-side engine sees every drained record
+and owns that rule).
+
+``--selftest`` exercises exposition round-trip, rule parsing, the
+pseudo-record synthesis, alert booking, and the exit-code logic — no
+sockets beyond localhost, no jax (asserted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OBS = os.path.join(_REPO, "pytorch_distributed_tpu", "obs")
+
+
+def _load_obs(name: str):
+    """Load ``pytorch_distributed_tpu/obs/<name>.py`` by path under the
+    same ``_ptd_obs_<name>`` alias obs/alerts.py uses, so the sibling
+    modules share one instance and jax never enters the process."""
+    import importlib.util
+
+    full = f"pytorch_distributed_tpu.obs.{name}"
+    if full in sys.modules:
+        return sys.modules[full]
+    alias = f"_ptd_obs_{name}"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(
+        alias, os.path.join(_OBS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+alerts = _load_obs("alerts")
+export = _load_obs("export")
+heartbeat = _load_obs("heartbeat")
+metrics = _load_obs("metrics")
+
+
+# --------------------------------------------------------------- aggregation
+
+def aggregator_rules():
+    """``default_rules()`` minus ``goodput_floor`` (see module docstring:
+    sampled scrapes cannot estimate goodput honestly)."""
+    return [r for r in alerts.default_rules() if r.kind != "goodput_floor"]
+
+
+def endpoint_urls(args) -> list:
+    """``--endpoints`` verbatim, or ``--ports BASE --world N`` expanded to
+    ``http://HOST:BASE+k/metrics`` — the rank-k port convention both
+    trainers use for ``--metrics-port``."""
+    urls = []
+    for ep in (args.endpoints or "").split(","):
+        ep = ep.strip()
+        if not ep:
+            continue
+        if not ep.startswith("http"):
+            ep = f"http://{ep}"
+        if not ep.rstrip("/").endswith("/metrics"):
+            ep = ep.rstrip("/") + "/metrics"
+        urls.append(ep)
+    if args.ports is not None:
+        for k in range(max(1, args.world)):
+            urls.append(f"http://{args.host}:{args.ports + k}/metrics")
+    return urls
+
+
+def pseudo_record(samples, rank: int):
+    """Synthesize a metrics-record dict from one scrape so the aggregator
+    feeds the *same* ``AlertEngine.observe`` the trainers run: step-time
+    stats come back from ``ptd_step_time_seconds{stat=...}``, everything
+    else from the generic ``ptd_metric{field=...}`` gauges, and ``t`` is
+    reconstructed from the record-age gauge."""
+    rec = {"process": int(rank)}
+    step = export.sample_value(samples, "ptd_step", rank=rank)
+    if step is not None:
+        rec["step"] = int(step)
+    for field, stat in export._STAT_FIELDS.items():
+        v = export.sample_value(samples, "ptd_step_time_seconds",
+                                rank=rank, stat=stat)
+        if v is not None:
+            rec[field] = float(v)
+    for name, lab, v in samples:
+        if name == "ptd_metric" and lab.get("rank") == str(rank):
+            rec.setdefault(lab.get("field", "?"), float(v))
+    age = export.sample_value(samples, "ptd_record_age_seconds", rank=rank)
+    rec["t"] = time.time() - float(age or 0.0)
+    return rec if "step_time" in rec else None
+
+
+def scraped_rank(samples):
+    """The rank an exposition claims via ``ptd_up{rank=...}``."""
+    for name, lab, _v in samples:
+        if name == "ptd_up" and "rank" in lab:
+            try:
+                return int(lab["rank"])
+            except ValueError:
+                return None
+    return None
+
+
+class FleetMonitor:
+    """One aggregator: scrape endpoints + read heartbeats each cycle,
+    evaluate the shared rule set, optionally book firings as ``alert``
+    ft_events (``process: -1``), render the dashboard."""
+
+    def __init__(self, urls, hb_dir=None, rules=None, alerts_jsonl=None,
+                 timeout: float = 2.0):
+        self.urls = list(urls)
+        self.hb_dir = hb_dir
+        self.timeout = float(timeout)
+        self.booker = None
+        if alerts_jsonl:
+            self.booker = metrics.MetricsLogger(alerts_jsonl,
+                                                process_index=-1)
+        self.engine = alerts.AlertEngine(
+            rules if rules is not None else aggregator_rules(),
+            emit=self._book, process_index=-1)
+        self.rows = {}        # rank -> dashboard row dict
+        self.remote_firing = []   # scraped ptd_alert_firing samples
+        self.cycles = 0
+
+    def _book(self, **fields) -> None:
+        if self.booker is not None:
+            fields = dict(fields)
+            step = fields.pop("step", None)
+            self.booker.log_event("alert", step=step, **fields)
+
+    def close(self) -> None:
+        if self.booker is not None:
+            self.booker.close()
+
+    # ----------------------------------------------------------- one cycle
+    def cycle(self, now=None):
+        """Scrape + evaluate once; returns the alerts fired this cycle."""
+        now = time.time() if now is None else now
+        self.cycles += 1
+        fired = []
+        self.remote_firing = []
+        seen = set()
+        for i, url in enumerate(self.urls):
+            try:
+                samples = export.scrape(url, timeout=self.timeout)
+            except Exception as e:
+                self.rows[f"?{i}"] = {"rank": None, "url": url,
+                                      "state": "DOWN", "error": str(e)}
+                continue
+            rank = scraped_rank(samples)
+            rank = i if rank is None else rank
+            seen.add(rank)
+            self.rows.pop(f"?{i}", None)
+            rec = pseudo_record(samples, rank)
+            if rec is not None:
+                fired += self.engine.observe(rec)
+            for name, lab, _v in samples:
+                if name == "ptd_alert_firing":
+                    self.remote_firing.append((rank, lab.get("rule", "?"),
+                                               lab.get("severity", "warn")))
+            self.rows[rank] = {
+                "rank": rank, "url": url, "state": "UP",
+                "step": rec.get("step") if rec else None,
+                "p50_ms": (rec.get("step_time_p50", 0.0) * 1e3
+                           if rec else None),
+                "last_ms": (rec.get("step_time", 0.0) * 1e3
+                            if rec else None),
+                "throughput": rec.get("throughput") if rec else None,
+                "mfu": rec.get("mfu") if rec else None,
+                "mem_bytes": export.sample_value(samples,
+                                                 "ptd_mem_rss_bytes",
+                                                 rank=rank),
+                "rec_age_s": export.sample_value(
+                    samples, "ptd_record_age_seconds", rank=rank),
+                "alerts_total": export.sample_value(samples,
+                                                    "ptd_alerts_total",
+                                                    rank=rank),
+            }
+        beats = {}
+        if self.hb_dir:
+            beats = heartbeat.read_heartbeats(self.hb_dir)
+            fired += self.engine.observe_heartbeats(beats, now=now)
+            for pid, b in beats.items():
+                row = self.rows.setdefault(pid, {"rank": pid, "url": None,
+                                                 "state": "HB"})
+                row["beat_age_s"] = max(0.0, now - float(b.get("t", now)))
+                row.setdefault("step", b.get("step"))
+        self.beats = beats
+        return fired
+
+    def any_firing(self) -> bool:
+        return bool(self.engine.active() or self.remote_firing)
+
+    # ----------------------------------------------------------- rendering
+    def dashboard(self, now=None) -> str:
+        now = time.time() if now is None else now
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
+        lines = [f"== obs_live @ {stamp} ==  cycle {self.cycles}, "
+                 f"{len(self.urls)} endpoint(s)"]
+        roll = heartbeat.fleet_rollup(getattr(self, "beats", {}), now=now)
+        if roll:
+            mem = roll.get("total_mem_bytes")
+            lines.append(
+                f"fleet: {roll['ranks']} rank(s)  steps "
+                f"{roll['min_step']}..{roll['max_step']}  oldest beat "
+                f"{roll['oldest_beat_age_s']:.1f}s"
+                + (f"  median ema {roll['median_ema_s'] * 1e3:.1f}ms"
+                   if roll.get("median_ema_s") is not None else "")
+                + (f"  mem {mem / 2**20:.1f} MiB" if mem else ""))
+        lines.append(f"{'rank':>4}  {'state':<5}  {'step':>6}  "
+                     f"{'p50(ms)':>8}  {'tok/s':>8}  {'mfu':>5}  "
+                     f"{'mem(MiB)':>8}  {'rec-age':>7}  {'beat-age':>8}")
+
+        def _fmt(v, spec, dash="-"):
+            return format(v, spec) if isinstance(v, (int, float)) else dash
+
+        for key in sorted(self.rows, key=str):
+            r = self.rows[key]
+            lines.append(
+                f"{_fmt(r.get('rank'), 'd', '?'):>4}  {r['state']:<5}  "
+                f"{_fmt(r.get('step'), 'd'):>6}  "
+                f"{_fmt(r.get('p50_ms'), '.1f'):>8}  "
+                f"{_fmt(r.get('throughput'), '.0f'):>8}  "
+                f"{_fmt(r.get('mfu'), '.2f'):>5}  "
+                f"{_fmt((r.get('mem_bytes') or 0) / 2**20 if r.get('mem_bytes') else None, '.1f'):>8}  "
+                f"{_fmt(r.get('rec_age_s'), '.1f'):>7}  "
+                f"{_fmt(r.get('beat_age_s'), '.1f'):>8}")
+        active = self.engine.active()
+        if active:
+            lines.append("-- alerts firing (aggregator) --")
+            for a in sorted(active, key=lambda a: a.name):
+                where = f"  rank {a.rank}" if a.rank is not None else ""
+                lines.append(f"  {a.name:<16} [{a.severity}]{where}  "
+                             f"{a.detail}")
+        if self.remote_firing:
+            lines.append("-- alerts firing (rank-local) --")
+            for rank, rule, sev in sorted(set(self.remote_firing)):
+                lines.append(f"  {rule:<16} [{sev}]  rank {rank}")
+        if not active and not self.remote_firing:
+            lines.append("no alerts firing")
+        return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- CLI glue
+
+def build_rules(spec):
+    if spec in (None, "", "default"):
+        return aggregator_rules()
+    return alerts.load_rules(spec)
+
+
+def run(args) -> int:
+    urls = endpoint_urls(args)
+    if not urls and not args.hb_dir:
+        print("nothing to watch: pass --endpoints/--ports and/or --hb-dir",
+              file=sys.stderr)
+        return 2
+    mon = FleetMonitor(urls, hb_dir=args.hb_dir,
+                       rules=build_rules(args.rules),
+                       alerts_jsonl=args.alerts_jsonl,
+                       timeout=args.timeout)
+    try:
+        while True:
+            fired = mon.cycle()
+            print(mon.dashboard(), flush=True)
+            for a in fired:
+                print(f"ALERT {a.name} [{a.severity}]: {a.detail}",
+                      flush=True)
+            if args.once:
+                return 1 if mon.any_firing() else 0
+            print("", flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 130
+    finally:
+        mon.close()
+
+
+# ------------------------------------------------------------------ selftest
+
+def _selftest() -> int:
+    """Socket-light, jax-free: exposition round-trip against a real
+    exporter on an ephemeral port, pseudo-record synthesis, rule
+    loading, alert booking, dashboard needles, exit-code logic."""
+    import tempfile
+    import urllib.request
+
+    assert "jax" not in sys.modules, \
+        "obs_live must never import jax (login-node aggregator)"
+
+    with tempfile.TemporaryDirectory() as d:
+        # 1. Live exposition round-trip: exporter on port 0, scraped over
+        #    real HTTP, pseudo-record rebuilt from the samples.
+        exp = export.MetricsExporter(0, rank=3)
+        exp.update({"step": 41, "t": time.time(), "process": 3,
+                    "step_time": 0.020, "step_time_ema": 0.021,
+                    "step_time_p50": 0.019, "step_time_p95": 0.028,
+                    "step_time_max": 0.030, "throughput": 51200.0,
+                    "loss": 2.5})
+        exp.update({"ft_event": "alert", "t": time.time(), "process": 3,
+                    "alert": "x", "rule": "hang", "severity": "page"})
+        exp.start()
+        try:
+            url = f"http://127.0.0.1:{exp.port}/metrics"
+            samples = export.scrape(url)
+            assert export.sample_value(samples, "ptd_up", rank=3) == 1.0
+            rec = pseudo_record(samples, 3)
+            assert rec is not None and rec["step"] == 41
+            assert abs(rec["step_time_p50"] - 0.019) < 1e-9
+            assert abs(rec["throughput"] - 51200.0) < 1e-6
+            assert scraped_rank(samples) == 3
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{exp.port}/healthz") as r:
+                assert json.loads(r.read())["ok"] is True
+        finally:
+            exp.stop()
+
+        # 2. Rules: default aggregator set drops goodput_floor; a rules
+        #    file round-trips; a malformed one raises AlertRuleError.
+        kinds = {r.kind for r in aggregator_rules()}
+        assert "goodput_floor" not in kinds and "dead_rank" in kinds
+        rp = os.path.join(d, "rules.json")
+        with open(rp, "w") as f:
+            json.dump({"rules": [
+                {"kind": "dead_rank", "severity": "page",
+                 "max_age_s": 2.0},
+                {"kind": "step_time_p95", "max_ms": 15.0,
+                 "quantile": "p50"}]}, f)
+        loaded = build_rules(rp)
+        assert [r.kind for r in loaded] == ["dead_rank", "step_time_p95"]
+        bad = os.path.join(d, "bad.json")
+        with open(bad, "w") as f:
+            json.dump({"rules": [{"kind": "nope"}]}, f)
+        try:
+            build_rules(bad)
+        except alerts.AlertRuleError as e:
+            assert "nope" in str(e)
+        else:
+            raise AssertionError("malformed rules must raise")
+
+        # 3. Heartbeat leg: a fresh rank plus a stale one → dead_rank
+        #    fires, is booked to the JSONL (process -1), the dashboard
+        #    names it, and --once semantics exit 1.
+        hb = os.path.join(d, "hb")
+        os.makedirs(hb)
+        now = time.time()
+        for pid, t in ((0, now), (1, now - 120.0)):
+            with open(os.path.join(hb, f"heartbeat-{pid:05d}.jsonl"),
+                      "w") as f:
+                f.write(json.dumps({"pid": pid, "step": 10, "t": t,
+                                    "world": 2, "ema": 0.02}) + "\n")
+        booked = os.path.join(d, "metrics.jsonl")
+        mon = FleetMonitor([], hb_dir=hb,
+                           rules=[alerts.Rule("dead_rank", "dead_rank",
+                                              "page",
+                                              {"max_age_s": 60.0})],
+                           alerts_jsonl=booked)
+        fired = mon.cycle(now=now)
+        assert [a.rank for a in fired] == [1], fired
+        assert mon.any_firing()
+        dash = mon.dashboard(now=now)
+        for needle in ("== obs_live @", "fleet: 2 rank(s)", "dead_rank",
+                       "[page]", "rank 1", "beat age 120.0s"):
+            assert needle in dash, f"dashboard missing {needle!r}\n{dash}"
+        # second cycle: latched, no re-fire, still firing
+        assert mon.cycle(now=now) == []
+        assert mon.any_firing()
+        mon.close()
+        recs = metrics.read_metrics(booked)
+        assert len(recs) == 1 and recs[0]["ft_event"] == "alert"
+        assert recs[0]["process"] == -1 and recs[0]["rank"] == 1
+        dead = alerts.dead_ranks_from_events(recs)
+        assert list(dead) == [1], \
+            "booked alert must round-trip into elastic_agent's eviction feed"
+
+        # 4. Recovery clears the latch → exit code flips back to 0.
+        with open(os.path.join(hb, "heartbeat-00001.jsonl"), "w") as f:
+            f.write(json.dumps({"pid": 1, "step": 11, "t": now,
+                                "world": 2, "ema": 0.02}) + "\n")
+        mon2 = FleetMonitor([], hb_dir=hb,
+                            rules=[alerts.Rule("dead_rank", "dead_rank",
+                                               "page",
+                                               {"max_age_s": 60.0})])
+        assert mon2.cycle(now=now) == [] and not mon2.any_firing()
+        assert "no alerts firing" in mon2.dashboard(now=now)
+
+        # 5. DOWN endpoint: scrape failure renders, doesn't raise.
+        mon3 = FleetMonitor(["http://127.0.0.1:9/metrics"], timeout=0.2)
+        mon3.cycle()
+        assert "DOWN" in mon3.dashboard()
+
+        # 6. Endpoint expansion: --ports + --world, and bare host:port.
+        ns = argparse.Namespace(endpoints="10.0.0.5:9100", ports=9200,
+                                world=2, host="127.0.0.1")
+        assert endpoint_urls(ns) == [
+            "http://10.0.0.5:9100/metrics",
+            "http://127.0.0.1:9200/metrics",
+            "http://127.0.0.1:9201/metrics"]
+
+    assert "jax" not in sys.modules
+    print("obs_live selftest: OK")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fleet telemetry aggregator: scrape per-rank metric "
+                    "exporters, tail heartbeats, evaluate alert rules")
+    ap.add_argument("--endpoints", default=None,
+                    help="comma-separated exporter endpoints "
+                         "(host:port or full /metrics URLs)")
+    ap.add_argument("--ports", type=int, default=None, metavar="BASE",
+                    help="scrape http://HOST:BASE+k/metrics for "
+                         "k in [0, --world)")
+    ap.add_argument("--world", type=int, default=1,
+                    help="rank count for --ports expansion")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="host for --ports expansion")
+    ap.add_argument("--hb-dir", default=None, dest="hb_dir",
+                    help="heartbeat dir (dead/slow-rank rules + fleet "
+                         "rollup)")
+    ap.add_argument("--rules", default=None, metavar="RULES",
+                    help="alert rules JSON, or 'default' (default set "
+                         "minus goodput_floor)")
+    ap.add_argument("--alerts-jsonl", default=None, dest="alerts_jsonl",
+                    metavar="PATH",
+                    help="book aggregator firings as alert ft_events "
+                         "into this metrics JSONL (process -1)")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="seconds between aggregation cycles")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-endpoint scrape timeout")
+    ap.add_argument("--once", action="store_true",
+                    help="one cycle for cron/CI: exit 1 iff any alert "
+                         "is firing")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the jax-free aggregator checks")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -602,9 +602,11 @@ def report(args) -> str:
             [r for r in records
              if "ft_event" not in r and "bench_event" not in r], malformed)
         sections += summarize_ft_events(records)
+        from pytorch_distributed_tpu.obs.alerts import summarize_alerts
         from pytorch_distributed_tpu.obs.goodput import summarize_goodput
 
         sections += summarize_goodput(records)
+        sections += summarize_alerts(records)
         sections += summarize_comms(records, getattr(args, "comm_ledger", None),
                                     getattr(args, "comm_predicted", None))
         sections += summarize_memory(records,
@@ -664,8 +666,11 @@ def report_json(args) -> Dict:
             "wall_s": gp.wall_s, "productive_s": gp.productive_s,
             "badput_s": dict(gp.badput_s), "counts": dict(gp.counts),
             "steps": gp.steps, "goodput_pct": gp.goodput_pct,
-            "untracked_s": gp.untracked_s,
+            "untracked_s": gp.untracked_s, "alerts": gp.alerts,
         }
+        from pytorch_distributed_tpu.obs.alerts import alerts_data
+
+        out["alerts"] = alerts_data(records)
         out["bench"] = [r for r in records if "bench_event" in r]
         comms = comm_stats(records)
         comms["residual_pct"] = _comm_residual(
@@ -744,6 +749,7 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         "comm_wire_bytes": cs["comm_wire_bytes"],
         "exposed_comm_ms": cs["exposed_comm_ms"],
         "peak_hbm_bytes": cs["peak_hbm_bytes"],
+        "alerts": float(gp.alerts) if gp.steps else None,
     }
 
 
@@ -767,6 +773,10 @@ _DIFF_METRICS = (
     ("exposed_comm_ms", True, False),
     ("comm_wire_bytes", True, False),
     ("peak_hbm_bytes", True, False),
+    # `alert` ft_event count (obs/alerts.py): absolute delta — any NEW
+    # alert in the candidate regresses (threshold 0.5 below), and a
+    # clean baseline (0 alerts) must not divide-by-zero.
+    ("alerts", True, True),
 )
 
 
@@ -792,8 +802,9 @@ def diff_data(a_records: List[dict], b_records: List[dict],
         elif absolute_pp:
             delta = vb - va
             row["delta_pp"] = delta
-            worse = (delta > goodput_threshold_pp if lower_better
-                     else -delta > goodput_threshold_pp)
+            # alerts: any new firing is a regression, not a ±5pp band
+            thr = 0.5 if name == "alerts" else goodput_threshold_pp
+            worse = (delta > thr if lower_better else -delta > thr)
             row["verdict"] = "REGRESS" if worse else "PASS"
             regressed = regressed or worse
         elif va == 0:
@@ -840,8 +851,12 @@ def diff_report(a_records: List[dict], b_records: List[dict],
                          f"{'--':>9}  (zero baseline)")
             continue
         if "delta_pp" in row:
-            dtxt = f"{row['delta_pp']:+.1f}pp"
-            fa, fb = f"{va:.1f}%", f"{vb:.1f}%"
+            if name == "alerts":  # a count, not a percentage
+                dtxt = f"{row['delta_pp']:+.0f}"
+                fa, fb = f"{va:.0f}", f"{vb:.0f}"
+            else:
+                dtxt = f"{row['delta_pp']:+.1f}pp"
+                fa, fb = f"{va:.1f}%", f"{vb:.1f}%"
         else:
             dtxt = f"{row['delta_pct']:+.1f}%"
             if name.startswith("step_time"):
@@ -958,6 +973,16 @@ def _selftest() -> int:
             log.log_event("remesh", step=12, change="shrink", old_world=4,
                           new_world=3, epoch=1, reason="drill")
             log.log_event("preempt", step=19)
+            # live alert plane (obs/alerts.py): firings booked as
+            # `alert` ft_events fold into their own report section
+            log.log_event("alert", step=15, alert="step_time_p95",
+                          rule="step_time_p95", severity="warn",
+                          value=22.0, threshold=15.0, rank=0,
+                          detail="step time p95 22.0ms > 15ms")
+            log.log_event("alert", step=18, alert="dead_rank",
+                          rule="dead_rank", severity="page", rank=1,
+                          detail="rank 1: beat age 120.0s > 60s "
+                                 "(dead or hung)")
         with open(mpath, "a") as f:
             # torn tail (a killed writer) + a bench staleness event
             f.write(json.dumps({
@@ -1058,6 +1083,10 @@ def _selftest() -> int:
                        "lr scale          0.5 after 1 rollback",
                        "== goodput ==", "goodput", "badput/nan_skip",
                        "badput/rollback_discard", "badput/remesh",
+                       "alerts fired      2",
+                       "== alerts ==", "step_time_p95", "[warn]",
+                       "dead_rank", "[page]", "ranks 1",
+                       "step time p95 22.0ms > 15ms",
                        "membership epoch 1: world 3 ranks [0, 1, 2]",
                        "epoch 1",
                        "== comms ==", "per-step payload  66952 B",
@@ -1086,8 +1115,13 @@ def _selftest() -> int:
         js = report_json(ns)
         for key in ("steps", "ft_events", "goodput", "bench", "comms",
                     "memory", "bench_staleness", "devices", "heartbeats",
-                    "plan"):
+                    "plan", "alerts"):
             assert key in js, f"selftest: {key!r} missing from json: {js}"
+        assert js["alerts"]["total"] == 2, js["alerts"]
+        assert js["alerts"]["by_name"]["dead_rank"]["severity"] == "page"
+        assert js["alerts"]["by_name"]["step_time_p95"]["steps"] == [15]
+        assert js["goodput"]["alerts"] == 2, js["goodput"]
+        assert js["steps"]["alerts"] == 2.0, js["steps"]
         assert js["plan"]["key"] == "c4/dp4", js["plan"]
         assert js["plan"]["predicted_mfu_pct"] > 0, js["plan"]
         assert js["plan"]["mfu_drift_pct"] is not None, js["plan"]
@@ -1189,6 +1223,33 @@ def _selftest() -> int:
         dr = diff_data(n_recs, m_recs)
         by_rev = {r["metric"]: r for r in dr["metrics"]}
         assert by_rev["peak_hbm_bytes"]["verdict"] == "PASS", dr
+
+        # ---- planted alert regression: identical timings, but the
+        # candidate run fired an alert -> only the alerts row REGRESSes
+        # (any new firing fails the fence; counts render as counts)
+        alerted = os.path.join(d, "alerted.jsonl")
+        with MetricsLogger(alerted, flush_every=50) as log:
+            for i in range(30):
+                log.log_step(i, step_time=0.010, n_items=128, lr=0.1,
+                             extra={"mfu": 40.0, "hfu": 44.0})
+            log.log_event("alert", step=25, alert="goodput_floor",
+                          rule="goodput_floor", severity="warn",
+                          detail="goodput estimate 41% < 50%")
+        al_recs, _ = load_metrics(alerted)
+        text5, regressed5 = diff_report(a_recs, al_recs)
+        assert regressed5, (
+            f"selftest: a new alert must REGRESS the diff:\n{text5}")
+        al_row = [ln for ln in text5.splitlines()
+                  if ln.strip().startswith("alerts")]
+        assert al_row and "REGRESS" in al_row[0], text5
+        assert "+1" in al_row[0] and "pp" not in al_row[0], al_row
+        da = diff_data(a_recs, al_recs)
+        assert {r["metric"]: r for r in da["metrics"]}[
+            "alerts"]["verdict"] == "REGRESS", da
+        # reverse (alerts cleared in the candidate) passes the row
+        dr_a = diff_data(al_recs, a_recs)
+        assert {r["metric"]: r for r in dr_a["metrics"]}[
+            "alerts"]["verdict"] == "PASS", dr_a
 
         # ---- bench staleness in --diff: a note, never a failure ----
         import contextlib

@@ -133,3 +133,20 @@ def test_flight_recorder_flags_parse_to_their_own_dests():
     assert args.precision == "bf16"
     args = lm_pretrain.build_parser().parse_args([])
     assert (args.flight_rec, args.hang_timeout) == (None, 30.0)
+
+
+def test_telemetry_plane_flags_parse_to_their_own_dests():
+    """ISSUE-14 flags: ``--metrics-port``/``--alerts`` land in their own
+    dests on both surfaces, default to off, and collide with nothing."""
+    cfg = config_mod.parse_config(
+        ["--metrics-port", "9100", "--alerts", "/tmp/rules.json"])
+    assert (cfg.metrics_port, cfg.alerts) == (9100, "/tmp/rules.json")
+    cfg = config_mod.parse_config([])
+    assert (cfg.metrics_port, cfg.alerts) == (0, None)
+    args = lm_pretrain.build_parser().parse_args(
+        ["--metrics-port", "9100", "--alerts", "default",
+         "--precision", "bf16"])
+    assert (args.metrics_port, args.alerts) == (9100, "default")
+    assert args.precision == "bf16"
+    args = lm_pretrain.build_parser().parse_args([])
+    assert (args.metrics_port, args.alerts) == (0, None)
