@@ -658,6 +658,40 @@ def _recipe_decode():
     return run, (params, prompt, jax.random.PRNGKey(0)), None, None
 
 
+def _recipe_serve(phase: str):
+    """The serving engine's jitted steps (serving/engine.py), at the
+    engine's own tiny reference shapes.  ``_make_steps`` is lru-cached,
+    so these lowerings ARE the callables a same-config engine runs — the
+    recipe sweep, shardlint, and the ledgers fence serving traffic with
+    no second trace.  No donation (pools thread through like the decode
+    cache); a 1-device data mesh so the baseline sweep books the entry.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.serving.engine import _make_steps
+    from pytorch_distributed_tpu.serving.kvpool import init_pools
+
+    B, NB, BS, W, C = 2, 8, 4, 4, 8
+    steps = _make_steps(_LM["vocab"], _LM["d_model"], _LM["n_heads"], 1,
+                        BS, 0.0, 0, 1.0, "")
+    pk, pv = init_pools(1, NB, BS, _LM["n_heads"],
+                        _LM["d_model"] // _LM["n_heads"])
+    table1 = jnp.zeros((1, W), jnp.int32)
+    params = steps.model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32), pk, pv,
+        table1, jnp.zeros((1, 1), jnp.int32))["params"]
+    key = jax.random.PRNGKey(0)
+    mesh = _mesh(("data",), (1,))
+    if phase == "prefill":
+        args = (params, pk, pv, jnp.zeros((1, C), jnp.int32),
+                jnp.int32(0), jnp.int32(C), table1, key)
+        return steps.prefill, args, None, mesh
+    args = (params, pk, pv, jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), jnp.int32), jnp.zeros((B, W), jnp.int32), key)
+    return steps.decode, args, None, mesh
+
+
 # Every jitted step builder in the framework, as zero-arg constructors
 # returning (jitted, example_args, donate_argnums-or-None, mesh-or-None).
 RECIPES: "OrderedDict[str, Callable[[], tuple]]" = OrderedDict([
@@ -683,6 +717,8 @@ RECIPES: "OrderedDict[str, Callable[[], tuple]]" = OrderedDict([
     ("lm_pp_1f1b", lambda: _recipe_pipeline("1f1b")),
     ("lm_pp_interleaved", lambda: _recipe_pipeline("interleaved")),
     ("decode_greedy", _recipe_decode),
+    ("serve_prefill", lambda: _recipe_serve("prefill")),
+    ("serve_decode", lambda: _recipe_serve("decode")),
 ])
 
 

@@ -27,7 +27,12 @@ Rule kinds (anchors in parentheses):
   ``max_events`` (obs/watchdog.py);
 - ``bench_stale``     days since the last good benchmark capture beyond
   ``max_days`` (scripts/benchlib.py ``bench_staleness``) — the live twin
-  of the ``obs_report --strict`` fence.
+  of the ``obs_report --strict`` fence;
+- ``ttft_p99``        serving time-to-first-token p99 above ``max_ms``
+  (the serving engine's ``ttft_p99_ms`` SLO field, serving/engine.py);
+- ``kv_occupancy``    paged KV pool occupancy above ``max_pct`` — the
+  early-warning fence before the pool exhausts and preemption starts
+  (serving/kvpool.py ``kv_occupancy_pct``).
 
 Firing alerts are **booked as ``alert`` ft_events** into the same JSONL
 through the engine's ``emit`` callback (the trainers wire it to
@@ -71,11 +76,13 @@ _RULE_SPECS: Dict[str, tuple] = {
     "hang": (set(), set()),
     "recompile": (set(), {"max_events"}),
     "bench_stale": ({"max_days"}, {"lkg_path", "events_path"}),
+    "ttft_p99": ({"max_ms"}, set()),
+    "kv_occupancy": ({"max_pct"}, set()),
 }
 RULE_KINDS = tuple(sorted(_RULE_SPECS))
 
 _STEP_RULE_KINDS = ("step_time_p95", "goodput_floor", "exposed_comm",
-                    "mem_peak")
+                    "mem_peak", "ttft_p99", "kv_occupancy")
 
 
 class AlertRuleError(ValueError):
@@ -434,6 +441,34 @@ class AlertEngine:
                     rule, key=key, step=step, value=float(v), threshold=cap,
                     rank=proc,
                     detail=f"exposed comm {float(v):.3f}ms > {cap:g}ms")
+            else:
+                self._clear(key)
+
+        for rule in self._by_kind.get("ttft_p99", ()):
+            v = rec.get("ttft_p99_ms")
+            if v is None:
+                continue
+            cap = float(rule.params["max_ms"])
+            key = (rule.name, proc)
+            if float(v) > cap:
+                fired += self._fire(
+                    rule, key=key, step=step, value=float(v), threshold=cap,
+                    rank=proc,
+                    detail=f"TTFT p99 {float(v):.1f}ms > {cap:g}ms")
+            else:
+                self._clear(key)
+
+        for rule in self._by_kind.get("kv_occupancy", ()):
+            v = rec.get("kv_occupancy_pct")
+            if v is None:
+                continue
+            cap = float(rule.params["max_pct"])
+            key = (rule.name, proc)
+            if float(v) > cap:
+                fired += self._fire(
+                    rule, key=key, step=step, value=float(v), threshold=cap,
+                    rank=proc,
+                    detail=f"KV occupancy {float(v):.1f}% > {cap:g}%")
             else:
                 self._clear(key)
 

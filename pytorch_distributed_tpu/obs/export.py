@@ -39,7 +39,25 @@ _STAT_FIELDS = {
     "step_time_p95": "p95",
     "step_time_max": "max",
 }
-_SKIP_FIELDS = {"step", "t", "process", "epoch"} | set(_STAT_FIELDS)
+# serving SLO fields (serving/engine.py) promoted to ptd_serving_*
+# gauges so dashboards get stable names instead of ptd_metric{field=...}
+_SERVING_FIELDS = {
+    "ttft_p50_ms": ("ptd_serving_ttft_ms", {"quantile": "p50"}),
+    "ttft_p95_ms": ("ptd_serving_ttft_ms", {"quantile": "p95"}),
+    "ttft_p99_ms": ("ptd_serving_ttft_ms", {"quantile": "p99"}),
+    "itl_p50_ms": ("ptd_serving_itl_ms", {"quantile": "p50"}),
+    "itl_p95_ms": ("ptd_serving_itl_ms", {"quantile": "p95"}),
+    "itl_p99_ms": ("ptd_serving_itl_ms", {"quantile": "p99"}),
+    "queue_depth": ("ptd_serving_queue_depth", {}),
+    "active_seqs": ("ptd_serving_active_seqs", {}),
+    "kv_occupancy_pct": ("ptd_serving_kv_occupancy_pct", {}),
+    "kv_frag_pct": ("ptd_serving_kv_frag_pct", {}),
+    "preemptions": ("ptd_serving_preemptions_total", {}),
+    "requests_completed": ("ptd_serving_requests_completed_total", {}),
+    "tokens_per_s": ("ptd_serving_tokens_per_second", {}),
+}
+_SKIP_FIELDS = ({"step", "t", "process", "epoch"} | set(_STAT_FIELDS)
+                | set(_SERVING_FIELDS))
 
 
 def _heartbeat_mod():
@@ -211,6 +229,12 @@ class MetricsExporter:
                 if isinstance(v, (int, float)):
                     lines.append(_line("ptd_step_time_seconds",
                                        dict(rank, stat=stat), float(v)))
+            for field, (name, extra_labels) in sorted(
+                    _SERVING_FIELDS.items()):
+                v = rec.get(field)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    lines.append(_line(name, dict(rank, **extra_labels),
+                                       float(v)))
             for field in sorted(rec):
                 if field in _SKIP_FIELDS:
                     continue

@@ -1,0 +1,9 @@
+"""Continuous-batching LM serving (ISSUE 15).
+
+The serving twin of the training stack: a paged KV cache (kvpool),
+admission/preemption scheduling (scheduler), the jitted step loop
+(engine), and a seeded synthetic load harness (loadgen), fronted by
+``scripts/serve_lm.py``.  Import submodules directly — this package
+stays import-time light so host-side pieces (scheduler, loadgen) load
+without jax.
+"""

@@ -1,0 +1,520 @@
+"""Continuous-batching serving loop over the paged KV cache.
+
+One jitted decode step per engine iteration, always at the full
+``[max_batch]`` static shape: sequences join and leave the batch by
+flipping slots and block-table rows, never by changing tensor shapes, so
+the step compiles exactly once (the zero-recompile soak test pins this
+with obs/watchdog.py).  The only host sync per decode iteration is the
+single ``np.asarray`` pull of the sampled tokens.
+
+Layers underneath compose transparently: int8 weight-only quant rides
+the ``quant="int8"`` model variant (models/quant.py), and greedy
+speculative decoding (``gamma > 0``) runs gamma+1 draft micro-steps plus
+one target verification inside a single jitted round — rejection
+correction keeps target-greedy outputs regardless of draft quality
+(models/speculative.py semantics, re-derived over paged state).
+
+``_make_steps`` is the shared lowering surface: the engine jits what it
+returns, and analysis/core.py registers the same builders as the
+``serve_prefill`` / ``serve_decode`` recipes so shardlint, the
+comm/memory ledgers, and the compile budget all see serving traffic.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import time
+import types
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from pytorch_distributed_tpu.obs.metrics import _percentile
+from pytorch_distributed_tpu.serving.kvpool import (
+    BlockPool,
+    apply_permutation,
+    init_pools,
+)
+from pytorch_distributed_tpu.serving.loadgen import LoadConfig, generate_load
+from pytorch_distributed_tpu.serving.scheduler import Request, Scheduler
+
+MODES = ("continuous", "static")
+
+
+def _pct_ms(samples, q: float) -> Optional[float]:
+    """Nearest-rank percentile of a seconds-sample deque, in ms."""
+    if not samples:
+        return None
+    return _percentile(sorted(samples), q) * 1e3
+
+
+@functools.lru_cache(maxsize=8)
+def _make_steps(vocab_size: int, d_model: int, n_heads: int, n_layers: int,
+                block_size: int, temperature: float, top_k: int,
+                top_p: float, quant: str):
+    """Model + jitted prefill/decode step functions for one model config.
+
+    lru_cached so the engine, the A/B experiment, and the analysis
+    recipes all lower the SAME jitted callables — one compile per
+    (config, shape) across the whole process, and the recipe lowerings
+    in analysis/core.py are literally the functions the engine runs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.generate import filter_logits
+    from pytorch_distributed_tpu.serving.model import PagedTransformerLM
+
+    model = PagedTransformerLM(
+        vocab_size=vocab_size, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, block_size=block_size, quant=quant)
+
+    def _pick(logits, key):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, filter_logits(logits, temperature, top_k, top_p)
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def decode_step(params, pk, pv, tokens, offsets, table, key):
+        """tokens [B] fed at positions ``offsets`` -> next token [B]."""
+        pos = offsets[:, None].astype(jnp.int32)
+        logits, pk, pv = model.apply(
+            {"params": params}, tokens[:, None], pk, pv, table, pos)
+        return _pick(logits[:, -1, :], key), pk, pv
+
+    @jax.jit
+    def prefill_step(params, pk, pv, tokens, start, n_valid, table, key):
+        """One prompt chunk ``tokens [1, C]`` at absolute positions
+        ``start..start+C-1``; only the first ``n_valid`` lanes carry real
+        prompt (padding writes land past the committed window and are
+        overwritten before any mask exposes them).  Returns the seed
+        token sampled at the last valid position."""
+        C = tokens.shape[1]
+        pos = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
+        logits, pk, pv = model.apply(
+            {"params": params}, tokens, pk, pv, table, pos)
+        last = jax.lax.dynamic_slice(
+            logits, (0, n_valid - 1, 0), (1, 1, logits.shape[-1]))
+        return _pick(last[:, -1, :], key)[0], pk, pv
+
+    return types.SimpleNamespace(
+        model=model, decode=decode_step, prefill=prefill_step)
+
+
+def _make_spec_round(tsteps, dsteps, gamma: int):
+    """One jitted greedy speculative round over paged state.
+
+    gamma+1 draft micro-steps (the last feed exists only to commit the
+    final draft token's KV), one target verification over
+    ``[t_last, d_1..d_gamma]``, and in-jit acceptance: ``out[b, j]`` is
+    the j-th committed token, ``-1`` past the accepted-plus-correction
+    prefix — so the host pulls ONE array per round.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def spec_round(tp, dp, pk_t, pv_t, pk_d, pv_d, t_last, offsets, table):
+        toks = [t_last]
+        cur = t_last
+        offs = offsets.astype(jnp.int32)
+        for i in range(gamma):
+            pos = (offs + i)[:, None]
+            logits, pk_d, pv_d = dsteps.model.apply(
+                {"params": dp}, cur[:, None], pk_d, pv_d, table, pos)
+            cur = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            toks.append(cur)
+        # extra feed: writes KV(d_gamma); its sampled output is discarded
+        pos = (offs + gamma)[:, None]
+        _, pk_d, pv_d = dsteps.model.apply(
+            {"params": dp}, cur[:, None], pk_d, pv_d, table, pos)
+        ver = jnp.stack(toks, axis=1)                       # [B, gamma+1]
+        L = gamma + 1
+        pos = offs[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]
+        logits, pk_t, pv_t = tsteps.model.apply(
+            {"params": tp}, ver, pk_t, pv_t, table, pos)
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # target greedy
+        p = ver[:, 1:]                                      # draft proposals
+        eq = (g[:, :L - 1] == p).astype(jnp.int32)
+        n_acc = jnp.cumprod(eq, axis=1).sum(axis=1)         # [B]
+        corr = jnp.take_along_axis(g, n_acc[:, None], axis=1)
+        j = jnp.arange(L, dtype=jnp.int32)[None, :]
+        pfull = jnp.pad(p, ((0, 0), (0, 1)))
+        out = jnp.where(j < n_acc[:, None], pfull,
+                        jnp.where(j == n_acc[:, None], corr, -1))
+        return out, pk_t, pv_t, pk_d, pv_d
+
+    return spec_round
+
+
+class ServingEngine:
+    """Continuous-batching engine: paged KV + scheduler + jitted steps.
+
+    ``mode="static"`` is the naive wave-batching baseline the A/B
+    experiment measures against: a new wave is admitted only once every
+    slot has drained, so short sequences idle behind the longest one.
+    """
+
+    def __init__(self, params, *, vocab_size: int, d_model: int,
+                 n_heads: int, n_layers: int,
+                 max_batch: int = 4, kv_blocks: int = 64,
+                 block_size: int = 16, blocks_per_seq: int = 8,
+                 chunk_size: int = 8, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, quant: str = "",
+                 gamma: int = 0, draft_params=None,
+                 policy: str = "fcfs", mode: str = "continuous",
+                 defrag_threshold_pct: float = 50.0,
+                 obs=None, watchdog=None, chaos=None,
+                 stream: Optional[Callable[[int, int, str], None]] = None,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        if mode not in MODES:
+            raise ValueError(f"unknown mode {mode!r}; expected {MODES}")
+        if gamma > 0 and temperature > 0:
+            raise ValueError("speculative serving is greedy-only: "
+                             "gamma > 0 requires temperature <= 0")
+        if gamma > 0 and draft_params is None:
+            raise ValueError("gamma > 0 requires draft_params")
+        self.params = params
+        self.mode = mode
+        self.gamma = int(gamma)
+        self.max_batch = int(max_batch)
+        self.block_size = int(block_size)
+        self.chunk_size = int(chunk_size)
+        self.max_new_tokens = int(max_new_tokens)
+        self.defrag_threshold_pct = float(defrag_threshold_pct)
+        self.obs = obs
+        self.watchdog = watchdog
+        self.chaos = chaos
+        self.stream = stream
+        self._time_fn = time_fn
+        self._sleep_fn = sleep_fn
+        self._jnp = jnp
+        self._key = jax.random.PRNGKey(seed)
+
+        self.steps = _make_steps(vocab_size, d_model, n_heads, n_layers,
+                                 block_size, float(temperature), int(top_k),
+                                 float(top_p), quant)
+        self.pool = BlockPool(kv_blocks, block_size, blocks_per_seq)
+        head_dim = d_model // n_heads
+        self.pk, self.pv = init_pools(
+            n_layers, kv_blocks, block_size, n_heads, head_dim)
+        self.sched = Scheduler(max_batch, policy=policy)
+
+        self._spec_round = None
+        if self.gamma > 0:
+            d_layers = sum(1 for k in draft_params if k.startswith("block_"))
+            d_model_d = draft_params["embed"]["embedding"].shape[1]
+            self.draft_params = draft_params
+            self.dsteps = _make_steps(
+                vocab_size, int(d_model_d), n_heads, d_layers, block_size,
+                float(temperature), int(top_k), float(top_p), "")
+            self.dpk, self.dpv = init_pools(
+                d_layers, kv_blocks, block_size, n_heads,
+                int(d_model_d) // n_heads)
+            self._spec_round = _make_spec_round(
+                self.steps, self.dsteps, self.gamma)
+
+        # per-slot device-batch state (host mirrors)
+        self._offsets = np.zeros(self.max_batch, np.int32)
+        self._last = np.zeros(self.max_batch, np.int32)
+        self._last_emit = [0.0] * self.max_batch
+
+        # SLO samples + counters
+        self.ttft_samples: deque = deque(maxlen=512)
+        self.itl_samples: deque = deque(maxlen=2048)
+        self.total_tokens = 0
+        self.finished: List[Request] = []
+        self._step = 0
+        self._t0: Optional[float] = None
+
+    # ------------------------------------------------------------------ time
+    def _now(self) -> float:
+        if self._t0 is None:
+            self._t0 = self._time_fn()
+        return self._time_fn() - self._t0
+
+    def _watch(self, label: str):
+        if self.watchdog is None:
+            return contextlib.nullcontext()
+        return self.watchdog.watch(label, step=self._step)
+
+    def _next_key(self):
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # ---------------------------------------------------------------- submit
+    def submit(self, req: Request) -> None:
+        cap = self.pool.capacity_tokens
+        P = len(req.prompt)
+        limit = cap - P + 1 - self.gamma
+        if P > cap or limit < 1:
+            raise ValueError(
+                f"prompt of {P} tokens does not fit a {cap}-token block "
+                f"table (gamma={self.gamma})")
+        req.max_new_tokens = min(req.max_new_tokens, limit)
+        self.sched.submit(req, now=self._now())
+
+    # --------------------------------------------------------------- prefill
+    def _prefill(self, slot: int, req: Request) -> None:
+        P = len(req.prompt)
+        ok = self.pool.ensure(req.rid, P)
+        assert ok, "admission checked block availability"
+        C = self.chunk_size
+        # stage every host->device input BEFORE entering the watch scope:
+        # first-use eager compiles (asarray, key splits) must land as
+        # unattributed warmups, not as step-label anomalies.
+        table = self._jnp.asarray(self.pool.table([req.rid]))
+        n_chunks = -(-P // C)
+        chunks = []
+        for i in range(n_chunks):
+            lo = i * C
+            valid = min(C, P - lo)
+            chunk = np.zeros((1, C), np.int32)
+            chunk[0, :valid] = req.prompt[lo:lo + valid]
+            chunks.append((self._jnp.asarray(chunk), np.int32(lo),
+                           np.int32(valid), self._next_key(),
+                           self._next_key()))
+        tok = None
+        with self._watch("serve_prefill"):
+            for chunk, lo, valid, key, dkey in chunks:
+                tok, self.pk, self.pv = self.steps.prefill(
+                    self.params, self.pk, self.pv, chunk, lo, valid,
+                    table, key)
+                if self._spec_round is not None:
+                    _, self.dpk, self.dpv = self.dsteps.prefill(
+                        self.draft_params, self.dpk, self.dpv,
+                        chunk, lo, valid, table, dkey)
+        seed = int(np.asarray(tok))
+        now = self._now()
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.ttft_samples.append(now - req.arrival_time)
+        self._emit(slot, req, seed, now, first=True)
+        self._offsets[slot] = P
+        self._last[slot] = seed
+        self._last_emit[slot] = now
+        if req.done:
+            self._finish(slot)
+
+    # ----------------------------------------------------------------- emit
+    def _emit(self, slot: int, req: Request, token: int, now: float,
+              first: bool = False) -> None:
+        req.generated.append(token)
+        self.total_tokens += 1
+        if self.stream is not None:
+            self.stream(req.rid, token, "first" if first else "token")
+
+    def _finish(self, slot: int) -> None:
+        req = self.sched.finish(slot, now=self._now())
+        self.pool.free(req.rid)
+        self._offsets[slot] = 0
+        self._last[slot] = 0
+        self.finished.append(req)
+
+    # ------------------------------------------------------------ preemption
+    def _preempt(self, slot: int) -> None:
+        req = self.sched.slots[slot]
+        self.pool.free(req.rid)
+        self._offsets[slot] = 0
+        self._last[slot] = 0
+        self.sched.preempt(slot)
+        if self.obs is not None:
+            self.obs.log_event("serve_preempt", step=self._step, rid=req.rid)
+
+    def _ensure_or_preempt(self, slot: int, rid, need_tokens: int) -> bool:
+        """Grow ``rid`` to ``need_tokens``; on exhaustion preempt victims
+        (possibly the requester itself) until it fits or the requester is
+        gone.  Returns False when the requesting slot was evicted."""
+        while not self.pool.ensure(rid, need_tokens):
+            victim = self.sched.pick_victim()
+            if victim is None:
+                return False
+            self._preempt(victim)
+            if victim == slot:
+                return False
+        return True
+
+    # ----------------------------------------------------------------- step
+    def step(self) -> int:
+        """One engine iteration; returns tokens emitted."""
+        t_start = self._now()
+        self._step += 1
+        if self.chaos is not None:
+            self.chaos.on_step(self, self._step)
+
+        # admission: continuous fills free lanes anytime; static (the
+        # naive baseline) only opens the door once the whole wave drains.
+        if self.mode == "continuous" or not self.sched.active:
+            placed = self.sched.admit(
+                lambda r: self.pool.can_alloc(
+                    self.pool.blocks_needed(len(r.prompt))))
+            for slot, req in placed:
+                self._prefill(slot, req)
+
+        emitted = 0
+        active = list(self.sched.active)
+        if active:
+            grow = self.gamma + 1
+            live = []
+            for slot, req in active:
+                if self.sched.slots[slot] is not req:
+                    continue          # evicted by an earlier lane's growth
+                if self._ensure_or_preempt(
+                        slot, req.rid, int(self._offsets[slot]) + grow):
+                    live.append((slot, req))
+            if live:
+                emitted += self._decode(live)
+
+        if self.pool.fragmentation_pct() > self.defrag_threshold_pct:
+            self._defrag()
+
+        if emitted or active:
+            self._log_metrics(self._now() - t_start, emitted)
+        return emitted
+
+    def _decode(self, live) -> int:
+        sids = [None] * self.max_batch
+        for slot, req in live:
+            sids[slot] = req.rid
+        table = self._jnp.asarray(self.pool.table(sids))
+        tokens = self._jnp.asarray(self._last)
+        offsets = self._jnp.asarray(self._offsets)
+        key = self._next_key()
+        with self._watch("serve_decode"):
+            if self._spec_round is not None:
+                out, self.pk, self.pv, self.dpk, self.dpv = self._spec_round(
+                    self.params, self.draft_params, self.pk, self.pv,
+                    self.dpk, self.dpv, tokens, offsets, table)
+            else:
+                out, self.pk, self.pv = self.steps.decode(
+                    self.params, self.pk, self.pv, tokens, offsets, table,
+                    key)
+        arr = np.asarray(out)          # the one host sync of the iteration
+        now = self._now()
+        emitted = 0
+        for slot, req in live:
+            toks = (arr[slot][arr[slot] >= 0].tolist()
+                    if arr.ndim == 2 else [int(arr[slot])])
+            gap = now - self._last_emit[slot]
+            for t in toks:
+                self._emit(slot, req, t, now)
+                self.itl_samples.append(gap / len(toks))
+                emitted += 1
+                if req.done:
+                    break
+            self._offsets[slot] += len(toks)
+            self._last[slot] = req.generated[-1]
+            self._last_emit[slot] = now
+            if req.done:
+                self._finish(slot)
+        return emitted
+
+    def _defrag(self) -> None:
+        perm = self.pool.defrag()
+        if np.array_equal(perm, np.arange(self.pool.n_blocks)):
+            return
+        # eager gathers outside any watch() scope: the watchdog books
+        # them as unattributed warmups, never anomalies.
+        p = self._jnp.asarray(perm)
+        self.pk = apply_permutation(self.pk, p)
+        self.pv = apply_permutation(self.pv, p)
+        if self._spec_round is not None:
+            self.dpk = apply_permutation(self.dpk, p)
+            self.dpv = apply_permutation(self.dpv, p)
+        if self.obs is not None:
+            self.obs.log_event("serve_defrag", step=self._step,
+                               defrags=self.pool.defrags)
+
+    # -------------------------------------------------------------- metrics
+    def _log_metrics(self, step_time: float, emitted: int) -> None:
+        if self.obs is None:
+            return
+        now = max(self._now(), 1e-9)
+        extra = {
+            "serving": 1.0,
+            "queue_depth": float(self.sched.queue_depth),
+            "active_seqs": float(len(self.sched.active)),
+            "kv_occupancy_pct": self.pool.occupancy_pct(),
+            "kv_frag_pct": self.pool.fragmentation_pct(),
+            "preemptions": float(self.sched.preemptions),
+            "requests_completed": float(self.sched.completed),
+            "tokens_per_s": self.total_tokens / now,
+        }
+        for name, samples in (("ttft", self.ttft_samples),
+                              ("itl", self.itl_samples)):
+            for q in (0.5, 0.95, 0.99):
+                v = _pct_ms(samples, q)
+                if v is not None:
+                    extra[f"{name}_p{int(q * 100)}_ms"] = v
+        self.obs.log_step(self._step, step_time, n_items=emitted,
+                          extra=extra)
+
+    # ------------------------------------------------------------------- run
+    def run(self, load: List, max_steps: int = 100000) -> Dict[str, Any]:
+        """Drive a loadgen trace to completion: submit each request when
+        its arrival time passes on the engine clock, step until drained."""
+        pending = sorted(load, key=lambda x: x[0])
+        i = 0
+        for _ in range(max_steps):
+            now = self._now()
+            while i < len(pending) and pending[i][0] <= now:
+                self.submit(pending[i][1])
+                i += 1
+            if not self.sched.active and not self.sched.queue_depth:
+                if i >= len(pending):
+                    break
+                self._sleep_fn(max(min(pending[i][0] - self._now(), 1e-3),
+                                   0.0))
+                continue
+            self.step()
+        return self.summary()
+
+    def summary(self) -> Dict[str, Any]:
+        wall = max(self._now(), 1e-9)
+        out = {
+            "mode": self.mode,
+            "completed": self.sched.completed,
+            "tokens": self.total_tokens,
+            "wall_s": wall,
+            "tokens_per_s": self.total_tokens / wall,
+            "preemptions": self.sched.preemptions,
+            "defrags": self.pool.defrags,
+            "alloc_failures": self.pool.alloc_failures,
+            "steps": self._step,
+        }
+        for name, samples in (("ttft", self.ttft_samples),
+                              ("itl", self.itl_samples)):
+            for q in (0.5, 0.95, 0.99):
+                out[f"{name}_p{int(q * 100)}_ms"] = _pct_ms(samples, q)
+        return out
+
+
+def init_lm_params(vocab_size: int, d_model: int, n_heads: int,
+                   n_layers: int, block_size: int = 16, seed: int = 0):
+    """Random-init params for the paged model (identical tree to
+    ``TransformerLM.init``, so either side's init works for both)."""
+    import jax
+    import jax.numpy as jnp
+
+    steps = _make_steps(vocab_size, d_model, n_heads, n_layers, block_size,
+                        0.0, 0, 1.0, "")
+    pk, pv = init_pools(n_layers, 4, block_size, n_heads,
+                        d_model // n_heads)
+    table = jnp.zeros((1, 2), jnp.int32)
+    tokens = jnp.zeros((1, 1), jnp.int32)
+    pos = jnp.zeros((1, 1), jnp.int32)
+    variables = steps.model.init(
+        jax.random.PRNGKey(seed), tokens, pk, pv, table, pos)
+    return variables["params"]

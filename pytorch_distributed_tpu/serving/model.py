@@ -1,0 +1,150 @@
+"""Paged-attention twin of ``models/transformer.TransformerLM``.
+
+``PagedTransformerLM`` keeps the exact parameter tree of the training
+model — same scope names (``embed``, ``block_i.{ln1,ln2}``,
+``attn.{qkv,proj}``, ``fc1``/``fc2``, ``ln_f``), same tied head — so a
+trained (or int8-quantized, models/quant.py) params pytree applies
+unchanged.  Only the attention inner changes: the per-call flax cache of
+``SelfAttention._decode_attend`` becomes an explicit paged KV pool
+threaded through ``__call__`` (serving/kvpool.py), because a serving
+batch mixes sequences at different offsets and lifetimes — one scalar
+cache index cannot describe it.
+
+Exactness contract (the bit-exact-greedy parity test rides on this): the
+score/softmax/value math is copied line-for-line from ``_decode_attend``
+— f32 score accumulation, ``/ sqrt(D)``, ``-1e30`` mask then softmax
+(masked lanes underflow to exactly 0.0 in f32, so garbage KV reads
+contribute exactly nothing), same einsum contractions.  ``rope_at`` is
+``transformer.rope`` with the scalar offset generalized to a per-token
+position matrix; the per-element float math is identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.models.transformer import _dense_cls
+from pytorch_distributed_tpu.serving.kvpool import (
+    lookup_blocks,
+    paged_gather,
+    paged_scatter,
+)
+
+
+def rope_at(x: jnp.ndarray, pos: jnp.ndarray,
+            base: float = 10000.0) -> jnp.ndarray:
+    """``transformer.rope`` with explicit absolute positions.
+
+    ``x [B, L, H, D]``, ``pos [B, L]`` (int).  Each (batch, token) lane
+    gets the rotation for its own position — the vector-offset form a
+    mixed-offset serving batch needs.  Elementwise math matches
+    ``rope(x, offset=idx)`` bit-for-bit at equal positions."""
+    half = x.shape[-1] // 2
+    freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = pos.astype(jnp.float32)[:, :, None] * freqs[None, None, :]
+    cos = jnp.cos(ang)[:, :, None, :]                         # [B, L, 1, half]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+class PagedSelfAttention(nn.Module):
+    n_heads: int
+    block_size: int
+    dtype: Any = jnp.float32
+    quant: str = ""
+
+    @nn.compact
+    def __call__(self, x, pool_k, pool_v, table, pos):
+        B, L, C = x.shape
+        D = C // self.n_heads
+        dense = _dense_cls(self.quant)
+        qkv = dense(3 * C, use_bias=False, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, L, self.n_heads, D)
+        q, k, v = (t.reshape(shape) for t in (q, k, v))
+        q = rope_at(q, pos)
+        k = rope_at(k, pos)
+        blk = lookup_blocks(table, pos, self.block_size)
+        off = pos % self.block_size
+        pool_k = paged_scatter(pool_k, blk, off, k.astype(pool_k.dtype))
+        pool_v = paged_scatter(pool_v, blk, off, v.astype(pool_v.dtype))
+        keys = paged_gather(pool_k, table)                    # [B, KV, H, D]
+        values = paged_gather(pool_v, table)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32),
+            keys.astype(jnp.float32)) / (D ** 0.5)
+        kpos = jnp.arange(keys.shape[1])
+        # self-inclusive causal mask over logical positions, per slot:
+        # position j attends to committed positions 0..j (matches
+        # _decode_attend's kpos <= qpos).
+        mask = kpos[None, None, None, :] <= pos[:, None, :, None]
+        scores = jnp.where(mask, scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", w, values.astype(jnp.float32)
+        ).astype(q.dtype).reshape(B, L, C)
+        out = dense(C, use_bias=False, dtype=self.dtype, name="proj")(out)
+        return out, pool_k, pool_v
+
+
+class PagedBlock(nn.Module):
+    n_heads: int
+    block_size: int
+    dtype: Any = jnp.float32
+    quant: str = ""
+
+    @nn.compact
+    def __call__(self, x, pool_k, pool_v, table, pos):
+        C = x.shape[-1]
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        a, pool_k, pool_v = PagedSelfAttention(
+            self.n_heads, self.block_size, self.dtype, self.quant,
+            name="attn")(h, pool_k, pool_v, table, pos)
+        x = x + a
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        dense = _dense_cls(self.quant)
+        h = dense(4 * C, dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h)
+        h = dense(C, dtype=self.dtype, name="fc2")(h)
+        return x + h, pool_k, pool_v
+
+
+class PagedTransformerLM(nn.Module):
+    """``__call__(tokens[B, L], pool_k, pool_v, table[B, W], pos[B, L])
+    -> (logits[B, L, vocab], pool_k, pool_v)``.
+
+    Pools are explicit function state, not flax variables: the engine
+    threads them through every jitted step, so one compiled step serves
+    every sequence the pool will ever hold."""
+
+    vocab_size: int = 64
+    d_model: int = 32
+    n_heads: int = 4
+    n_layers: int = 1
+    block_size: int = 16
+    dtype: Any = jnp.float32
+    quant: str = ""
+
+    @nn.compact
+    def __call__(self, tokens, pool_k, pool_v, table, pos):
+        embed = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                         name="embed")
+        x = embed(tokens)
+        new_k, new_v = [], []
+        for i in range(self.n_layers):
+            x, k_l, v_l = PagedBlock(
+                self.n_heads, self.block_size, self.dtype, self.quant,
+                name=f"block_{i}")(x, pool_k[i], pool_v[i], table, pos)
+            new_k.append(k_l)
+            new_v.append(v_l)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = embed.attend(x.astype(jnp.float32)).astype(jnp.float32)
+        return logits, jnp.stack(new_k), jnp.stack(new_v)

@@ -11,7 +11,7 @@ Commands:
   also 0 with a note when no sidecar exists — legacy file);
 - ``seal PATH``      write/refresh the sidecar for an existing file (adopt
   a pre-FT checkpoint into the verified world);
-- ``drill shrink|grow|hang|alert``  run an end-to-end drill on a tiny
+- ``drill shrink|grow|hang|alert|serve``  run an end-to-end drill on a tiny
   synthetic LM: ``shrink`` loses a rank at a seed-deterministic step and
   continues at world N−1; ``grow`` re-admits it later and finishes back
   at world N (exit 0 iff every expected ``remesh`` event was committed);
@@ -23,8 +23,13 @@ Commands:
   LKG under a staleness rule, and passes iff every one raises its
   matching alert *live* (scraped off the rank's ``/metrics`` exporter or
   booked by ``obs_live --once``) and lands as an ``alert`` ft_event that
-  goodput and ``obs_report`` fold.  The only commands that build a mesh
-  (jax imported lazily inside them);
+  goodput and ``obs_report`` fold; ``serve`` (ISSUE 15) drags the
+  continuous-batching serving engine with a ``DelayRank`` straggler
+  mid-soak so first-token latency blows through a ``ttft_p99`` rule's
+  ceiling, and passes iff the alert is booked live as an ``alert``
+  ft_event in the serving JSONL and ``obs_report`` folds the serving
+  section.  The only commands that build a mesh (jax imported lazily
+  inside them);
 - ``--selftest``     the fast no-mesh CI path (tier-1, like
   ``shardlint.py --selftest`` / ``obs_report.py --selftest``): sidecar
   round-trip, flip/truncate detection, corruption determinism, retry
@@ -119,6 +124,8 @@ def cmd_drill(args) -> int:
         return _drill_hang(args)
     if args.kind == "alert":
         return _drill_alert(args)
+    if args.kind == "serve":
+        return _drill_serve(args)
     world = args.world
     if world < 2 or world > len(jax.devices()):
         print(f"need 2 <= --world <= {len(jax.devices())} devices, "
@@ -415,6 +422,103 @@ def _drill_alert(args) -> int:
     return 0
 
 
+def _drill_serve(args) -> int:
+    """Serving-plane drill (ISSUE 15): a ``DelayRank`` straggler drags
+    every engine iteration of a continuous-batching soak, so queued
+    requests' first tokens land far past a ``ttft_p99`` rule's ceiling.
+    Passes iff the alert engine books a live ``ttft_p99`` alert ft_event
+    into the serving JSONL, the run still completes every request, and
+    ``obs_report`` folds the ``== serving ==`` section from the same
+    file."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    from pytorch_distributed_tpu.ft import ChaosSchedule
+    from pytorch_distributed_tpu.ft.chaos import DelayRank
+    from pytorch_distributed_tpu.obs.alerts import AlertEngine, Rule
+    from pytorch_distributed_tpu.obs.metrics import (
+        MetricsLogger,
+        read_metrics,
+    )
+    from pytorch_distributed_tpu.serving.engine import (
+        ServingEngine,
+        init_lm_params,
+    )
+    from pytorch_distributed_tpu.serving.loadgen import (
+        LoadConfig,
+        generate_load,
+    )
+
+    out = args.out or tempfile.mkdtemp(prefix="serve-drill-")
+    os.makedirs(out, exist_ok=True)
+    mpath = os.path.join(out, "serving.jsonl")
+    delay = 0.05  # per-iteration straggler stall
+    ceiling_ms = 25.0  # vs a >= 50ms injected TTFT floor
+    n_requests = 12
+    print(f"drill serve: DelayRank({delay:.2f}s/step) vs "
+          f"{ceiling_ms:.0f}ms ttft_p99 ceiling, {n_requests} requests, "
+          f"artifacts in '{out}'")
+
+    params = init_lm_params(64, 32, 4, 1, block_size=8, seed=args.seed)
+    obs = MetricsLogger(mpath, flush_every=1)
+    alert_engine = AlertEngine(
+        [Rule("ttft_p99", "ttft_p99", "page", {"max_ms": ceiling_ms})],
+        emit=lambda **f: obs.log_event("alert", **f))
+    obs.register(alert_engine.observe)
+
+    eng = ServingEngine(
+        params, vocab_size=64, d_model=32, n_heads=4, n_layers=1,
+        max_batch=4, kv_blocks=32, block_size=8, blocks_per_seq=6,
+        chunk_size=8, max_new_tokens=8, obs=obs,
+        chaos=ChaosSchedule(DelayRank(delay)), seed=args.seed)
+    load = generate_load(LoadConfig(n_requests=n_requests, rate_rps=200.0,
+                                    seed=args.seed))
+    for _, req in load:
+        req.max_new_tokens = min(req.max_new_tokens, 8)
+    try:
+        summary = eng.run(load)
+    finally:
+        obs.close()
+
+    ok = True
+    if summary["completed"] != n_requests:
+        print(f"FAIL: {summary['completed']}/{n_requests} requests "
+              f"completed under the straggler")
+        ok = False
+    ttft = summary.get("ttft_p99_ms")
+    if ttft is None or ttft <= ceiling_ms:
+        print(f"FAIL: injected straggler did not breach the ceiling "
+              f"(ttft_p99 {ttft} vs {ceiling_ms}ms)")
+        ok = False
+    booked = {str(e.get("alert")) for e in read_metrics(mpath)
+              if e.get("ft_event") == "alert"}
+    if "ttft_p99" not in booked:
+        print(f"FAIL: no 'ttft_p99' alert ft_event in '{mpath}' "
+              f"(booked: {sorted(booked)})")
+        ok = False
+    rep = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "obs_report.py"), "--metrics-jsonl", mpath],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    for needle in ("== serving ==", "== alerts =="):
+        if needle not in rep.stdout:
+            print(f"FAIL: obs_report did not fold {needle!r} "
+                  f"(rc {rep.returncode})")
+            ok = False
+    if not ok:
+        return 1
+    print(_json.dumps({k: summary[k] for k in
+                       ("completed", "tokens", "ttft_p99_ms",
+                        "tokens_per_s")}, sort_keys=True))
+    print(f"drill serve: ttft_p99 {ttft:.1f}ms > {ceiling_ms:.0f}ms "
+          f"ceiling, alert booked live")
+    print("drill serve: OK")
+    return 0
+
+
 def _selftest() -> int:
     """No-mesh FT fast path: every assertion here runs in well under a
     second with zero jax involvement."""
@@ -557,12 +661,15 @@ def main(argv=None) -> int:
     s.add_argument("path")
     d = sub.add_parser("drill",
                        help="run an end-to-end elastic membership drill")
-    d.add_argument("kind", choices=("shrink", "grow", "hang", "alert"),
+    d.add_argument("kind",
+                   choices=("shrink", "grow", "hang", "alert", "serve"),
                    help="shrink: lose a rank and continue; grow: lose "
                         "then re-admit it; hang: stall a rank inside a "
                         "collective and let the watchdog catch it; "
                         "alert: slow/dead/stale injections must each "
-                        "raise their matching live alert")
+                        "raise their matching live alert; serve: a "
+                        "straggler under the serving engine must fire "
+                        "the ttft_p99 SLO alert live")
     d.add_argument("--world", type=int, default=4,
                    help="starting data-parallel world size")
     d.add_argument("--steps", type=int, default=12)

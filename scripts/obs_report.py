@@ -592,6 +592,69 @@ def postmortem_section(flight_dir: str,
     return pm.render_text(rep).splitlines()
 
 
+def serving_stats(records: List[dict]) -> Optional[Dict]:
+    """Scalar summary of the serving SLO fields (serving/engine.py):
+    TTFT / inter-token-latency percentiles, queue/pool pressure,
+    preemption and defrag counts.  None when the run logged no serving
+    steps (training runs keep their report unchanged)."""
+    steps = [r for r in records
+             if r.get("serving") and "ft_event" not in r
+             and "bench_event" not in r]
+    if not steps:
+        return None
+
+    def last(field):
+        # percentiles and counters are cumulative over the run — the
+        # last stamped value IS the run summary
+        for r in reversed(steps):
+            v = r.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return float(v)
+        return None
+
+    def peak(field):
+        vals = [float(r[field]) for r in steps
+                if isinstance(r.get(field), (int, float))]
+        return max(vals) if vals else None
+
+    out: Dict = {"steps": float(len(steps))}
+    for f in ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+              "itl_p50_ms", "itl_p95_ms", "itl_p99_ms",
+              "tokens_per_s", "requests_completed", "preemptions"):
+        out[f] = last(f)
+    out["queue_depth_peak"] = peak("queue_depth")
+    out["kv_occupancy_peak_pct"] = peak("kv_occupancy_pct")
+    out["kv_frag_peak_pct"] = peak("kv_frag_pct")
+    out["defrags"] = float(sum(1 for r in records
+                               if r.get("ft_event") == "serve_defrag"))
+    return out
+
+
+def summarize_serving(records: List[dict]) -> List[str]:
+    s = serving_stats(records)
+    if s is None:
+        return []
+
+    def fmt(v, unit=""):
+        return "--" if v is None else f"{v:.1f}{unit}"
+
+    return [
+        "== serving ==",
+        f"  {s['steps']:.0f} serving step(s); "
+        f"{fmt(s['requests_completed'])} request(s) completed; "
+        f"{fmt(s['tokens_per_s'])} tok/s",
+        f"  TTFT p50/p95/p99  {fmt(s['ttft_p50_ms'], 'ms')} / "
+        f"{fmt(s['ttft_p95_ms'], 'ms')} / {fmt(s['ttft_p99_ms'], 'ms')}",
+        f"  ITL p50/p95/p99   {fmt(s['itl_p50_ms'], 'ms')} / "
+        f"{fmt(s['itl_p95_ms'], 'ms')} / {fmt(s['itl_p99_ms'], 'ms')}",
+        f"  queue depth peak  {fmt(s['queue_depth_peak'])};  "
+        f"KV occupancy peak {fmt(s['kv_occupancy_peak_pct'], '%')};  "
+        f"frag peak {fmt(s['kv_frag_peak_pct'], '%')}",
+        f"  preemptions       {fmt(s['preemptions'])};  "
+        f"defrags {s['defrags']:.0f}",
+    ]
+
+
 def report(args) -> str:
     sections = []
     records: List[dict] = []
@@ -612,6 +675,7 @@ def report(args) -> str:
         sections += summarize_memory(records,
                                      getattr(args, "mem_ledger", None))
         sections += summarize_bench(records, bench_staleness_info(args))
+        sections += summarize_serving(records)
     else:
         if getattr(args, "comm_ledger", None):
             sections += summarize_comms([], args.comm_ledger,
@@ -679,6 +743,9 @@ def report_json(args) -> Dict:
         comms["predicted_bytes"] = getattr(args, "comm_predicted", None)
         out["comms"] = comms
         out["memory"] = mem_stats(records)
+        srv = serving_stats(records)
+        if srv is not None:
+            out["serving"] = srv
     staleness = bench_staleness_info(args)
     if staleness is not None:
         out["bench_staleness"] = staleness
@@ -737,6 +804,7 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
     mfu = [r["mfu"] for r in steps if "mfu" in r]
     gp = compute_goodput(records)
     cs = comm_stats(records)
+    srv = serving_stats(records)
     return {
         "steps": float(len(steps)),
         "step_time_p50": _pct(times, .5) if times else None,
@@ -750,6 +818,9 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         "exposed_comm_ms": cs["exposed_comm_ms"],
         "peak_hbm_bytes": cs["peak_hbm_bytes"],
         "alerts": float(gp.alerts) if gp.steps else None,
+        # serving SLO fences (None for training runs -> rows skip)
+        "ttft_p99_ms": srv["ttft_p99_ms"] if srv else None,
+        "tokens_per_s": srv["tokens_per_s"] if srv else None,
     }
 
 
@@ -777,6 +848,11 @@ _DIFF_METRICS = (
     # alert in the candidate regresses (threshold 0.5 below), and a
     # clean baseline (0 alerts) must not divide-by-zero.
     ("alerts", True, True),
+    # serving SLO fences (serving/engine.py): time-to-first-token p99
+    # and end-to-end token throughput.  Missing from training runs ->
+    # both rows skip, so training diffs are untouched.
+    ("ttft_p99_ms", True, False),
+    ("tokens_per_s", False, False),
 )
 
 
@@ -1294,6 +1370,85 @@ def _selftest() -> int:
                         "--bench-events", bench_events])
         assert rc4 == 1, "selftest: strict report must fail on stale LKG"
         assert rc5 == 0, "selftest: non-strict report must stay exit 0"
+
+        # ---- serving plane: section, json twin, planted TTFT fence ----
+        # a training-shaped run must not grow a serving section
+        assert "== serving ==" not in out, out
+        spath = os.path.join(d, "serving.jsonl")
+        with MetricsLogger(spath, flush_every=50) as log:
+            for i in range(10):
+                log.log_step(i, step_time=0.005, n_items=32,
+                             extra={"serving": 1.0,
+                                    "queue_depth": float(max(0, 5 - i)),
+                                    "active_seqs": 4.0,
+                                    "kv_occupancy_pct": 55.0 + i,
+                                    "kv_frag_pct": 12.5,
+                                    "preemptions": 1.0,
+                                    "requests_completed": float(i),
+                                    "tokens_per_s": 512.0,
+                                    "ttft_p50_ms": 40.0,
+                                    "ttft_p95_ms": 75.0,
+                                    "ttft_p99_ms": 80.0,
+                                    "itl_p50_ms": 4.0, "itl_p95_ms": 9.0,
+                                    "itl_p99_ms": 12.0})
+            log.log_event("serve_preempt", step=4, rid=3)
+            log.log_event("serve_defrag", step=7, defrags=1)
+        ns_s = argparse.Namespace(
+            metrics_jsonl=spath, hb_dir=None, telemetry_csv=None, now=now,
+            max_step_lag=3, max_beat_age=60.0, bench_lkg=None,
+            bench_events=None, bench_max_stale_days=14.0, plan=None,
+            flight_dir=None)
+        srv_out = report(ns_s)
+        for needle in ("== serving ==", "512.0 tok/s",
+                       "TTFT p50/p95/p99  40.0ms / 75.0ms / 80.0ms",
+                       "ITL p50/p95/p99   4.0ms / 9.0ms / 12.0ms",
+                       "queue depth peak  5.0",
+                       "KV occupancy peak 64.0%",
+                       "preemptions       1.0;  defrags 1"):
+            assert needle in srv_out, (
+                f"selftest: {needle!r} missing from:\n{srv_out}")
+        js_s = report_json(ns_s)
+        assert js_s["serving"]["ttft_p99_ms"] == 80.0, js_s["serving"]
+        assert js_s["serving"]["kv_occupancy_peak_pct"] == 64.0, (
+            js_s["serving"])
+        assert js_s["steps"]["ttft_p99_ms"] == 80.0, js_s["steps"]
+        assert js_s["steps"]["tokens_per_s"] == 512.0, js_s["steps"]
+        json.dumps(js_s)
+
+        # planted TTFT regression: same step times and throughput, but
+        # first tokens land 2.5x later -> the ttft_p99_ms fence (and only
+        # it) must REGRESS, and the --diff CLI must exit 1
+        base_s = os.path.join(d, "serve_base.jsonl")
+        bad_s = os.path.join(d, "serve_slow_ttft.jsonl")
+        for path, ttft in ((base_s, 80.0), (bad_s, 200.0)):
+            with MetricsLogger(path, flush_every=50) as log:
+                for i in range(10):
+                    log.log_step(i, step_time=0.005, n_items=32,
+                                 extra={"serving": 1.0,
+                                        "tokens_per_s": 512.0,
+                                        "ttft_p99_ms": ttft})
+        sa_recs, _ = load_metrics(base_s)
+        sb_recs, _ = load_metrics(bad_s)
+        text6, regressed6 = diff_report(sa_recs, sb_recs)
+        assert regressed6, (
+            f"selftest: planted TTFT regression must REGRESS:\n{text6}")
+        ds = diff_data(sa_recs, sb_recs)
+        by_srv = {r["metric"]: r for r in ds["metrics"]}
+        assert by_srv["ttft_p99_ms"]["verdict"] == "REGRESS", ds
+        assert by_srv["step_time_p50"]["verdict"] == "PASS", ds
+        assert by_srv["tokens_per_s"]["verdict"] == "PASS", ds
+        # reverse direction (TTFT improved) passes the row
+        dr_s = diff_data(sb_recs, sa_recs)
+        assert {r["metric"]: r for r in dr_s["metrics"]}[
+            "ttft_p99_ms"]["verdict"] == "PASS", dr_s
+        buf_s = io.StringIO()
+        with contextlib.redirect_stdout(buf_s):
+            rc_s = run_diff(base_s, bad_s, 10.0, 5.0)
+        assert rc_s == 1, "selftest: planted TTFT regression must exit 1"
+        assert "ttft_p99_ms" in buf_s.getvalue(), buf_s.getvalue()
+        # training-only diffs skip the serving rows (missing, not a fail)
+        assert {r["metric"]: r for r in diff_data(a_recs, b_recs)[
+            "metrics"]}["ttft_p99_ms"]["verdict"] == "missing"
 
         # ---- --flight-dir: the postmortem fold (ISSUE 13) ----
         pm = _postmortem_mod()

@@ -18,11 +18,25 @@ pure argparse) and asserts, recursively through subparsers:
 """
 
 import argparse
+import importlib.util
+import os
 
 import pytest
 
 from pytorch_distributed_tpu.recipes import lm_generate, lm_pretrain
 from pytorch_distributed_tpu.train import config as config_mod
+
+
+def _load_serve_lm():
+    """scripts/ is not a package; load the serving front end by path
+    (its heavy imports live inside main(), so this is argparse-only)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "serve_lm.py")
+    spec = importlib.util.spec_from_file_location("serve_lm_flags", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 
 PARSERS = {
     # every image recipe (distributed, apex, horovod, slurm, dataparallel,
@@ -30,6 +44,7 @@ PARSERS = {
     "train.config": lambda: config_mod.build_parser(),
     "recipes.lm_pretrain": lambda: lm_pretrain.build_parser(),
     "recipes.lm_generate": lambda: lm_generate.build_parser(),
+    "scripts.serve_lm": lambda: _load_serve_lm().build_parser(),
 }
 
 
@@ -150,3 +165,22 @@ def test_telemetry_plane_flags_parse_to_their_own_dests():
     assert args.precision == "bf16"
     args = lm_pretrain.build_parser().parse_args([])
     assert (args.metrics_port, args.alerts) == (0, None)
+
+
+def test_serving_flags_parse_to_their_own_dests():
+    """ISSUE-15 flags: serve_lm's model/engine/load/SLO flags land in
+    their own dests and collide with nothing (the parametrized _lint
+    tests above cover the collision half for this parser too)."""
+    ap = _load_serve_lm().build_parser()
+    args = ap.parse_args(
+        ["--mode", "static", "--kv-blocks", "128", "--gamma", "3",
+         "--quant", "int8", "--rate-rps", "10.5", "--slo-ttft-ms", "250",
+         "--policy", "priority", "--blocks-per-seq", "6"])
+    assert (args.mode, args.kv_blocks, args.gamma) == ("static", 128, 3)
+    assert (args.quant, args.rate_rps) == ("int8", 10.5)
+    assert (args.slo_ttft_ms, args.policy) == (250.0, "priority")
+    assert args.blocks_per_seq == 6
+    args = ap.parse_args([])
+    assert (args.mode, args.policy) == ("continuous", "fcfs")
+    assert (args.slo_ttft_ms, args.slo_kv_pct) == (None, None)
+    assert (args.no_watchdog, args.metrics_jsonl) == (False, None)

@@ -1,0 +1,453 @@
+"""Continuous-batching serving engine (ISSUE 15): paged KV pool units,
+scheduler determinism, bit-exact parity with models/generate.py, and the
+zero-recompile soak.
+
+The parity tests are the load-bearing ones: the paged engine must emit
+*bit-identical* greedy tokens to the dense KV-cache reference for every
+request in a mixed-length trace — batched, chunk-prefilled, behind
+admission/preemption, and under speculative decoding.  Everything the
+engine does (block tables, null-block routing, recompute-on-preempt) is
+invisible or it's wrong.
+
+All engine tests run on a fake clock (time_fn/sleep_fn injection), so
+they are deterministic and never actually sleep.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models.generate import greedy_generate
+from pytorch_distributed_tpu.serving.engine import (
+    ServingEngine,
+    init_lm_params,
+)
+from pytorch_distributed_tpu.serving.kvpool import (
+    BlockPool,
+    apply_permutation,
+    init_pools,
+    lookup_blocks,
+    paged_gather,
+)
+from pytorch_distributed_tpu.serving.loadgen import (
+    LoadConfig,
+    generate_load,
+)
+from pytorch_distributed_tpu.serving.scheduler import Request, Scheduler
+
+CFG = dict(vocab_size=64, d_model=32, n_heads=4, n_layers=2)
+BS = 8  # KV block size shared by every engine test (reuses compiles)
+
+
+def _params(seed=0):
+    return init_lm_params(block_size=BS, seed=seed, **CFG)
+
+
+def _fake_clock():
+    t = [0.0]
+    return (lambda: t[0]), (lambda s: t.__setitem__(0, t[0] + max(s, 1e-3)))
+
+
+def _engine(params, **kw):
+    time_fn, sleep_fn = _fake_clock()
+    defaults = dict(max_batch=4, kv_blocks=17, block_size=BS,
+                    blocks_per_seq=8, chunk_size=8, max_new_tokens=64,
+                    time_fn=time_fn, sleep_fn=sleep_fn, seed=0, **CFG)
+    defaults.update(kw)
+    return ServingEngine(params, **defaults)
+
+
+def _mk_load(seed, n, pmin=2, pmax=10, nmin=2, nmax=12):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        p = rng.integers(0, CFG["vocab_size"],
+                         size=int(rng.integers(pmin, pmax + 1))).tolist()
+        out.append((0.0, Request(rid=i, prompt=p,
+                                 max_new_tokens=int(
+                                     rng.integers(nmin, nmax + 1)))))
+    return out
+
+
+def _reference(params, load):
+    """Dense-cache greedy reference, one request at a time."""
+    want = {}
+    for _, req in load:
+        prompt = jnp.asarray([list(req.prompt)], jnp.int32)
+        got = greedy_generate(params, prompt, req.max_new_tokens,
+                              flash_prefill=False, **CFG)
+        want[req.rid] = np.asarray(got)[0].tolist()
+    return want
+
+
+# --------------------------------------------------------------- kvpool
+
+def test_blockpool_alloc_free_accounting():
+    pool = BlockPool(n_blocks=9, block_size=4, blocks_per_seq=4)
+    assert pool.capacity_blocks == 8  # block 0 is the reserved null sink
+    assert pool.capacity_tokens == 16
+    assert pool.blocks_needed(1) == 1 and pool.blocks_needed(4) == 1
+    assert pool.blocks_needed(5) == 2
+    assert pool.ensure(7, 6)  # 2 blocks
+    assert pool.used_blocks == 2 and pool.free_blocks == 6
+    assert pool.ensure(7, 8)  # still 2 blocks: grow within allocation
+    assert pool.used_blocks == 2
+    assert pool.ensure(7, 9)  # third block
+    assert pool.used_blocks == 3
+    assert 0 not in pool.blocks_of(7)
+    assert pool.free(7) == 3
+    assert pool.used_blocks == 0 and pool.free_blocks == 8
+    assert pool.occupancy_pct() == 0.0
+
+
+def test_blockpool_exhaustion_counts_failures():
+    pool = BlockPool(n_blocks=5, block_size=4, blocks_per_seq=4)
+    assert pool.ensure(1, 16)  # all 4 usable blocks
+    assert not pool.can_alloc(1)
+    assert not pool.ensure(2, 1)
+    assert pool.alloc_failures == 1
+    with pytest.raises(ValueError):
+        pool.ensure(3, 17)  # beyond per-seq capacity: admission bug
+    pool.free(1)
+    assert pool.ensure(2, 1)
+
+
+def test_blockpool_defrag_preserves_gathered_kv():
+    """Free a middle sequence, defrag, permute the device pool: gathers
+    through the rewritten tables must be bit-identical."""
+    pool = BlockPool(n_blocks=8, block_size=4, blocks_per_seq=3)
+    for sid, toks in ((0, 8), (1, 8), (2, 8)):
+        assert pool.ensure(sid, toks)
+    pool.free(1)
+    assert pool.fragmentation_pct() > 0.0
+
+    pk, _ = init_pools(1, 8, 4, n_heads=2, head_dim=4)
+    # stamp every block with its own id so moves are detectable
+    pk = pk.at[:].set(jnp.arange(8, dtype=jnp.float32)[None, :, None,
+                                                       None, None])
+    before = {sid: np.asarray(paged_gather(pk[0], jnp.asarray(
+        pool.table([sid])))) for sid in (0, 2)}
+
+    perm = pool.defrag()
+    assert pool.defrags == 1
+    assert pool.fragmentation_pct() == 0.0
+    assert sorted(pool.blocks_of(0) + pool.blocks_of(2)) == [1, 2, 3, 4]
+    pk2 = apply_permutation(pk, jnp.asarray(perm))
+    for sid in (0, 2):
+        after = np.asarray(paged_gather(pk2[0], jnp.asarray(
+            pool.table([sid]))))
+        np.testing.assert_array_equal(after, before[sid])
+    # nothing to move: identity perm, counter untouched
+    perm2 = pool.defrag()
+    np.testing.assert_array_equal(perm2, np.arange(8))
+    assert pool.defrags == 1
+
+
+def test_blockpool_null_routing():
+    pool = BlockPool(n_blocks=8, block_size=4, blocks_per_seq=2)
+    assert pool.ensure(5, 3)
+    tab = pool.table([5, None])
+    assert tab.shape == (2, 2) and tab.dtype == np.int32
+    assert (tab[1] == 0).all()  # empty lane reads the null block
+    # out-of-window positions route to block 0, never past the table
+    blk = np.asarray(lookup_blocks(jnp.asarray(tab),
+                                   jnp.asarray([[9], [0]], jnp.int32), 4))
+    assert blk[0, 0] == 0
+
+
+# ------------------------------------------------------------ scheduler
+
+def test_scheduler_fcfs_admission_is_submit_order():
+    s = Scheduler(max_batch=2)
+    reqs = [Request(rid=i, prompt=[0], max_new_tokens=1) for i in range(4)]
+    for r in reqs:
+        s.submit(r)
+    placed = s.admit(lambda r: True)
+    assert [(i, r.rid) for i, r in placed] == [(0, 0), (1, 1)]
+    assert s.queue_depth == 2
+    s.finish(0)
+    assert [(i, r.rid) for i, r in s.admit(lambda r: True)] == [(0, 2)]
+
+
+def test_scheduler_priority_policy_and_hol_blocking():
+    s = Scheduler(max_batch=1, policy="priority")
+    s.submit(Request(rid=0, prompt=[0], max_new_tokens=1, priority=0))
+    s.submit(Request(rid=1, prompt=[0], max_new_tokens=1, priority=5))
+    placed = s.admit(lambda r: True)
+    assert placed[0][1].rid == 1  # higher priority jumps the queue
+    s.finish(0)
+    # head-of-line blocking: a rejected head blocks everything behind it
+    s.submit(Request(rid=2, prompt=[0], max_new_tokens=1, priority=9))
+    assert s.admit(lambda r: False) == []
+    assert s.queue_depth == 2
+
+
+def test_scheduler_preempt_requeues_at_original_position():
+    s = Scheduler(max_batch=2)
+    for i in range(3):
+        s.submit(Request(rid=i, prompt=[0], max_new_tokens=4))
+    s.admit(lambda r: True)  # rids 0, 1 active; rid 2 queued
+    victim = s.slots[1]
+    victim.generated = [7, 7]
+    s.preempt(1)
+    assert victim.generated == [] and victim.preemptions == 1
+    # the preempted rid 1 re-enters AHEAD of the later-submitted rid 2
+    placed = s.admit(lambda r: True)
+    assert [r.rid for _, r in placed] == [1]
+    assert s.preemptions == 1
+
+
+def test_scheduler_victim_is_lowest_priority_then_youngest():
+    s = Scheduler(max_batch=3, policy="priority")
+    s.submit(Request(rid=0, prompt=[0], max_new_tokens=1, priority=2))
+    s.submit(Request(rid=1, prompt=[0], max_new_tokens=1, priority=1))
+    s.submit(Request(rid=2, prompt=[0], max_new_tokens=1, priority=1))
+    s.admit(lambda r: True)
+    # both rid 1 and 2 have the low priority; rid 2 was admitted later
+    slot = s.pick_victim()
+    assert s.slots[slot].rid == 2
+    assert s.pick_victim(protect=(slot,)) != slot
+
+
+# -------------------------------------------------- engine: exact parity
+
+def test_continuous_engine_bit_exact_vs_dense_reference():
+    """Mixed-length trace through admission, chunked prefill, and paged
+    decode must reproduce the dense-cache greedy tokens bit-for-bit."""
+    params = _params()
+    load = _mk_load(seed=3, n=10)
+    want = _reference(params, load)
+    eng = _engine(params)
+    summary = eng.run(load)
+    assert summary["completed"] == 10
+    got = {r.rid: list(r.generated) for r in eng.finished}
+    assert got == want
+
+
+def test_static_mode_same_tokens_fewer_assumptions():
+    """mode="static" (the A/B baseline) is a scheduling change only: the
+    emitted tokens must be identical to continuous mode."""
+    params = _params()
+    load_a = _mk_load(seed=4, n=8)
+    load_b = _mk_load(seed=4, n=8)
+    eng_a = _engine(params, mode="continuous")
+    eng_a.run(load_a)
+    eng_b = _engine(params, mode="static")
+    s = eng_b.run(load_b)
+    assert s["completed"] == 8
+    assert ({r.rid: list(r.generated) for r in eng_a.finished}
+            == {r.rid: list(r.generated) for r in eng_b.finished})
+
+
+def test_preemption_recompute_parity():
+    """A pool too small for the offered load must preempt-and-requeue —
+    and, because recompute under greedy is deterministic, finish with
+    exactly the tokens an unconstrained pool produces."""
+    params = _params()
+
+    def load():
+        return [(0.0, Request(rid=i, prompt=[i + 1, i + 2, i + 3, i + 4],
+                              max_new_tokens=20)) for i in range(4)]
+
+    big = _engine(params, kv_blocks=33, blocks_per_seq=4)
+    big.run(load())
+    assert big.summary()["preemptions"] == 0
+
+    tiny = _engine(params, kv_blocks=7, blocks_per_seq=4)
+    s = tiny.run(load())
+    assert s["completed"] == 4
+    assert s["preemptions"] > 0
+    assert ({r.rid: list(r.generated) for r in tiny.finished}
+            == {r.rid: list(r.generated) for r in big.finished})
+
+
+def test_speculative_decode_bit_exact():
+    """gamma=3 speculative decoding accepts/rejects against the target's
+    own greedy argmax, so outputs must be identical to gamma=0."""
+    params = _params()
+    draft = init_lm_params(CFG["vocab_size"], 16, CFG["n_heads"], 1,
+                           block_size=BS, seed=1)
+    load_a = _mk_load(seed=5, n=6)
+    load_b = _mk_load(seed=5, n=6)
+    plain = _engine(params)
+    plain.run(load_a)
+    spec = _engine(params, gamma=3, draft_params=draft)
+    s = spec.run(load_b)
+    assert s["completed"] == 6
+    assert ({r.rid: list(r.generated) for r in spec.finished}
+            == {r.rid: list(r.generated) for r in plain.finished})
+    # speculative rounds emit >= 1 token each, so never MORE iterations
+    assert s["steps"] <= plain.summary()["steps"]
+
+
+def test_int8_serving_smoke():
+    from pytorch_distributed_tpu.models.quant import quantize_lm_params
+
+    params = quantize_lm_params(_params())
+    load = _mk_load(seed=6, n=4, nmax=6)
+    eng = _engine(params, quant="int8")
+    s = eng.run(load)
+    assert s["completed"] == 4
+    for r in eng.finished:
+        assert len(r.generated) == r.max_new_tokens
+        assert all(0 <= t < CFG["vocab_size"] for t in r.generated)
+
+
+def test_streaming_callback_ordering():
+    params = _params()
+    load = _mk_load(seed=7, n=5)
+    events = []
+    eng = _engine(params, stream=lambda rid, tok, kind:
+                  events.append((rid, tok, kind)))
+    eng.run(load)
+    per_rid = {}
+    for rid, tok, kind in events:
+        per_rid.setdefault(rid, []).append((tok, kind))
+    for r in eng.finished:
+        toks = per_rid[r.rid]
+        # exactly one "first" per request, and it is the first event
+        assert [k for _, k in toks] == (["first"]
+                                        + ["token"] * (len(toks) - 1))
+        assert [t for t, _ in toks] == list(r.generated)
+
+
+# ----------------------------------------- engine: recompiles + metrics
+
+def test_zero_recompile_soak_with_defrag():
+    """Mixed-length churn (admissions, finishes, defrags) across a soak
+    must never retrace the serving steps: the static-shape contract."""
+    from pytorch_distributed_tpu.obs.watchdog import RecompileWatchdog
+
+    params = _params()
+    load = [(t, r) for t, r in generate_load(LoadConfig(
+        n_requests=24, rate_rps=500.0, profile="mixed",
+        vocab_size=CFG["vocab_size"], seed=8))]
+    wd = RecompileWatchdog()
+    wd.install()
+    try:
+        eng = _engine(params, watchdog=wd, defrag_threshold_pct=10.0)
+        s = eng.run(load)
+    finally:
+        wd.uninstall()
+    assert s["completed"] == 24
+    assert s["defrags"] >= 1, "soak never exercised the defrag path"
+    assert wd.anomalies == [], [a for a in wd.anomalies]
+
+
+def test_engine_emits_slo_fields_and_events():
+    from pytorch_distributed_tpu.obs.metrics import MetricsLogger
+
+    params = _params()
+    records = []
+    obs = MetricsLogger(None, flush_every=1)
+    obs.register(records.append)
+    eng = _engine(params, obs=obs, kv_blocks=7, blocks_per_seq=4)
+    eng.run([(0.0, Request(rid=i, prompt=[1, 2, 3, 4], max_new_tokens=20))
+             for i in range(4)])
+    obs.close()
+    steps = [r for r in records if "ft_event" not in r
+             and r.get("serving")]
+    assert steps, "no serving step records logged"
+    last = steps[-1]
+    for field in ("queue_depth", "active_seqs", "kv_occupancy_pct",
+                  "kv_frag_pct", "preemptions", "requests_completed",
+                  "tokens_per_s", "ttft_p50_ms", "ttft_p99_ms"):
+        assert field in last, field
+    assert any(r.get("ft_event") == "serve_preempt" for r in records)
+
+
+def test_ttft_and_kv_alert_rules():
+    from pytorch_distributed_tpu.obs.alerts import AlertEngine, Rule
+
+    booked = []
+    ae = AlertEngine(
+        [Rule("ttft_p99", "ttft", "page", {"max_ms": 100.0}),
+         Rule("kv_occupancy", "kv", "warn", {"max_pct": 90.0})],
+        emit=lambda **f: booked.append(f))
+    ae.observe({"step": 1, "step_time": 0.01, "ttft_p99_ms": 50.0,
+                "kv_occupancy_pct": 10.0})
+    assert booked == []
+    ae.observe({"step": 2, "step_time": 0.01, "ttft_p99_ms": 150.0,
+                "kv_occupancy_pct": 95.0})
+    assert {b["alert"] for b in booked} == {"ttft", "kv"}
+    # latched: the same breach does not re-book
+    ae.observe({"step": 3, "step_time": 0.01, "ttft_p99_ms": 150.0,
+                "kv_occupancy_pct": 95.0})
+    assert len(booked) == 2
+    # recovery clears the latch; the next breach books again
+    ae.observe({"step": 4, "step_time": 0.01, "ttft_p99_ms": 50.0,
+                "kv_occupancy_pct": 10.0})
+    ae.observe({"step": 5, "step_time": 0.01, "ttft_p99_ms": 150.0,
+                "kv_occupancy_pct": 10.0})
+    assert [b["alert"] for b in booked] == ["ttft", "kv", "ttft"]
+
+
+def test_exporter_renders_serving_gauges():
+    from pytorch_distributed_tpu.obs.export import (
+        MetricsExporter,
+        parse_prometheus,
+    )
+
+    ex = MetricsExporter(port=0)
+    ex.update({"step": 3, "step_time": 0.01, "serving": 1.0,
+               "ttft_p99_ms": 42.0, "itl_p50_ms": 2.0,
+               "queue_depth": 5.0, "kv_occupancy_pct": 61.0,
+               "preemptions": 2.0, "tokens_per_s": 512.0})
+    samples = {(n, lab.get("quantile")): v
+               for n, lab, v in parse_prometheus(ex.render())}
+    assert samples[("ptd_serving_ttft_ms", "p99")] == 42.0
+    assert samples[("ptd_serving_itl_ms", "p50")] == 2.0
+    assert samples[("ptd_serving_queue_depth", None)] == 5.0
+    assert samples[("ptd_serving_kv_occupancy_pct", None)] == 61.0
+    assert samples[("ptd_serving_preemptions_total", None)] == 2.0
+    assert samples[("ptd_serving_tokens_per_second", None)] == 512.0
+    # serving fields must not double-render as generic ptd_metric rows
+    generic = [lab.get("field") for n, lab, _ in
+               parse_prometheus(ex.render()) if n == "ptd_metric"]
+    assert "ttft_p99_ms" not in generic
+
+
+def test_serving_recipes_registered_and_baselined():
+    import json
+    import os
+
+    from pytorch_distributed_tpu.analysis import core
+
+    assert "serve_prefill" in core.RECIPES
+    assert "serve_decode" in core.RECIPES
+    base = json.load(open(os.path.join(
+        os.path.dirname(core.__file__), "baseline.json")))
+    for name in ("serve_prefill", "serve_decode"):
+        assert name in base, f"{name} missing from analysis/baseline.json"
+        assert base[name]["peak_hbm_bytes"] > 0
+    # serving recipes are single-host: no collectives on the wire
+    assert base["serve_decode"]["total_bytes"] == 0
+
+
+def test_engine_shares_compiled_steps_with_recipes():
+    """The analysis recipes and the live engine must hit the same cached
+    jitted callables — zero extra compiles for the registered steps."""
+    from pytorch_distributed_tpu.serving.engine import _make_steps
+
+    a = _make_steps(64, 32, 4, 2, BS, 0.0, 0, 1.0, "")
+    b = _make_steps(64, 32, 4, 2, BS, 0.0, 0, 1.0, "")
+    assert a is b
+    assert a.decode is b.decode and a.prefill is b.prefill
+
+
+def test_loadgen_deterministic_and_mixed():
+    a = generate_load(LoadConfig(n_requests=16, seed=9))
+    b = generate_load(LoadConfig(n_requests=16, seed=9))
+    assert [(t, r.rid, list(r.prompt), r.max_new_tokens) for t, r in a] \
+        == [(t, r.rid, list(r.prompt), r.max_new_tokens) for t, r in b]
+    c = generate_load(LoadConfig(n_requests=16, seed=10))
+    assert [r.max_new_tokens for _, r in a] \
+        != [r.max_new_tokens for _, r in c]
+    times = [t for t, _ in a]
+    assert times == sorted(times) and times[0] >= 0.0
+    lens = {r.max_new_tokens for _, r in
+            generate_load(LoadConfig(n_requests=64, profile="mixed",
+                                     seed=11))}
+    cfg = LoadConfig()
+    assert any(n <= cfg.short_max for n in lens)
+    assert any(n >= cfg.long_min for n in lens)
