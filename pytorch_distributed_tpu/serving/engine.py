@@ -27,7 +27,7 @@ import functools
 import time
 import types
 from collections import deque
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -333,12 +333,14 @@ class ServingEngine:
         if self.obs is not None:
             self.obs.log_event("serve_preempt", step=self._step, rid=req.rid)
 
-    def _ensure_or_preempt(self, slot: int, rid, need_tokens: int) -> bool:
+    def _ensure_or_preempt(self, slot: int, rid, need_tokens: int,
+                           protect: Sequence[int] = ()) -> bool:
         """Grow ``rid`` to ``need_tokens``; on exhaustion preempt victims
-        (possibly the requester itself) until it fits or the requester is
-        gone.  Returns False when the requesting slot was evicted."""
+        (possibly the requester itself, never a ``protect`` slot) until
+        it fits or the requester is gone.  Returns False when the
+        requesting slot was evicted."""
         while not self.pool.ensure(rid, need_tokens):
-            victim = self.sched.pick_victim()
+            victim = self.sched.pick_victim(protect=protect)
             if victim is None:
                 return False
             self._preempt(victim)
@@ -357,10 +359,20 @@ class ServingEngine:
         # admission: continuous fills free lanes anytime; static (the
         # naive baseline) only opens the door once the whole wave drains.
         if self.mode == "continuous" or not self.sched.active:
-            placed = self.sched.admit(
-                lambda r: self.pool.can_alloc(
-                    self.pool.blocks_needed(len(r.prompt))))
-            for slot, req in placed:
+            # Blocks are only allocated at prefill, below — so each
+            # candidate must be probed against the free count minus what
+            # earlier admits in this same loop have already pledged.
+            pledged = 0
+
+            def can_admit(r: Request) -> bool:
+                nonlocal pledged
+                need = self.pool.blocks_needed(len(r.prompt))
+                if self.pool.free_blocks - pledged < need:
+                    return False
+                pledged += need
+                return True
+
+            for slot, req in self.sched.admit(can_admit):
                 self._prefill(slot, req)
 
         emitted = 0
@@ -368,12 +380,17 @@ class ServingEngine:
         if active:
             grow = self.gamma + 1
             live = []
+            held = set()
             for slot, req in active:
                 if self.sched.slots[slot] is not req:
                     continue          # evicted by an earlier lane's growth
+                # protect already-validated lanes: a later lane's growth
+                # must never evict a slot this same decode will read.
                 if self._ensure_or_preempt(
-                        slot, req.rid, int(self._offsets[slot]) + grow):
+                        slot, req.rid, int(self._offsets[slot]) + grow,
+                        protect=held):
                     live.append((slot, req))
+                    held.add(slot)
             if live:
                 emitted += self._decode(live)
 

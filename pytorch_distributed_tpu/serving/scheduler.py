@@ -56,8 +56,11 @@ class Scheduler:
         self.max_batch = int(max_batch)
         self.policy = policy
         self.slots: List[Optional[Request]] = [None] * self.max_batch
-        self._heap: List[Tuple[tuple, Request]] = []
+        self._heap: List[Tuple[tuple, int, Request]] = []
         self._seq = itertools.count()
+        # heap tiebreaker: equal keys pop FIFO instead of falling through
+        # to comparing Request objects (which defines no ordering)
+        self._tiebreak = itertools.count()
         self._order: dict = {}       # rid -> submit sequence number
         self._admit_seq = itertools.count()
         self._admitted_at: dict = {}  # rid -> admission sequence (victim age)
@@ -69,7 +72,8 @@ class Scheduler:
     def submit(self, req: Request, now: float = 0.0) -> None:
         req.arrival_time = now
         self._order[req.rid] = next(self._seq)
-        heapq.heappush(self._heap, (self._key(req), req))
+        heapq.heappush(self._heap,
+                       (self._key(req), next(self._tiebreak), req))
 
     def _key(self, req: Request) -> tuple:
         # A preempted request re-enters with its ORIGINAL submit order,
@@ -100,7 +104,7 @@ class Scheduler:
         for slot in self.free_slots():
             if not self._heap:
                 break
-            _, req = self._heap[0]
+            req = self._heap[0][-1]
             if not can_admit(req):
                 break
             heapq.heappop(self._heap)
@@ -134,7 +138,8 @@ class Scheduler:
         req.generated = []
         req.preemptions += 1
         self.preemptions += 1
-        heapq.heappush(self._heap, (self._key(req), req))
+        heapq.heappush(self._heap,
+                       (self._key(req), next(self._tiebreak), req))
         return req
 
     def finish(self, slot: int, now: float = 0.0) -> Request:

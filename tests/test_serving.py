@@ -197,6 +197,21 @@ def test_scheduler_preempt_requeues_at_original_position():
     assert s.preemptions == 1
 
 
+def test_scheduler_duplicate_rid_requeue_never_compares_requests():
+    """A requeue whose key collides with a queued duplicate rid must
+    resolve on the tiebreaker, not by comparing Request objects."""
+    s = Scheduler(max_batch=1)
+    a = Request(rid=0, prompt=[0], max_new_tokens=4)
+    s.submit(a)
+    s.admit(lambda r: True)
+    b = Request(rid=0, prompt=[1], max_new_tokens=4)
+    s.submit(b)   # same rid while a is active: requeue key will collide
+    s.preempt(0)  # pre-fix: TypeError inside heapq comparing a vs b
+    assert s.queue_depth == 2
+    placed = s.admit(lambda r: True)
+    assert placed[0][1] is b  # equal keys pop FIFO: b entered first
+
+
 def test_scheduler_victim_is_lowest_priority_then_youngest():
     s = Scheduler(max_batch=3, policy="priority")
     s.submit(Request(rid=0, prompt=[0], max_new_tokens=1, priority=2))
@@ -257,6 +272,73 @@ def test_preemption_recompute_parity():
     s = tiny.run(load())
     assert s["completed"] == 4
     assert s["preemptions"] > 0
+    assert ({r.rid: list(r.generated) for r in tiny.finished}
+            == {r.rid: list(r.generated) for r in big.finished})
+
+
+def test_admission_does_not_overcommit_pool():
+    """Two queued requests that each fit the pool alone but not together
+    must admit one after the other — the admit loop used to probe every
+    candidate against the same unchanged free count, so the second
+    prefill found its blocks already gone."""
+    params = _params()
+
+    def load():
+        # 20-token prompts = 3 blocks each; the tiny pool has 4 usable
+        return [(0.0, Request(rid=i, prompt=[i + 1] * 20,
+                              max_new_tokens=6)) for i in range(2)]
+
+    big = _engine(params, kv_blocks=33)
+    big.run(load())
+    tiny = _engine(params, kv_blocks=5, blocks_per_seq=4)
+    s = tiny.run(load())
+    assert s["completed"] == 2
+    assert s["preemptions"] == 0 and s["alloc_failures"] == 0
+    assert ({r.rid: list(r.generated) for r in tiny.finished}
+            == {r.rid: list(r.generated) for r in big.finished})
+
+
+def test_growth_cannot_evict_a_validated_lane():
+    """A later lane's pool-growth preemption must never pick a victim
+    already validated into this step's decode batch — the stale pair
+    would decode through a zeroed table row into a requeued request
+    whose generation was just reset."""
+    params = _params()
+
+    def drive(**kw):
+        # low-priority r0 lands in slot 0 first; high-priority r1 joins
+        # in slot 1, and its growth pressure would (pre-fix) evict the
+        # already-validated slot 0 mid-step.  r1 then finishes fast and
+        # frees the pool, so the garbage token the stale lane appended
+        # survives into r0's recomputed output instead of being wiped by
+        # another preemption.
+        eng = _engine(params, policy="priority", max_batch=2,
+                      blocks_per_seq=4, **kw)
+
+        def stream(rid, tok, kind):
+            # every emitted token must belong to a request that occupies
+            # a slot RIGHT NOW — a stale evicted lane fails this no
+            # matter what token value the zeroed table row produces
+            assert any(r is not None and r.rid == rid
+                       for r in eng.sched.slots), (rid, tok, kind)
+
+        eng.stream = stream
+        eng.submit(Request(rid=0, prompt=list(range(1, 9)),
+                           max_new_tokens=4, priority=0))
+        eng.step()
+        eng.submit(Request(rid=1, prompt=list(range(9, 25)),
+                           max_new_tokens=2, priority=5))
+        for _ in range(30):
+            if eng.sched.completed == 2:
+                break
+            eng.step()
+        assert eng.sched.completed == 2
+        return eng
+
+    big = drive(kv_blocks=33)
+    assert big.summary()["preemptions"] == 0
+    tiny = drive(kv_blocks=5)  # 4 usable blocks: r1's growth exhausts
+    assert tiny.summary()["preemptions"] > 0
     assert ({r.rid: list(r.generated) for r in tiny.finished}
             == {r.rid: list(r.generated) for r in big.finished})
 
