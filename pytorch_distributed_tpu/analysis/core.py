@@ -476,7 +476,8 @@ def _image_state(model, grad_compress: str = "none", explicit: bool = False,
                              residual=residual)
 
 
-def _recipe_train_image(explicit: bool, grad_compress: str = "none"):
+def _recipe_train_image(explicit: bool, grad_compress: str = "none",
+                        overlap: str = "none", bucket_mb: float = 4.0):
     import jax.numpy as jnp
 
     from pytorch_distributed_tpu.train.steps import make_train_step
@@ -486,7 +487,8 @@ def _recipe_train_image(explicit: bool, grad_compress: str = "none"):
     state = _image_state(model, grad_compress=grad_compress,
                          explicit=explicit)
     step = make_train_step(model, mesh, explicit_collectives=explicit,
-                           grad_compress=grad_compress)
+                           grad_compress=grad_compress, overlap=overlap,
+                           bucket_mb=bucket_mb)
     return step, (state, _image_batch(), jnp.float32(0.1)), (0,), mesh
 
 
@@ -517,6 +519,41 @@ def _recipe_train_image_zero(grad_compress: str = "none"):
     step = make_train_step(model, mesh, explicit_collectives=True,
                            grad_compress=grad_compress, zero="wus")
     return step, (state, _image_batch(), jnp.float32(0.1)), (0,), mesh
+
+
+def _recipe_lm_overlap(grad_compress: str = "none"):
+    """Explicit shard_map DP LM step under the bucketed comm-overlap
+    scheduler (parallel/overlap.py): the grad sync lowers as per-bucket
+    collectives scope-labeled ``b<k>``, and with ``--grad-compress int8``
+    the compiled wire carries s8 payloads + f32 scales — the HLO-ledger
+    evidence that compression rides the real collectives, not a numerics
+    emulation."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.ops import qcomm
+    from pytorch_distributed_tpu.parallel.tp import replicated_like
+    from pytorch_distributed_tpu.train.lm import make_lm_train_step
+    from pytorch_distributed_tpu.train.optim import sgd_init
+    from pytorch_distributed_tpu.train.state import TrainState
+
+    mesh = _mesh(("data",), (4,))
+    model = TransformerLM(
+        vocab_size=_LM["vocab"], d_model=_LM["d_model"],
+        n_heads=_LM["n_heads"], n_layers=1)
+    tokens = jnp.zeros((_LM["batch"], _LM["seq"]), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    residual = qcomm.init_residual(params, grad_compress, explicit=True,
+                                   n_data=4)
+    state = TrainState.create({"params": params}, sgd_init(params),
+                              residual=residual)
+    # ~8 KiB buckets so even the tiny model splits into several buckets
+    # and the ledger exercises multi-bucket b<k> attribution.
+    step = make_lm_train_step(model, mesh, replicated_like(params),
+                              grad_compress=grad_compress,
+                              overlap="bucketed", bucket_mb=1 / 128)
+    return step, (state, tokens, jnp.float32(0.1)), (0,), mesh
 
 
 def _recipe_train_lm_zero():
@@ -705,6 +742,16 @@ RECIPES: "OrderedDict[str, Callable[[], tuple]]" = OrderedDict([
     # Weight-update sharding (parallel/zero.py): the pinned reduce-scatter
     # / all-gather budgets make an accidental allreduce fallback (or a
     # momentum layout regression) a hard collective-regression error.
+    # Bucketed comm-overlap scheduler (parallel/overlap.py): grad sync
+    # splits into per-bucket collectives (scope b<k>) so each can overlap
+    # the remaining backward.  Bucketing must not change totals — the
+    # pinned budgets fence a bucket-count or per-bucket-bytes drift, and
+    # the int8 variant pins that compression survives onto the real wire.
+    ("train_image_bucketed",
+     lambda: _recipe_train_image(True, overlap="bucketed",
+                                 bucket_mb=1 / 128)),
+    ("lm_train_bucketed", lambda: _recipe_lm_overlap()),
+    ("lm_train_bucketed_int8", lambda: _recipe_lm_overlap("int8")),
     ("train_image_zero", _recipe_train_image_zero),
     ("train_lm_zero", _recipe_train_lm_zero),
     ("eval_image", _recipe_eval_image),

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 from typing import Any, Dict, List, Optional, Sequence
 
 from pytorch_distributed_tpu.analysis import hlo as hlo_mod
@@ -102,6 +103,29 @@ def phase_of_op_name(op_name: str) -> str:
     return "forward"
 
 
+# parallel/overlap.py wraps each gradient bucket's collective in a
+# ``b<k>`` scope (``ag_b<k>`` for the ZeRO delta all-gather buckets), so
+# the compiled op_name carries the bucket index through metadata.
+_BUCKET_SCOPE = re.compile(r"^(?:ag_)?b(\d+)$")
+
+
+def bucket_of_op_name(op_name: str) -> int:
+    """Bucket index of a collective lowered by the bucketed overlap
+    scheduler, or -1 for unbucketed (monolithic) collectives.
+
+    Looks for a ``b<k>`` / ``ag_b<k>`` scope component in the jax scope
+    path — always nested under ``grad_sync``/``optimizer``, so per-phase
+    attribution still sums: bucketing relabels entries within a phase,
+    it never moves bytes across phases."""
+    if not op_name:
+        return -1
+    for p in op_name.split("/"):
+        m = _BUCKET_SCOPE.match(p)
+        if m:
+            return int(m.group(1))
+    return -1
+
+
 @dataclasses.dataclass
 class CommEntry:
     """One collective in the ledger (the attributed receipt line)."""
@@ -118,6 +142,8 @@ class CommEntry:
     # Payload dtype label (wire_encoding_of); defaults keep pre-existing
     # comm_ledger.json files loadable (load_ledgers does CommEntry(**e)).
     wire_encoding: str = "f32"
+    # Overlap-scheduler bucket index (bucket_of_op_name); -1 = monolithic.
+    bucket: int = -1
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -214,7 +240,8 @@ def ledger_from_hlo_text(
             wire_bytes=wire_bytes(d.kind, d.bytes, d.group_size),
             n_groups=d.n_groups, group_size=d.group_size,
             phase=phase_of_op_name(d.op_name), op_name=d.op_name,
-            source=d.source, wire_encoding=wire_encoding_of(d.shapes)))
+            source=d.source, wire_encoding=wire_encoding_of(d.shapes),
+            bucket=bucket_of_op_name(d.op_name)))
     return CommLedger(step=step, mesh_shape=dict(mesh_shape or {}),
                       entries=entries)
 

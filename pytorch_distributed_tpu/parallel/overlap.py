@@ -1,0 +1,291 @@
+"""Comm-overlap scheduler: bucketed backward-overlapped gradient sync.
+
+The reference's Horovod recipe hides the gradient all-reduce behind the
+backward pass via bucketed ring-allreduce (fusion buffers + hooks firing
+as soon as a bucket's gradients are ready).  Our explicit ``shard_map``
+steps so far issued *one* tail-end collective per leaf group under a
+single ``grad_sync`` scope — correct, but fully exposed: the timeline
+analyzer (obs/timeline.py) reports the whole sync as ``exposed_comm_ms``
+because no backward compute remains to hide it under.
+
+This module is the jax expression of the bucketed schedule
+(arXiv:1810.11112 characterizes the overlap-driven design space):
+
+- the gradient pytree is partitioned into ~``bucket_mb``-MiB buckets in
+  **reverse flatten order** — flax param dicts flatten in layer order,
+  so reversed ≈ reverse-autodiff order: the bucket whose cotangents are
+  produced *first* during backward is issued first;
+- each bucket is synced by its own ``psum`` / ``compressed_psum`` under
+  a nested ``grad_sync``/``b<k>`` scope, so XLA's scheduler is free to
+  run bucket k's collective concurrently with the backward compute that
+  produces bucket k+1's cotangents (on hardware with async collectives;
+  the CPU test backend serializes, which is why the A/B fence derives
+  its timelines from the schedule + the real compiled ledger);
+- the math per leaf is **identical** to the monolithic sync — the same
+  per-leaf ``psum`` / EQuARX decomposition, just grouped differently —
+  so bucketed ≡ monolithic is bit-exact, not approximately equal
+  (tests/test_overlap.py pins this for f32/bf16/int8-EF).
+
+Scope labels: collectives land under ``.../grad_sync/b<k>/...`` op
+names.  ``obs.comms.phase_of_op_name`` matches path *components*, so the
+phase stays ``grad_sync`` (per-phase attribution still sums) and the new
+``bucket`` ledger field recovers the index (``obs.comms.bucket_of_op_name``).
+
+The ZeRO-WUS analogue (parallel/zero.py) splits the same way: bucketed
+reduce-scatter here, bucketed delta all-gather in
+``zero.wus_apply_updates(..., bucket_mb=...)``, and the *deferred* form
+(``wus_gather="deferred"`` in train/steps.py) double-buffers the param
+state through ``TrainState.momentum["pending"]`` so step t's delta
+gather overlaps step t+1's forward.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.ops import qcomm
+
+Pytree = Any
+
+MODES = ("none", "bucketed")
+DEFAULT_BUCKET_MB = 4.0
+_MIB = float(1 << 20)
+
+
+def resolve_overlap(overlap: Optional[str]) -> str:
+    """Canonical overlap mode from the CLI/config value (None -> "none")."""
+    mode = overlap if overlap is not None else "none"
+    if mode not in MODES:
+        raise ValueError(f"overlap must be one of {MODES}, got {mode!r}")
+    return mode
+
+
+def _leaf_bytes(leaf) -> int:
+    size = int(math.prod(jnp.shape(leaf))) if jnp.shape(leaf) else 1
+    try:
+        item = jnp.dtype(leaf.dtype).itemsize
+    except Exception:
+        item = 4
+    return size * item
+
+
+def plan_buckets(tree: Pytree, bucket_mb: float = DEFAULT_BUCKET_MB,
+                 ) -> List[List[int]]:
+    """Partition a pytree's flat leaves into reverse-order byte buckets.
+
+    Returns a list of leaf-index lists covering every leaf exactly once.
+    Bucket 0 holds the *last* leaves of the flatten order (the first
+    gradients autodiff produces); a bucket closes once it has accumulated
+    ``bucket_mb`` MiB, except that a single oversized leaf still gets its
+    own bucket (leaves are never split — the per-leaf collective math
+    must stay identical to the monolithic path).  Deterministic: a pure
+    function of the leaf shapes/dtypes and ``bucket_mb``.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return []
+    if bucket_mb <= 0:
+        raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
+    budget = bucket_mb * _MIB
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    acc = 0.0
+    for i in reversed(range(len(leaves))):
+        cur.append(i)
+        acc += _leaf_bytes(leaves[i])
+        if acc >= budget:
+            buckets.append(cur)
+            cur, acc = [], 0.0
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def n_buckets(tree: Pytree, bucket_mb: float = DEFAULT_BUCKET_MB) -> int:
+    return len(plan_buckets(tree, bucket_mb))
+
+
+def _split_by_buckets(leaves: Sequence[Any],
+                      buckets: Sequence[Sequence[int]]) -> List[List[Any]]:
+    return [[leaves[i] for i in bucket] for bucket in buckets]
+
+
+def _scatter_back(n: int, buckets: Sequence[Sequence[int]],
+                  per_bucket: Sequence[Sequence[Any]]) -> List[Any]:
+    out: List[Any] = [None] * n
+    for bucket, vals in zip(buckets, per_bucket):
+        for i, v in zip(bucket, vals):
+            out[i] = v
+    return out
+
+
+def bucketed_psum(
+    grads: Pytree,
+    residual: Pytree,
+    axis_name: str,
+    *,
+    mode: str = "none",
+    cast_dtype=None,
+    bucket_mb: float = DEFAULT_BUCKET_MB,
+    block: int = qcomm.DEFAULT_BLOCK,
+) -> Tuple[Pytree, Pytree]:
+    """Bucketed gradient all-reduce inside ``shard_map``.
+
+    Drop-in replacement for the monolithic body of train/steps.py's
+    ``sync_grads`` (minus the count psum / normalization, which the
+    caller keeps): per bucket, ``mode in QUANTIZED_MODES`` rides
+    ``qcomm.compressed_psum`` (error-feedback residual threaded through),
+    otherwise an optional ``cast_dtype`` wire cast + ``jax.lax.psum``.
+    Per-leaf results are bit-identical to the single-call path — psum
+    batches leaves into one HLO op per call, so bucketing only changes
+    the op *grouping*, never the per-leaf reduction.
+    """
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    buckets = plan_buckets(grads, bucket_mb)
+    use_ef = (mode in qcomm.QUANTIZED_MODES
+              and len(jax.tree_util.tree_leaves(residual)) > 0)
+    r_leaves = (jax.tree_util.tree_leaves(residual) if use_ef
+                else [None] * len(g_leaves))
+    if use_ef and len(r_leaves) != len(g_leaves):
+        raise ValueError("residual tree does not match the gradient tree")
+
+    out_g: List[List[Any]] = []
+    out_r: List[List[Any]] = []
+    for k, bucket in enumerate(buckets):
+        gs = [g_leaves[i] for i in bucket]
+        with jax.named_scope(f"b{k}"):
+            if mode in qcomm.QUANTIZED_MODES:
+                rs = [r_leaves[i] for i in bucket] if use_ef else {}
+                synced, new_rs = qcomm.compressed_psum(
+                    gs, rs, axis_name, mode=mode, block=block)
+                out_g.append(synced)
+                out_r.append(new_rs if use_ef else [None] * len(bucket))
+            else:
+                if cast_dtype is not None:
+                    gs = [g.astype(cast_dtype) for g in gs]
+                out_g.append(jax.lax.psum(gs, axis_name))
+                out_r.append([None] * len(bucket))
+
+    synced_leaves = _scatter_back(len(g_leaves), buckets, out_g)
+    synced = jax.tree_util.tree_unflatten(treedef, synced_leaves)
+    if use_ef:
+        new_res = jax.tree_util.tree_unflatten(
+            treedef, _scatter_back(len(g_leaves), buckets, out_r))
+    else:
+        new_res = residual
+    return synced, new_res
+
+
+def bucketed_reduce_scatter(
+    grads: Pytree,
+    residual: Pytree,
+    axis_name: str,
+    n: int,
+    *,
+    mode: str = "none",
+    cast_dtype=None,
+    bucket_mb: float = DEFAULT_BUCKET_MB,
+    block: int = qcomm.DEFAULT_BLOCK,
+) -> Tuple[Pytree, Pytree]:
+    """Bucketed gradient reduce-scatter for the ZeRO-WUS path.
+
+    Same bucketing/scoping as :func:`bucketed_psum`, over
+    ``zero.reduce_scatter_grads`` (f32/bf16 wire) or
+    ``qcomm.compressed_reduce_scatter`` (int8/fp8 + EF) per bucket.
+    Returns flat ``(chunk,)`` sum leaves exactly like the monolithic
+    helpers — chunk layout is per-leaf, so bucketing cannot move it.
+    """
+    from pytorch_distributed_tpu.parallel import zero as zero_lib
+
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    buckets = plan_buckets(grads, bucket_mb)
+    use_ef = (mode in qcomm.QUANTIZED_MODES
+              and len(jax.tree_util.tree_leaves(residual)) > 0)
+    r_leaves = (jax.tree_util.tree_leaves(residual) if use_ef
+                else [None] * len(g_leaves))
+
+    out_g: List[List[Any]] = []
+    out_r: List[List[Any]] = []
+    for k, bucket in enumerate(buckets):
+        gs = [g_leaves[i] for i in bucket]
+        with jax.named_scope(f"b{k}"):
+            if mode in qcomm.QUANTIZED_MODES:
+                rs = [r_leaves[i] for i in bucket] if use_ef else {}
+                chunks, new_rs = qcomm.compressed_reduce_scatter(
+                    gs, rs, axis_name, mode=mode, block=block)
+                out_g.append(chunks)
+                out_r.append(new_rs if use_ef else [None] * len(bucket))
+            else:
+                out_g.append(zero_lib.reduce_scatter_grads(
+                    gs, axis_name, n, cast_dtype=cast_dtype, block=block))
+                out_r.append([None] * len(bucket))
+
+    chunk_leaves = _scatter_back(len(g_leaves), buckets, out_g)
+    chunks = jax.tree_util.tree_unflatten(treedef, chunk_leaves)
+    if use_ef:
+        new_res = jax.tree_util.tree_unflatten(
+            treedef, _scatter_back(len(g_leaves), buckets, out_r))
+    else:
+        new_res = residual
+    return chunks, new_res
+
+
+# ------------------------------------------- deferred WUS gather (2-buffer)
+
+def init_pending(params: Pytree, n_data: int,
+                 block: int = qcomm.DEFAULT_BLOCK) -> Pytree:
+    """Zero pending-delta chunks for the deferred WUS gather: stacked
+    ``(n_data, chunk)`` leaves (the ``init_wus_momentum`` layout), carried
+    in ``momentum["pending"]`` and sharded ``P(data_axis)``.  Zeros make
+    the first step's head-of-step gather a mathematical no-op."""
+    from pytorch_distributed_tpu.parallel import zero as zero_lib
+
+    return zero_lib.init_wus_momentum(params, n_data, block=block)["buf"]
+
+
+def drain_pending(params: Pytree, pending: Pytree, axis_name: str, *,
+                  cast_dtype=None) -> Pytree:
+    """Gather + apply the previous step's staged delta chunks (in-graph,
+    per-rank).  Runs at the *head* of the step under a ``param_gather``
+    scope, so in dataflow terms layer k's gather only blocks layer k's
+    forward — the double-buffered overlap window.  Returns the live
+    params; the staged chunks it consumed should be replaced by the new
+    step's deltas (``train/steps.py`` wires this)."""
+    def apply_one(p, d):
+        wire = d if cast_dtype is None else d.astype(cast_dtype)
+        flat = jax.lax.all_gather(wire, axis_name, tiled=True).astype(
+            jnp.float32).reshape(-1)
+        delta = flat[: p.size].reshape(p.shape)
+        return (p.astype(jnp.float32) - delta).astype(p.dtype)
+
+    with jax.named_scope("param_gather"):
+        return jax.tree_util.tree_map(
+            apply_one, params,
+            jax.tree_util.tree_map(lambda d: d.reshape(-1), pending))
+
+
+def materialize_params(params: Pytree, pending: Pytree, *,
+                       cast_dtype=None) -> Pytree:
+    """Host-side (numpy) drain of staged deltas: the checkpoint/eval view
+    of a deferred-gather state.  ``pending`` leaves are stacked
+    ``(n_data, chunk)`` — the full delta is just the chunks concatenated,
+    so no collective is needed; ``cast_dtype`` replays the wire cast the
+    in-graph gather would have applied, keeping the two drains bit-equal.
+    """
+    import numpy as np
+
+    def m(p, d):
+        flat = np.asarray(d, np.float32).reshape(-1)
+        if cast_dtype is not None:
+            flat = flat.astype(jnp.dtype(cast_dtype)).astype(np.float32)
+        shape = np.shape(p)
+        size = int(np.prod(shape, dtype=np.int64))
+        delta = flat[:size].reshape(shape)
+        base = np.asarray(p, np.float32)
+        return (base - delta).astype(np.asarray(p).dtype)
+
+    return jax.tree_util.tree_map(m, params, pending)

@@ -88,12 +88,17 @@ def pipeline_apply(
             # vs the ring hop instead of anonymous fusions (obs/trace.py).
             with jax.named_scope("pp_stage_fwd"):
                 y = stage_fn(params_local, cur)
+            # Double-buffered hop (parallel/overlap.py design): issue the
+            # ring transfer the moment `y` exists — the output-collection
+            # ops below don't read `buf_next`, so the ppermute overlaps
+            # them instead of serializing at the tick boundary.  Pure
+            # reorder: bit-exact.
+            with jax.named_scope("pp_hop"):
+                buf_next = jax.lax.ppermute(y, pipe_axis, perm)
             # Last stage's finished microbatch index at tick t is t-(P-1).
             out_idx = t - (n_stages - 1)
             is_out = jnp.logical_and(idx == n_stages - 1, out_idx >= 0)
             out_contrib = jnp.where(is_out, y, jnp.zeros_like(y))
-            with jax.named_scope("pp_hop"):
-                buf_next = jax.lax.ppermute(y, pipe_axis, perm)
             return buf_next, (out_contrib, out_idx)
 
         buf0 = jnp.zeros_like(micro_local[0])

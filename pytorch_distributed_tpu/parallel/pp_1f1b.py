@@ -129,6 +129,13 @@ def pipeline_1f1b_loss_and_grads(
             # per-tick self-time to fwd/head/bwd/hop (obs/trace.py).
             with jax.named_scope("pp1f1b_fwd"):
                 y = stage_fn(params_local, cur)
+            # Double-buffered forward hop (parallel/overlap.py design):
+            # issue the ring transfer the moment `y` exists — the head and
+            # backward phases below don't read `fbuf_next`, so the
+            # ppermute overlaps a full tick of compute instead of
+            # serializing at the tick boundary.  Pure reorder: bit-exact.
+            with jax.named_scope("pp_hop"):
+                fbuf_next = jax.lax.ppermute(y, pipe_axis, perm_fwd)
 
             # ---- loss head: last stage, same tick its forward retires ----
             # lax.cond so only the last stage pays the head (vocab-matmul
@@ -172,7 +179,6 @@ def pipeline_1f1b_loss_and_grads(
             )
 
             with jax.named_scope("pp_hop"):
-                fbuf_next = jax.lax.ppermute(y, pipe_axis, perm_fwd)
                 bbuf_next = jax.lax.ppermute(dx_m, pipe_axis, perm_bwd)
             return (fbuf_next, bbuf_next, stash, g_stage, g_head, d_micro,
                     loss_sum, correct_sum), None

@@ -354,6 +354,13 @@ def interleaved_pipeline_loss_and_grads(
             # per-tick self-time to fwd/head/bwd/hop (obs/trace.py).
             with jax.named_scope("ppint_fwd"):
                 y = stage_fn(chunk_of(params_local, fk), x_in)
+            # Double-buffered forward hop (parallel/overlap.py design):
+            # `y` is final here — issuing its ring transfer before the
+            # head/backward phases lets the ppermute overlap a full tick
+            # of compute instead of serializing at the tick boundary.
+            # Pure reorder: bit-exact.
+            with jax.named_scope("pp_hop"):
+                vin_f_next = jax.lax.ppermute(y, pipe_axis, ring_fwd)
             stash = jnp.where(fa == 1, stash.at[fsl].set(x_in), stash)
             # head: producing global chunk C-1 = (V-1)*P + (P-1)
             is_last = jnp.logical_and(idx == last_dev, fk == V - 1)
@@ -400,7 +407,6 @@ def interleaved_pipeline_loss_and_grads(
                 d_micro,
             )
             with jax.named_scope("pp_hop"):
-                vin_f_next = jax.lax.ppermute(y, pipe_axis, ring_fwd)
                 vin_b_next = jax.lax.ppermute(dx_m, pipe_axis, ring_bwd)
             return (vin_f_next, vin_b_next, inbox_f, inbox_b, stash,
                     g_chunks, g_head, d_micro, loss_sum, correct_sum), None

@@ -88,9 +88,12 @@ def init_wus_momentum(params: Pytree, n_data: int, quantized: bool = False,
 
 def is_wus_momentum(momentum: Pytree) -> bool:
     """True when ``momentum`` carries the stacked-chunk WUS layout (the
-    checkpoint layer keys gather-on-save / shard-on-restore off this)."""
+    checkpoint layer keys gather-on-save / shard-on-restore off this).
+    ``pending`` is the deferred-gather double buffer (parallel/overlap.py)
+    — like ``agerr`` it is transient wire state, dropped on gather; a
+    deferred state must be materialized before checkpointing."""
     return (isinstance(momentum, dict) and "buf" in momentum
-            and set(momentum) <= {"buf", "agerr"})
+            and set(momentum) <= {"buf", "agerr", "pending"})
 
 
 def gather_momentum(momentum: Pytree, params: Pytree) -> Pytree:
@@ -150,6 +153,118 @@ def reduce_scatter_grads(grads: Pytree, axis_name: str, n: int,
     return jax.tree_util.tree_map(rs, grads)
 
 
+def wus_update_chunks(
+    params: Pytree,
+    momentum: Pytree,
+    grad_chunks: Pytree,
+    lr,
+    idx,
+    n: int,
+    momentum_coef: float = 0.9,
+    weight_decay: float = 1e-4,
+    block: int = qcomm.DEFAULT_BLOCK,
+) -> Tuple[Pytree, Pytree]:
+    """The compute half of the WUS step: torch-parity SGD (train/optim.py
+    ``_upd``) on this rank's flat 1/N chunk — ``g += wd*p; buf = mu*buf
+    + g; delta = lr*buf`` — with no collective.  Returns ``(delta_tree,
+    new_buf_tree)`` of flat per-rank chunks; the wire half is
+    :func:`wus_gather_deltas` (eager) or the overlap scheduler's deferred
+    gather (parallel/overlap.py)."""
+    buf = momentum["buf"]
+    p_leaves, treedef = jax.tree_util.tree_flatten(params)
+    b_leaves = jax.tree_util.tree_leaves(buf)
+    g_leaves = jax.tree_util.tree_leaves(grad_chunks)
+    if not (len(p_leaves) == len(b_leaves) == len(g_leaves)):
+        raise ValueError("wus_update_chunks: params / momentum['buf'] / "
+                         "grad chunk trees do not match")
+
+    deltas, new_buf = [], []
+    for p, b, g in zip(p_leaves, b_leaves, g_leaves):
+        pc = _own_chunk(p, idx, n, block)
+        b0 = b.reshape(pc.shape)
+        g = g.reshape(pc.shape) + weight_decay * pc
+        b1 = momentum_coef * b0 + g
+        deltas.append(lr * b1)
+        new_buf.append(b1.reshape(b.shape))
+    return (jax.tree_util.tree_unflatten(treedef, deltas),
+            jax.tree_util.tree_unflatten(treedef, new_buf))
+
+
+def wus_gather_deltas(
+    delta_tree: Pytree,
+    agerr: Optional[Pytree],
+    params: Pytree,
+    axis_name: str,
+    mode: str = "none",
+    cast_dtype=None,
+    block: int = qcomm.DEFAULT_BLOCK,
+    bucket_mb: Optional[float] = None,
+) -> Tuple[Pytree, Optional[Pytree]]:
+    """The wire half of the WUS step: all-gather the per-rank delta chunks
+    back to full leaves (f32, bf16 wire, or the quantized qcomm path with
+    error feedback in ``agerr``).
+
+    ``bucket_mb``: when set, leaves are gathered in ~MiB-sized groups
+    under nested ``ag_b<k>`` scopes (forward flatten order — layer k's
+    params unblock layer k's next forward first), so XLA may interleave
+    each group's gather with the update compute of later groups.  Per
+    leaf the collective is identical either way, so bucketing never
+    changes the gathered values.  Returns ``(full_delta_tree,
+    new_agerr_or_None)``."""
+    if mode in qcomm.QUANTIZED_MODES:
+        def gather(ds, es, ps):
+            full, new_e = qcomm.compressed_all_gather(
+                ds, es if es is not None else {}, axis_name, ps,
+                mode=mode, block=block)
+            return full, (new_e if es is not None else
+                          [None] * len(jax.tree_util.tree_leaves(ds)))
+    else:
+        def gather(ds, es, ps):
+            def ag(d, p):
+                wire = d if cast_dtype is None else d.astype(cast_dtype)
+                flat = jax.lax.all_gather(wire, axis_name).astype(
+                    jnp.float32).reshape(-1)
+                return flat[: p.size].reshape(p.shape)
+
+            return ([ag(d, p) for d, p in zip(ds, ps)],
+                    es if es is not None else
+                    [None] * len(jax.tree_util.tree_leaves(ds)))
+
+    d_leaves, treedef = jax.tree_util.tree_flatten(delta_tree)
+    p_leaves = jax.tree_util.tree_leaves(params)
+    use_ef = agerr is not None and len(jax.tree_util.tree_leaves(agerr)) > 0
+    e_leaves = (jax.tree_util.tree_leaves(agerr) if use_ef
+                else [None] * len(d_leaves))
+
+    if bucket_mb is None:
+        full, new_e = gather(d_leaves, e_leaves if use_ef else None, p_leaves)
+        full_leaves, e_out = list(full), list(new_e)
+    else:
+        from pytorch_distributed_tpu.parallel import overlap as overlap_lib
+
+        buckets = overlap_lib.plan_buckets(params, bucket_mb)
+        # gather buckets in forward order: the reverse-autodiff bucket
+        # order of the sync is wrong here — the *next* forward consumes
+        # layer 0's params first.
+        buckets = list(reversed(buckets))
+        full_leaves = [None] * len(d_leaves)
+        e_out = [None] * len(d_leaves)
+        for k, bucket in enumerate(buckets):
+            with jax.named_scope(f"ag_b{k}"):
+                full, new_e = gather(
+                    [d_leaves[i] for i in bucket],
+                    [e_leaves[i] for i in bucket] if use_ef else None,
+                    [p_leaves[i] for i in bucket])
+            for i, f, e in zip(bucket, full, new_e):
+                full_leaves[i] = f
+                e_out[i] = e
+
+    full_tree = jax.tree_util.tree_unflatten(treedef, full_leaves)
+    new_agerr = (jax.tree_util.tree_unflatten(treedef, e_out) if use_ef
+                 else agerr)
+    return full_tree, new_agerr
+
+
 def wus_apply_updates(
     params: Pytree,
     momentum: Pytree,
@@ -163,53 +278,32 @@ def wus_apply_updates(
     mode: str = "none",
     cast_dtype=None,
     block: int = qcomm.DEFAULT_BLOCK,
+    bucket_mb: Optional[float] = None,
 ) -> Tuple[Pytree, Pytree]:
     """The 1/N-shard weight update + param all-gather (runs per-rank).
 
-    Torch-parity SGD (train/optim.py ``_upd``) on this rank's flat chunk:
-    ``g += wd*p; buf = mu*buf + g; delta = lr*buf`` — then the *delta*
-    chunks are all-gathered (f32, bf16 wire, or the quantized qcomm path
-    with error feedback in ``momentum["agerr"]``) and applied to the
-    replicated params on every rank, so replicas stay bit-identical.
+    Composition of :func:`wus_update_chunks` (chunked SGD) and
+    :func:`wus_gather_deltas` (delta all-gather — f32, bf16 wire, or the
+    quantized qcomm path with error feedback in ``momentum["agerr"]``);
+    the gathered delta is applied to the replicated params on every rank,
+    so replicas stay bit-identical.  ``bucket_mb`` opts the gather into
+    the overlap scheduler's ~MiB bucketing (values unchanged; see
+    :func:`wus_gather_deltas`).
 
     Returns ``(new_params, new_momentum)`` with momentum in the stacked
     layout (``(1, chunk)`` per-rank slots inside shard_map).
     """
-    buf = momentum["buf"]
     agerr = momentum.get("agerr")
+    delta_tree, new_buf = wus_update_chunks(
+        params, momentum, grad_chunks, lr, idx, n,
+        momentum_coef=momentum_coef, weight_decay=weight_decay, block=block)
 
-    p_leaves, treedef = jax.tree_util.tree_flatten(params)
-    b_leaves = jax.tree_util.tree_leaves(buf)
-    g_leaves = jax.tree_util.tree_leaves(grad_chunks)
-    if not (len(p_leaves) == len(b_leaves) == len(g_leaves)):
-        raise ValueError("wus_apply_updates: params / momentum['buf'] / "
-                         "grad chunk trees do not match")
-
-    deltas, new_buf = [], []
-    for p, b, g in zip(p_leaves, b_leaves, g_leaves):
-        pc = _own_chunk(p, idx, n, block)
-        b0 = b.reshape(pc.shape)
-        g = g.reshape(pc.shape) + weight_decay * pc
-        b1 = momentum_coef * b0 + g
-        deltas.append(lr * b1)
-        new_buf.append(b1.reshape(b.shape))
-    delta_tree = jax.tree_util.tree_unflatten(treedef, deltas)
-
-    new_momentum = {"buf": jax.tree_util.tree_unflatten(treedef, new_buf)}
-    if mode in qcomm.QUANTIZED_MODES:
-        full, new_agerr = qcomm.compressed_all_gather(
-            delta_tree, agerr, axis_name, params, mode=mode, block=block)
+    new_momentum = {"buf": new_buf}
+    full, new_agerr = wus_gather_deltas(
+        delta_tree, agerr, params, axis_name, mode=mode,
+        cast_dtype=cast_dtype, block=block, bucket_mb=bucket_mb)
+    if new_agerr is not None:
         new_momentum["agerr"] = new_agerr
-    else:
-        def ag(d, p):
-            wire = d if cast_dtype is None else d.astype(cast_dtype)
-            flat = jax.lax.all_gather(wire, axis_name).astype(
-                jnp.float32).reshape(-1)
-            return flat[: p.size].reshape(p.shape)
-
-        full = jax.tree_util.tree_map(ag, delta_tree, params)
-        if agerr is not None:
-            new_momentum["agerr"] = agerr
 
     new_params = jax.tree_util.tree_map(
         lambda p, d: (p.astype(jnp.float32) - d).astype(p.dtype),

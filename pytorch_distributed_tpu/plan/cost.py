@@ -80,6 +80,34 @@ def step_cost_for(plan: Plan) -> flops.StepCost:
                               remat=plan.remat)
 
 
+def bucketed_overlap(grad_bytes: float, bucket_mb: float = 4.0,
+                     max_overlap: float = 0.95) -> float:
+    """Schedule-derived backward-overlap fraction for the bucketed
+    comm-overlap scheduler (parallel/overlap.py) — the replacement for
+    the assumed ``DEFAULT_OVERLAP`` guess when ``--overlap bucketed``
+    is actually in the recipe.
+
+    With ``K = ceil(grad_bytes / bucket_mb·MiB)`` reverse-autodiff
+    buckets, every bucket's collective except the final one is issued
+    while backward compute remains, so the hideable fraction is
+    ``(K-1)/K`` — capped at ``max_overlap`` because the tail bucket (and
+    ramp effects) always stay exposed."""
+    import math
+
+    if bucket_mb <= 0:
+        raise ValueError(f"bucket_mb must be > 0, got {bucket_mb}")
+    k = max(1, math.ceil(float(grad_bytes) / (bucket_mb * 1024.0 * 1024.0)))
+    return min(max_overlap, (k - 1) / k)
+
+
+def spec_bucketed_overlap(spec: ModelSpec, bucket_mb: float = 4.0) -> float:
+    """``bucketed_overlap`` over a spec's full f32 gradient bytes (the
+    DP sync payload before any tp/pp sharding — the conservative,
+    plan-independent schedule estimate the autoplan CLI uses)."""
+    plan = Plan(spec=spec, chips=1)
+    return bucketed_overlap(4.0 * step_cost_for(plan).params, bucket_mb)
+
+
 # --------------------------------------------------------------- comms
 
 @dataclasses.dataclass(frozen=True)

@@ -83,12 +83,17 @@ def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
              top_k: int = 5, elastic: bool = True, validate: bool = False,
              validate_k: int = 3, hbm_budget: Optional[float] = None,
              overlap: Optional[float] = None,
+             overlap_source: Optional[str] = None,
              spec: Optional[ModelSpec] = None) -> Dict[str, Any]:
     """The full pipeline for one (model, world size).  Returns the
     ``plan.json`` payload; never imports jax unless ``validate=True``.
 
     ``overlap`` replaces the assumed backward-overlap fraction with a
-    measured one (0-1); the payload records which was used."""
+    measured one (0-1); the payload records which was used.
+    ``overlap_source`` overrides that provenance label — the autoplan
+    CLI passes ``"schedule"`` when the value came from the bucketed
+    overlap model (``cost.bucketed_overlap``) rather than a profiler
+    measurement."""
     if spec is None:
         if model not in MODELS:
             raise KeyError(f"unknown model {model!r}; known: "
@@ -106,7 +111,9 @@ def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
                "hbm_bytes": hw.hbm_bytes, "link_bytes": hw.link_bytes},
         "overlap": (cost_mod.DEFAULT_OVERLAP if overlap is None
                     else float(overlap)),
-        "overlap_source": "assumed" if overlap is None else "measured",
+        "overlap_source": (overlap_source if overlap_source is not None
+                           else ("assumed" if overlap is None
+                                 else "measured")),
         "enumerated": len(ranked) + sum(pruned.values()),
         "feasible": len(ranked),
         "pruned": pruned,

@@ -139,7 +139,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "round-trip cast, or int8/fp8 block quantization "
                         "with error feedback.  The LM step is GSPMD, so "
                         "quantized modes run as a numerics emulation "
-                        "(wire bytes unchanged; convergence effects real)")
+                        "under the default GSPMD step (wire bytes "
+                        "unchanged; convergence effects real) — add "
+                        "--overlap bucketed on a pure-DP run to switch to "
+                        "the explicit shard_map step where the wire "
+                        "really carries the compressed collectives")
+    p.add_argument("--overlap", choices=("none", "bucketed"),
+                   default="none",
+                   help="comm-overlap scheduler (parallel/overlap.py): "
+                        "bucketed runs the pure-DP step as explicit "
+                        "shard_map collectives with ~--bucket-mb MiB "
+                        "reverse-autodiff grad buckets, each issued as "
+                        "its own psum so sync overlaps the remaining "
+                        "backward; bit-equal numerics.  Pure DP only "
+                        "(no --tp/--sp/--pp/--fsdp/--fused-ce/"
+                        "--accum-steps/--zero/--elastic)")
+    p.add_argument("--bucket-mb", type=float, default=4.0,
+                   dest="bucket_mb", metavar="MIB",
+                   help="target gradient bucket size in MiB for --overlap "
+                        "bucketed (smaller = more overlap, more "
+                        "collectives)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("-p", "--print-freq", type=int, default=10)
     p.add_argument("--checkpoint-dir", type=str, default=None)
@@ -348,6 +367,13 @@ def main(argv=None) -> float:
     if not args.elastic and args.rescale_lr != "none":
         raise SystemExit("--rescale-lr applies to elastic world changes; "
                          "add --elastic")
+    if args.overlap == "bucketed" and (
+            args.tp > 1 or args.sp > 1 or args.ep > 1 or args.pp > 1
+            or args.fsdp or args.fused_ce or args.accum_steps > 1
+            or args.zero != "none" or args.elastic):
+        raise SystemExit("--overlap bucketed runs the explicit shard_map "
+                         "pure-DP step only; drop --tp/--sp/--ep/--pp/"
+                         "--fsdp/--fused-ce/--accum-steps/--zero/--elastic")
     if args.sp_impl == "a2a" and args.sp > 1:
         if args.pp > 1:
             raise SystemExit("--sp-impl a2a does not run inside pipeline "
@@ -523,6 +549,8 @@ def main(argv=None) -> float:
             preempt=guard,
             grad_compress=args.grad_compress,
             zero=args.zero,
+            overlap=args.overlap,
+            bucket_mb=args.bucket_mb,
             elastic=(ElasticSim(dict(mesh.shape).get("data", 1),
                                 min_ranks=args.min_ranks)
                      if args.elastic else None),

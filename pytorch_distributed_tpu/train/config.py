@@ -65,6 +65,12 @@ class Config:
     # cost.  None = "recipe decides" (all recipes currently default to the
     # replicated-DP "none"), mirroring the grad_compress convention.
     zero: Optional[str] = None
+    # Comm-overlap scheduler (parallel/overlap.py): "bucketed" splits the
+    # explicit grad sync into ~bucket_mb-MiB reverse-autodiff buckets so
+    # each bucket's collective can run concurrently with the remaining
+    # backward (bit-equal numerics; requires the explicit-collectives step).
+    overlap: str = "none"
+    bucket_mb: float = 4.0
     accum_steps: int = 1
     local_rank: int = -1  # launch-line parity only; unused on TPU
     image_size: int = 224
@@ -254,6 +260,19 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "delta — ~(N-1)/N of optimizer+gradient bytes reclaimed "
                    "per device; composes with --grad-compress (both wire "
                    "hops quantized); unset = recipe default (none)")
+    p.add_argument("--overlap", default=d.overlap,
+                   choices=("none", "bucketed"),
+                   help="comm-overlap scheduler (parallel/overlap.py): "
+                   "bucketed splits the explicit grad sync into "
+                   "~--bucket-mb MiB reverse-autodiff buckets issued as "
+                   "separate collectives that overlap the remaining "
+                   "backward; bit-equal numerics (requires the "
+                   "explicit-collectives step — horovod recipe, or "
+                   "lm_pretrain pure-DP)")
+    p.add_argument("--bucket-mb", default=d.bucket_mb, type=float,
+                   dest="bucket_mb", metavar="MIB",
+                   help="target gradient bucket size in MiB for --overlap "
+                   "bucketed (smaller = more overlap, more collectives)")
     p.add_argument("--resume", default=d.resume, type=str, metavar="PATH",
                    help="path to checkpoint to resume from")
     p.add_argument("--checkpoint-dir", default=d.checkpoint_dir, type=str,

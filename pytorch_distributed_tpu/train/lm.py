@@ -212,6 +212,9 @@ def make_lm_train_step(
     grad_compress: Optional[str] = None,
     zero: str = "none",
     params=None,
+    overlap: str = "none",
+    bucket_mb: float = 4.0,
+    explicit_collectives: bool = False,
 ):
     """Jitted LM step; ``param_specs`` is a PartitionSpec pytree from
     parallel/tp.py (``replicated_like`` for pure DP, ``tp_specs`` for TP).
@@ -242,11 +245,24 @@ def make_lm_train_step(
     ``nonfinite_flag``/``gate_update``; policy in ft/divergence.py).
 
     ``grad_compress``: gradient-sync compression mode (ops/qcomm.py,
-    ``none | bf16 | int8 | fp8``).  The LM step is pure GSPMD — XLA owns
-    the gradient psum — so quantized modes run as a *numerics emulation*
+    ``none | bf16 | int8 | fp8``).  Under the default GSPMD step XLA owns
+    the gradient psum, so quantized modes run as a *numerics emulation*
     (fake-quantize + error feedback applied to the already-synced global
-    gradient; wire bytes unchanged).  True wire compression lives in the
-    explicit-collectives image path (train/steps.py).
+    gradient; wire bytes unchanged).  ``explicit_collectives=True`` (or
+    ``overlap='bucketed'``, which implies it) switches pure-DP meshes onto
+    the explicit ``shard_map`` step where the hand-written
+    ``psum``/``compressed_psum`` carries the *real* int8/bf16 wire —
+    the LM counterpart of the image path's wire transformation.
+
+    ``overlap``: ``none | bucketed`` — the comm-overlap scheduler
+    (parallel/overlap.py).  ``bucketed`` partitions the grad pytree into
+    ~``bucket_mb``-MiB buckets in reverse-autodiff order and issues one
+    collective per bucket under nested ``grad_sync``/``b<k>`` scopes, so
+    early-bucket sync can run concurrently with the remaining backward;
+    per-leaf math is identical, so results are bit-equal to monolithic
+    sync.  Requires a pure data-parallel mesh with replicated params
+    (no TP / pipeline / fused-CE / accum / wus — those stay on their
+    existing paths).
 
     ``zero='wus'`` (parallel/zero.py): momentum leaves take data-axis
     ``fsdp_specs`` shardings (``zero_momentum_specs``, composed over
@@ -254,9 +270,34 @@ def make_lm_train_step(
     update math is untouched — XLA derives the weight-update sharding
     from the layout alone.  Per-device optimizer bytes drop to ~1/N;
     ``params`` (the concrete param tree) is required to size the specs."""
+    from pytorch_distributed_tpu.parallel import overlap as overlap_lib
     from pytorch_distributed_tpu.parallel import zero as zero_lib
 
     zero_mode = zero_lib.resolve_zero(zero)
+    overlap_mode = overlap_lib.resolve_overlap(overlap)
+    if explicit_collectives or overlap_mode == "bucketed":
+        manual = getattr(model, "has_manual_grads", lambda: False)()
+        unsupported = [
+            ("the 1F1B pipeline's manual-gradient schedule", manual),
+            (f"accum_steps={accum_steps}", accum_steps > 1),
+            (f"fused_ce_chunks={fused_ce_chunks}", bool(fused_ce_chunks)),
+            (f"zero={zero_mode!r} (use the image trainer's explicit wus "
+             "path)", zero_mode != "none"),
+        ]
+        bad = [what for what, cond in unsupported if cond]
+        if bad:
+            raise ValueError(
+                "the explicit-collectives LM step (overlap/"
+                "explicit_collectives) supports the plain pure-DP step "
+                "only; got " + "; ".join(bad))
+        gc_mode, gc_cast = qcomm.resolve_mode(grad_compress, None)
+        return _make_lm_train_step_explicit(
+            model, mesh, param_specs, momentum=momentum,
+            weight_decay=weight_decay, data_axis=data_axis,
+            clip_grad_norm=clip_grad_norm, log_norms=log_norms,
+            guard_nonfinite=guard_nonfinite, gc_mode=gc_mode,
+            gc_cast=gc_cast, overlap_mode=overlap_mode,
+            bucket_mb=bucket_mb)
     mom_specs = None
     if zero_mode == "wus":
         if params is None:
@@ -464,8 +505,160 @@ def make_lm_train_step(
     )
 
 
+def _make_lm_train_step_explicit(
+    model,
+    mesh: Mesh,
+    param_specs,
+    *,
+    momentum: float,
+    weight_decay: float,
+    data_axis: str,
+    clip_grad_norm: float,
+    log_norms: bool,
+    guard_nonfinite: bool,
+    gc_mode: str,
+    gc_cast,
+    overlap_mode: str,
+    bucket_mb: float,
+):
+    """Explicit ``shard_map`` DP LM step — the wire-transformation half of
+    the overlap scheduler (parallel/overlap.py).
+
+    Pure data parallelism with replicated params: each shard computes its
+    local mean loss and grads, the hand-written ``psum`` /
+    ``compressed_psum`` syncs them (so ``grad_compress`` compresses the
+    *actual* wire, unlike the GSPMD emulation), and
+    ``overlap='bucketed'`` splits the sync into reverse-autodiff-ordered
+    buckets under ``grad_sync``/``b<k>`` scopes so each bucket's
+    collective is free to run concurrently with the remaining backward.
+    Per-leaf math is unchanged, so monolithic and bucketed steps are
+    bit-equal.  Quantized error-feedback residuals ride in
+    ``TrainState.residual`` in the stacked ``(n_data, *shape)`` layout
+    sharded over ``data_axis`` (ops/qcomm.py ``init_residual``
+    ``explicit=True``)."""
+    from jax import shard_map
+
+    from pytorch_distributed_tpu.parallel import overlap as overlap_lib
+
+    mesh_shape = dict(mesh.shape)
+    off_axes = {a: s for a, s in mesh_shape.items()
+                if a != data_axis and s > 1}
+    if off_axes:
+        raise ValueError(
+            "the explicit-collectives LM step needs a pure data-parallel "
+            f"mesh; axes {off_axes} are > 1 besides {data_axis!r}")
+    nontrivial = [
+        s for s in jax.tree_util.tree_leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P))
+        if isinstance(s, P) and any(ax is not None for ax in s)
+    ]
+    if nontrivial:
+        raise ValueError(
+            "the explicit-collectives LM step keeps params replicated; "
+            f"got sharded param_specs {nontrivial[:3]}...")
+    n = mesh_shape.get(data_axis, 1)
+    quantized = gc_mode in qcomm.QUANTIZED_MODES
+
+    def local_step(state: TrainState, tokens: jnp.ndarray, lr: jnp.ndarray):
+        def loss_fn(p, toks):
+            with jax.named_scope("lm_forward"):
+                logits, sown = model.apply({"params": p}, toks,
+                                           mutable=["losses"])
+                vocab = logits.shape[-1]
+                loss = cross_entropy(
+                    logits[:, :-1].reshape(-1, vocab),
+                    toks[:, 1:].reshape(-1),
+                )
+                for leaf in jax.tree_util.tree_leaves(
+                        sown.get("losses", {})):
+                    loss = loss + leaf
+                acc = jnp.mean(
+                    (jnp.argmax(logits[:, :-1], axis=-1)
+                     == toks[:, 1:]).astype(jnp.float32))
+                return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, tokens)
+        new_residual = state.residual
+        # Equal-size shards: mean-of-shard-means == global mean, so the
+        # synced gradient is psum/n of the local d(mean loss)/dp.
+        with jax.named_scope("grad_sync"):
+            if overlap_mode == "bucketed":
+                grads, new_residual = overlap_lib.bucketed_psum(
+                    grads, state.residual, data_axis, mode=gc_mode,
+                    cast_dtype=gc_cast, bucket_mb=bucket_mb)
+            elif quantized:
+                grads, new_residual = qcomm.compressed_psum(
+                    grads, state.residual, data_axis, mode=gc_mode)
+            else:
+                if gc_cast is not None:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g.astype(gc_cast), grads)
+                grads = jax.lax.psum(grads, data_axis)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / n, grads)
+        loss = jax.lax.psum(loss, data_axis) / n
+        acc = jax.lax.psum(acc, data_axis) / n
+        # Synced grads are identical on every shard, so the per-shard norm
+        # IS the global norm — no extra collective.
+        gnorm = (tree_l2_norm(grads)
+                 if (log_norms or clip_grad_norm > 0.0 or guard_nonfinite)
+                 else None)
+        if clip_grad_norm > 0.0:
+            with jax.named_scope("grad_clip"):
+                scale = jnp.minimum(
+                    1.0, clip_grad_norm / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                    grads,
+                )
+        with jax.named_scope("optimizer"):
+            new_params, new_momentum = sgd_update(
+                grads, state.momentum, state.params, lr,
+                momentum=momentum, weight_decay=weight_decay,
+            )
+        metrics = {"loss": loss, "acc": acc * 100.0}
+        if guard_nonfinite:
+            bad = nonfinite_flag(loss, gnorm)
+            new_params = gate_update(bad, state.params, new_params)
+            new_momentum = gate_update(bad, state.momentum, new_momentum)
+            new_residual = gate_update(bad, state.residual, new_residual)
+            metrics["nonfinite"] = bad
+        new_state = TrainState(state.step + 1, new_params, state.batch_stats,
+                               new_momentum, new_residual)
+        if log_norms:
+            metrics["grad_norm"] = gnorm
+            metrics["param_norm"] = tree_l2_norm(new_params)
+        return new_state, metrics
+
+    replicated = NamedSharding(mesh, P())
+    state_spec = TrainState(
+        step=P(), params=P(), batch_stats=P(), momentum=P(),
+        residual=P(data_axis) if quantized else P())
+    state_sharding = TrainState(
+        step=replicated, params=replicated, batch_stats=replicated,
+        momentum=replicated,
+        residual=(NamedSharding(mesh, P(data_axis)) if quantized
+                  else replicated))
+    stepped = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(state_spec, P(data_axis, None), P()),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+    return jax.jit(
+        stepped,
+        in_shardings=(state_sharding, NamedSharding(mesh, P(data_axis, None)),
+                      replicated),
+        out_shardings=(state_sharding, replicated),
+        donate_argnums=(0,),
+    )
+
+
 def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data",
-                      has_residual: bool = False, momentum_specs=None):
+                      has_residual: bool = False, momentum_specs=None,
+                      residual_specs=None):
     """Jitted held-out eval step returning exact token-weighted *sums*
     (loss·count, correct, count) — the LM counterpart of the image harness's
     ``make_eval_step`` (reference validate() pattern,
@@ -475,7 +668,10 @@ def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data",
     ``grad_compress``), so in_shardings must cover that subtree too.
     ``momentum_specs``: the ``--zero wus`` momentum layout
     (``zero_momentum_specs``) — in_shardings must match or XLA gathers
-    the sharded optimizer state on every eval call."""
+    the sharded optimizer state on every eval call.  ``residual_specs``
+    overrides the residual layout: the bucketed-overlap explicit step
+    stores residuals stacked per rank and sharded ``P(data_axis)``, not
+    param-shaped."""
 
     def step(state: TrainState, tokens: jnp.ndarray):
         # mutable=["losses"]: MoE models sow the router aux loss even in
@@ -494,11 +690,12 @@ def make_lm_eval_step(model, mesh: Mesh, param_specs, data_axis: str = "data",
 
     from pytorch_distributed_tpu.parallel.tp import state_specs
 
+    specs = state_specs(param_specs, residual=has_residual,
+                        momentum_specs=momentum_specs)
+    if residual_specs is not None:
+        specs = specs.replace(residual=residual_specs)
     state_shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s),
-        state_specs(param_specs, residual=has_residual,
-                    momentum_specs=momentum_specs)
-    )
+        lambda s: NamedSharding(mesh, s), specs)
     token_sharding = NamedSharding(mesh, P(data_axis, None))
     return jax.jit(
         step,
@@ -552,6 +749,8 @@ class LMTrainer:
         chaos=None,
         grad_compress: Optional[str] = None,
         zero: Optional[str] = None,
+        overlap: str = "none",
+        bucket_mb: float = 4.0,
         elastic=None,
         rescale_lr: str = "none",
         flight_rec: Optional[str] = None,
@@ -597,7 +796,12 @@ class LMTrainer:
         LM GSPMD step, see ``make_lm_train_step``); ``zero``: ``none|wus``
         weight-update sharding (parallel/zero.py) — momentum leaves take
         ``fsdp_specs`` data-axis shardings over the param specs, 1/N
-        optimizer bytes per device, identical numerics and checkpoints.
+        optimizer bytes per device, identical numerics and checkpoints;
+        ``overlap``/``bucket_mb``: the comm-overlap scheduler
+        (parallel/overlap.py) — ``'bucketed'`` switches pure-DP meshes
+        onto the explicit shard_map step with ~``bucket_mb``-MiB
+        reverse-autodiff grad-sync buckets (real compressed wire under
+        ``grad_compress``; bit-equal numerics).
 
         Elastic training (ft/elastic.py): ``elastic`` is a membership
         controller (``ElasticSim`` in-process, or any object with
@@ -642,6 +846,15 @@ class LMTrainer:
         )
         self.grad_compress, _ = qcomm.resolve_mode(grad_compress, None)
         self.zero = zero_lib.resolve_zero(zero)
+        from pytorch_distributed_tpu.parallel import overlap as overlap_lib
+
+        self.overlap = overlap_lib.resolve_overlap(overlap)
+        self.bucket_mb = float(bucket_mb)
+        if self.overlap == "bucketed" and elastic is not None:
+            raise ValueError(
+                "overlap='bucketed' carries stacked per-rank residual "
+                "state the elastic re-mesh does not re-grid on the LM "
+                "path; run elastic with overlap='none'")
         self.lr_schedule = lr_schedule
         self.eval_dataset = eval_dataset
         self.eval_every = eval_every
@@ -664,15 +877,24 @@ class LMTrainer:
         self._step_kwargs = dict(
             clip_grad_norm=clip_grad_norm, accum_steps=accum_steps,
             fused_ce_chunks=fused_ce_chunks, fused_ce_mode=fused_ce_mode,
+            overlap=self.overlap, bucket_mb=self.bucket_mb,
             # in-graph norms only when a metrics sink will consume them
             log_norms=bool(metrics_jsonl), guard_nonfinite=nan_guard)
         self._build_for_mesh(mesh, params)
-        residual = qcomm.init_residual(params, self.grad_compress,
-                                       explicit=False)
+        # Bucketed overlap runs the explicit shard_map step: quantized
+        # error-feedback residuals take the stacked per-rank layout
+        # sharded over the data axis (one slot per rank).
+        explicit = self.overlap == "bucketed"
+        residual = qcomm.init_residual(
+            params, self.grad_compress, explicit=explicit,
+            n_data=dict(mesh.shape).get("data", 1))
         state = TrainState.create({"params": params}, sgd_init(params),
                                   residual=residual)
         self.state = shard_state(state, self.param_specs, mesh,
                                  momentum_specs=self._mom_specs)
+        if explicit and self.grad_compress in qcomm.QUANTIZED_MODES:
+            self.state = self.state.replace(residual=jax.device_put(
+                self.state.residual, NamedSharding(mesh, P("data"))))
         from pytorch_distributed_tpu.obs import HeartbeatWriter, MetricsLogger
 
         self.obs = MetricsLogger(metrics_jsonl,
@@ -814,11 +1036,18 @@ class LMTrainer:
                                           zero=self.zero, params=params,
                                           **self._step_kwargs)
         self.token_sharding = NamedSharding(mesh, P("data", None))
+        quantized = self.grad_compress in qcomm.QUANTIZED_MODES
         self._eval_fn = (
             make_lm_eval_step(
                 self.model, mesh, self.param_specs,
-                has_residual=self.grad_compress in qcomm.QUANTIZED_MODES,
-                momentum_specs=self._mom_specs)
+                has_residual=quantized,
+                momentum_specs=self._mom_specs,
+                # bucketed overlap trains the explicit step: residuals are
+                # stacked per rank and sharded over data (_build_for_mesh)
+                residual_specs=(
+                    jax.tree_util.tree_map(lambda _: P("data"),
+                                           self.param_specs)
+                    if quantized and self.overlap == "bucketed" else None))
             if self.eval_dataset is not None else None)
         self._span = None   # per-process row range: topology-keyed
         self._agree = None  # lazy PreemptionAgreement holds the old mesh

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from pytorch_distributed_tpu.ops import cross_entropy, qcomm, topk_correct
+from pytorch_distributed_tpu.parallel import overlap as overlap_lib
 from pytorch_distributed_tpu.parallel import zero as zero_lib
 from pytorch_distributed_tpu.train.optim import sgd_update
 from pytorch_distributed_tpu.train.state import TrainState
@@ -111,6 +112,9 @@ def make_train_step(
     guard_nonfinite: bool = False,
     zero: str = "none",
     params: Optional[Any] = None,
+    overlap: str = "none",
+    bucket_mb: float = overlap_lib.DEFAULT_BUCKET_MB,
+    wus_gather: str = "eager",
 ) -> Callable[[TrainState, Batch, jnp.ndarray], Tuple[TrainState, Metrics]]:
     """Build the jitted train step for ``mesh``.
 
@@ -183,6 +187,29 @@ def make_train_step(
     lands in the metrics as a lazily-converted device scalar for the host
     ``DivergenceGuard`` policy (ft/divergence.py).  ``--nan-guard``.
 
+    ``overlap``: ``none | bucketed`` — the comm-overlap scheduler
+    (parallel/overlap.py).  ``bucketed`` partitions the gradient pytree
+    into ~``bucket_mb``-MiB buckets in reverse-autodiff order and issues
+    each bucket's sync (``psum`` / ``compressed_psum`` / reduce-scatter)
+    as its own collective under a nested ``grad_sync``/``b<k>`` scope, so
+    the sync of early-produced gradients can run concurrently with the
+    remaining backward instead of as one tail-end collective; the per-leaf
+    math is identical, so results are bit-equal to ``overlap="none"``.
+    Requires ``explicit_collectives=True`` (under GSPMD, XLA owns the
+    collective placement).  The ``--zero wus`` delta all-gather buckets
+    too (``ag_b<k>`` scopes, forward order).
+
+    ``wus_gather``: ``eager | deferred`` — with ``zero='wus'`` +
+    ``overlap='bucketed'``, ``deferred`` double-buffers the param state:
+    the step *stages* its delta chunks in ``momentum["pending"]`` and
+    drains the previous step's at its head under a ``param_gather`` scope
+    (parallel/overlap.py), so the gather overlaps the next forward.
+    ``state.params`` then lag one staged delta; drain with
+    ``overlap_lib.materialize_params`` before eval/checkpoint.  Build the
+    momentum with an extra ``pending`` slot (``init_pending``).  Only the
+    f32/bf16 delta wire supports deferral (quantized error feedback is
+    step-order-dependent).
+
     BatchNorm semantics differ deliberately, matching each formulation's GPU
     ancestor: GSPMD BN normalizes over the *global* batch (SyncBN — XLA
     inserts the cross-replica mean), while the shard_map variant normalizes
@@ -192,6 +219,26 @@ def make_train_step(
 
     mode, cast_dtype = qcomm.resolve_mode(grad_compress, wire_dtype)
     zero_mode = zero_lib.resolve_zero(zero)
+    overlap_mode = overlap_lib.resolve_overlap(overlap)
+    if overlap_mode == "bucketed" and not explicit_collectives:
+        raise ValueError(
+            "overlap='bucketed' schedules hand-written collectives and "
+            "requires explicit_collectives=True (under GSPMD, XLA owns "
+            "collective placement — there is nothing to bucket)")
+    if wus_gather not in ("eager", "deferred"):
+        raise ValueError(
+            f"wus_gather must be 'eager' or 'deferred', got {wus_gather!r}")
+    if wus_gather == "deferred":
+        if zero_mode != "wus" or overlap_mode != "bucketed":
+            raise ValueError(
+                "wus_gather='deferred' is the double-buffered ZeRO-WUS "
+                "delta gather — it requires zero='wus' and "
+                "overlap='bucketed'")
+        if mode in qcomm.QUANTIZED_MODES:
+            raise ValueError(
+                "wus_gather='deferred' supports the f32/bf16 delta wire "
+                "only: the quantized gather's error feedback is step-order"
+                "-dependent and cannot be staged across steps")
     if zero_mode == "wus":
         if tx is not None:
             raise ValueError(
@@ -205,7 +252,11 @@ def make_train_step(
     def sync_grads(grads, count, residual):
         # grads arrive as *local weighted sums*; sync then normalize.
         with jax.named_scope("grad_sync"):
-            if mode in qcomm.QUANTIZED_MODES:
+            if overlap_mode == "bucketed":
+                grads, residual = overlap_lib.bucketed_psum(
+                    grads, residual, data_axis, mode=mode,
+                    cast_dtype=cast_dtype, bucket_mb=bucket_mb)
+            elif mode in qcomm.QUANTIZED_MODES:
                 grads, residual = qcomm.compressed_psum(
                     grads, residual, data_axis, mode=mode)
             else:
@@ -324,8 +375,17 @@ def make_train_step(
             jax.random.fold_in(base_key, state.step),
             jax.lax.axis_index(data_axis),
         )
+        params = state.params
+        if wus_gather == "deferred":
+            # Double-buffered WUS: drain the PREVIOUS step's staged delta
+            # chunks at the head of this step — in dataflow terms layer
+            # k's gather only blocks layer k's forward, so the gather
+            # overlaps this step's earlier-layer compute.
+            params = overlap_lib.drain_pending(
+                params, state.momentum["pending"], data_axis,
+                cast_dtype=cast_dtype)
         grads, new_stats, (loss_sum, c1, c5, count) = accumulated_grads(
-            state.params, state.batch_stats, batch, rng
+            params, state.batch_stats, batch, rng
         )
         if zero_mode == "wus":
             # Weight-update sharding: reduce-scatter the gradient sums so
@@ -334,7 +394,11 @@ def make_train_step(
             n = jax.lax.axis_size(data_axis)
             idx = jax.lax.axis_index(data_axis)
             with jax.named_scope("grad_sync"):
-                if mode in qcomm.QUANTIZED_MODES:
+                if overlap_mode == "bucketed":
+                    gchunks, new_residual = overlap_lib.bucketed_reduce_scatter(
+                        grads, state.residual, data_axis, n, mode=mode,
+                        cast_dtype=cast_dtype, bucket_mb=bucket_mb)
+                elif mode in qcomm.QUANTIZED_MODES:
                     gchunks, new_residual = qcomm.compressed_reduce_scatter(
                         grads, state.residual, data_axis, mode=mode)
                 else:
@@ -345,11 +409,25 @@ def make_train_step(
                 gchunks = jax.tree_util.tree_map(
                     lambda g: g / gcount, gchunks)
             with jax.named_scope("optimizer"):
-                new_params, new_momentum = zero_lib.wus_apply_updates(
-                    state.params, state.momentum, gchunks, lr, idx, n,
-                    data_axis, momentum_coef=momentum,
-                    weight_decay=weight_decay, mode=mode,
-                    cast_dtype=cast_dtype)
+                if wus_gather == "deferred":
+                    # Stage this step's deltas; the next step drains them.
+                    deltas, new_buf = zero_lib.wus_update_chunks(
+                        params, state.momentum, gchunks, lr, idx, n,
+                        momentum_coef=momentum, weight_decay=weight_decay)
+                    new_params = params
+                    new_momentum = {
+                        "buf": new_buf,
+                        "pending": jax.tree_util.tree_map(
+                            lambda d: d.reshape((1,) + d.shape), deltas),
+                    }
+                else:
+                    new_params, new_momentum = zero_lib.wus_apply_updates(
+                        params, state.momentum, gchunks, lr, idx, n,
+                        data_axis, momentum_coef=momentum,
+                        weight_decay=weight_decay, mode=mode,
+                        cast_dtype=cast_dtype,
+                        bucket_mb=(bucket_mb if overlap_mode == "bucketed"
+                                   else None))
         else:
             grads, gcount, new_residual = sync_grads(
                 grads, count, state.residual)

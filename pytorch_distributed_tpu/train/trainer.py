@@ -453,6 +453,11 @@ class Trainer:
             guard_nonfinite=bool(getattr(cfg, "nan_guard", False)),
             zero=self.zero,
             params=self.state.params,
+            # Comm-overlap scheduler (parallel/overlap.py): bucketed
+            # backward-overlapped grad sync on the explicit step;
+            # make_train_step rejects bucketed-under-GSPMD loudly.
+            overlap=getattr(cfg, "overlap", "none"),
+            bucket_mb=float(getattr(cfg, "bucket_mb", 4.0)),
         )
         self.eval_step = make_eval_step(
             self.model, mesh, data_axis=self.data_axis,

@@ -76,6 +76,9 @@ def _render(payload) -> str:
     if payload.get("overlap_source") == "measured":
         lines.append(f"   overlap: {100.0 * payload['overlap']:.1f}% "
                      "(measured from timeline)")
+    elif payload.get("overlap_source") == "schedule":
+        lines.append(f"   overlap: {100.0 * payload['overlap']:.1f}% "
+                     "(bucketed-schedule model)")
     for reason, n in sorted(payload["pruned"].items()):
         lines.append(f"   pruned {n:4d}  {reason}")
     lines.append(f"   {'#':>2} {'plan':<34} {'MFU%':>6} {'step_ms':>10} "
@@ -149,6 +152,15 @@ def main(argv=None) -> int:
                     help="replace the assumed backward-overlap fraction "
                          "with the measured overlap_pct_mean from an "
                          "obs_timeline.py report")
+    ap.add_argument("--overlap-schedule", nargs="?", const=4.0, type=float,
+                    default=None, metavar="BUCKET_MB",
+                    help="replace the assumed backward-overlap fraction "
+                         "with the bucketed scheduler's schedule-derived "
+                         "one (cost.bucketed_overlap over the model's "
+                         "gradient bytes at BUCKET_MB-MiB buckets, "
+                         "default 4) — use when the recipe runs "
+                         "--overlap bucketed; payload records "
+                         "overlap_source=schedule")
     ap.add_argument("--no-elastic", action="store_true",
                     help="skip pre-planning the shrunk elastic worlds")
     ap.add_argument("--validate", action="store_true",
@@ -175,10 +187,22 @@ def main(argv=None) -> int:
         ap.error(f"unknown model {args.model!r}; known: {sorted(MODELS)}")
 
     overlap = None
+    overlap_source = None
+    if args.overlap_from and args.overlap_schedule is not None:
+        ap.error("--overlap-from and --overlap-schedule are exclusive "
+                 "(measured vs schedule-derived provenance)")
     if args.overlap_from:
         overlap = overlap_from_timeline(args.overlap_from)
         print(f"measured overlap {100.0 * overlap:.1f}% from "
               f"'{args.overlap_from}' (assumed default was 60%)")
+    elif args.overlap_schedule is not None:
+        from pytorch_distributed_tpu.plan import cost as cost_mod
+
+        overlap = cost_mod.spec_bucketed_overlap(
+            MODELS[args.model](), bucket_mb=args.overlap_schedule)
+        overlap_source = "schedule"
+        print(f"schedule-derived overlap {100.0 * overlap:.1f}% "
+              f"(bucketed model, {args.overlap_schedule:g} MiB buckets)")
 
     sweeps = []
     rc = 0
@@ -187,7 +211,7 @@ def main(argv=None) -> int:
             args.model, chips, chip=args.chip, top_k=args.top_k,
             elastic=not args.no_elastic, validate=args.validate,
             validate_k=args.validate_k, hbm_budget=args.hbm_budget,
-            overlap=overlap)
+            overlap=overlap, overlap_source=overlap_source)
         sweeps.append(payload)
         if args.format == "table":
             print(_render(payload))

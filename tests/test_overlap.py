@@ -1,0 +1,345 @@
+"""Comm-overlap scheduler (parallel/overlap.py, ``--overlap bucketed``).
+
+The tier-1 pins behind the ISSUE-16 contract — bucketing is a pure
+*schedule* transformation, so every numerics assertion here is bit-exact:
+
+- bucket planning: reverse-autodiff partition covers each leaf exactly
+  once, respects the byte cap, and degenerates to one bucket when the
+  cap exceeds the gradient;
+- bucketed ≡ monolithic on the explicit image step for f32 / bf16 wire
+  and for int8 error-feedback (3-step training parity, grads + params +
+  loss identical to the last bit);
+- same on the explicit shard_map LM step (f32 and int8-EF), plus the
+  GSPMD cross-check at f32 tolerance;
+- ZeRO-WUS: bucketed delta all-gather ≡ monolithic gather, and the
+  double-buffered (``wus_gather="deferred"``) params materialize to the
+  eager run's params exactly;
+- mode/flag validation: the scheduler refuses the combinations it cannot
+  keep bit-exact (GSPMD step, deferred+quantized, LM+wus, elastic);
+- ledger attribution: compiled bucketed collectives carry ``b<k>``
+  labels and per-phase byte totals still sum to the monolithic budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models.transformer import TransformerLM
+from pytorch_distributed_tpu.obs import comms
+from pytorch_distributed_tpu.ops import qcomm
+from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+from pytorch_distributed_tpu.parallel import overlap as overlap_lib
+from pytorch_distributed_tpu.parallel import zero as zero_lib
+from pytorch_distributed_tpu.parallel.tp import replicated_like
+from pytorch_distributed_tpu.train.lm import LMTrainer, make_lm_train_step
+from pytorch_distributed_tpu.train.optim import sgd_init
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.steps import make_train_step
+
+from tests.test_steps import _MLP, _leaves_allclose
+
+N = 4
+
+
+def _mesh4():
+    return build_mesh(MeshSpec(("data",), (N,)), jax.devices()[:N])
+
+
+def _leaves_equal(a, b):
+    fa, fb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------------- bucket planning
+
+
+def test_plan_buckets_partitions_in_reverse_autodiff_order():
+    tree = {"a": jnp.zeros((256,)), "b": jnp.zeros((512,)),
+            "c": jnp.zeros((64,))}
+    leaves = jax.tree_util.tree_leaves(tree)
+    buckets = overlap_lib.plan_buckets(tree, bucket_mb=1 / 1024)  # 1 KiB cap
+    # exact partition of leaf indices
+    flat = [i for b in buckets for i in b]
+    assert sorted(flat) == list(range(len(leaves)))
+    # reverse-autodiff order: bucket 0 starts from the LAST flatten leaf
+    assert flat == list(reversed(range(len(leaves))))
+    # byte cap: every bucket but the terminal one closed at/over 1 KiB
+    for b in buckets[:-1]:
+        assert sum(leaves[i].size * 4 for i in b) >= 1024
+
+
+def test_plan_buckets_degenerates_to_one_bucket():
+    tree = [jnp.zeros((8,)), jnp.zeros((8,))]
+    assert overlap_lib.plan_buckets(tree, bucket_mb=64.0) == [[1, 0]]
+    assert overlap_lib.n_buckets(tree, bucket_mb=64.0) == 1
+
+
+def test_resolve_overlap_validates():
+    assert overlap_lib.resolve_overlap("none") == "none"
+    assert overlap_lib.resolve_overlap("bucketed") == "bucketed"
+    with pytest.raises(ValueError):
+        overlap_lib.resolve_overlap("eager")
+
+
+# ------------------------------------- explicit image step: bucketed ≡ mono
+
+
+def _image_setup(seed=0):
+    model = _MLP(classes=10)
+    variables = model.init(jax.random.PRNGKey(seed),
+                           jnp.zeros((1, 8, 8, 3)))
+    rng = np.random.default_rng(seed)
+    batch = {
+        "images": rng.normal(size=(16, 8, 8, 3)).astype(np.float32),
+        "labels": rng.integers(0, 10, size=16).astype(np.int32),
+        "weights": np.ones(16, np.float32),
+    }
+    return model, variables, batch
+
+
+def _run_image(model, variables, batch, mesh, n_steps=3, zero="none",
+               wus_gather="eager", **kw):
+    gc = kw.get("grad_compress", "none")
+    quantized = gc in qcomm.QUANTIZED_MODES
+    # fresh param buffers per run: the jitted step donates its state
+    params = jax.tree_util.tree_map(
+        lambda x: jnp.array(np.asarray(x)), variables["params"])
+    residual = qcomm.init_residual(params, gc, explicit=True, n_data=N)
+    if zero == "wus":
+        mom = zero_lib.init_wus_momentum(params, N, quantized=quantized)
+        if wus_gather == "deferred":
+            mom["pending"] = overlap_lib.init_pending(params, N)
+    else:
+        mom = sgd_init(params)
+    state = TrainState.create({"params": params}, mom, residual=residual)
+    step = make_train_step(model, mesh, explicit_collectives=True,
+                           zero=zero, wus_gather=wus_gather, **kw)
+    for _ in range(n_steps):
+        state, metrics = step(state, batch, jnp.float32(0.1))
+    return state, metrics
+
+
+@pytest.mark.parametrize("gc", ["none", "bf16", "int8"])
+def test_image_bucketed_matches_monolithic_bitexact(gc):
+    mesh = _mesh4()
+    model, variables, batch = _image_setup()
+    s0, m0 = _run_image(model, variables, batch, mesh, grad_compress=gc)
+    s1, m1 = _run_image(model, variables, batch, mesh, grad_compress=gc,
+                        overlap="bucketed", bucket_mb=0.001)
+    _leaves_equal(s0.params, s1.params)
+    if gc == "int8":  # error-feedback state must track bit-exactly too
+        _leaves_equal(s0.residual, s1.residual)
+    assert float(m0["loss"]) == float(m1["loss"])
+
+
+# ------------------------------------------- ZeRO-WUS bucketed + deferred
+
+
+@pytest.mark.parametrize("gc", ["none", "int8"])
+def test_wus_bucketed_gather_matches_monolithic(gc):
+    mesh = _mesh4()
+    model, variables, batch = _image_setup()
+    s0, _ = _run_image(model, variables, batch, mesh, zero="wus",
+                       grad_compress=gc)
+    s1, _ = _run_image(model, variables, batch, mesh, zero="wus",
+                       grad_compress=gc, overlap="bucketed",
+                       bucket_mb=0.001)
+    _leaves_equal(s0.params, s1.params)
+    _leaves_equal(s0.momentum["buf"], s1.momentum["buf"])
+
+
+def test_wus_deferred_materializes_to_eager_params():
+    """Double-buffered delta all-gather: the live params lag by one
+    pending delta; replaying the wire cast on the host recovers the
+    eager run's params to the last bit."""
+    mesh = _mesh4()
+    model, variables, batch = _image_setup()
+    s_eager, _ = _run_image(model, variables, batch, mesh, zero="wus",
+                            overlap="bucketed", bucket_mb=0.001)
+    s_def, _ = _run_image(model, variables, batch, mesh, zero="wus",
+                          wus_gather="deferred", overlap="bucketed",
+                          bucket_mb=0.001)
+    mat = overlap_lib.materialize_params(
+        jax.device_get(s_def.params),
+        jax.device_get(s_def.momentum["pending"]))
+    _leaves_equal(s_eager.params, mat)
+
+
+# -------------------------------------------------- explicit LM shard_map
+
+
+@pytest.fixture(scope="module")
+def lm_setup():
+    mesh = _mesh4()
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=1)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(8, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return mesh, model, tokens, params
+
+
+def _run_lm(lm_setup, n_steps=3, **kw):
+    mesh, model, tokens, params = lm_setup
+    gc = kw.get("grad_compress", "none")
+    explicit = (kw.get("explicit_collectives", False)
+                or kw.get("overlap") == "bucketed")
+    p0 = jax.tree_util.tree_map(
+        lambda x: jnp.array(np.asarray(x)), params)
+    residual = qcomm.init_residual(p0, gc, explicit=explicit, n_data=N)
+    state = TrainState.create({"params": p0}, sgd_init(p0),
+                              residual=residual)
+    step = make_lm_train_step(model, mesh, replicated_like(p0), **kw)
+    for _ in range(n_steps):
+        state, metrics = step(state, tokens, jnp.float32(0.1))
+    return state, metrics
+
+
+@pytest.mark.parametrize("gc", ["none", "int8"])
+def test_lm_bucketed_matches_monolithic_bitexact(lm_setup, gc):
+    s0, m0 = _run_lm(lm_setup, explicit_collectives=True, grad_compress=gc)
+    s1, m1 = _run_lm(lm_setup, overlap="bucketed", bucket_mb=0.001,
+                     grad_compress=gc)
+    _leaves_equal(s0.params, s1.params)
+    assert float(m0["loss"]) == float(m1["loss"])
+
+
+def test_lm_explicit_tracks_gspmd(lm_setup):
+    """The explicit shard_map step is a different lowering of the same
+    math as the GSPMD step — equal to f32 reduction-order tolerance."""
+    sg, mg = _run_lm(lm_setup)
+    se, me = _run_lm(lm_setup, overlap="bucketed", bucket_mb=0.001)
+    _leaves_allclose(sg.params, se.params, rtol=0, atol=1e-5)
+    assert abs(float(mg["loss"]) - float(me["loss"])) < 1e-5
+
+
+# ------------------------------------------------------------- validation
+
+
+def test_bucketed_requires_explicit_collectives():
+    mesh = _mesh4()
+    model = _MLP(classes=10)
+    with pytest.raises(ValueError, match="explicit"):
+        make_train_step(model, mesh, overlap="bucketed")
+
+
+def test_deferred_gather_requires_wus_and_bucketed():
+    mesh = _mesh4()
+    model = _MLP(classes=10)
+    with pytest.raises(ValueError, match="deferred"):
+        make_train_step(model, mesh, explicit_collectives=True,
+                        wus_gather="deferred")
+
+
+def test_deferred_gather_rejects_quantized_wire():
+    mesh = _mesh4()
+    model = _MLP(classes=10)
+    with pytest.raises(ValueError, match="quantiz"):
+        make_train_step(model, mesh, explicit_collectives=True,
+                        zero="wus", overlap="bucketed",
+                        wus_gather="deferred", grad_compress="int8")
+
+
+def test_lm_bucketed_rejects_wus(lm_setup):
+    mesh, model, tokens, params = lm_setup
+    with pytest.raises(ValueError, match="zero"):
+        make_lm_train_step(model, mesh, replicated_like(params),
+                           overlap="bucketed", zero="wus", params=params)
+
+
+def test_lm_trainer_rejects_bucketed_with_elastic():
+    from pytorch_distributed_tpu.train.lm import SyntheticTokenDataset
+
+    mesh = _mesh4()
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(64, 16, 32)
+    with pytest.raises(ValueError, match="elastic"):
+        LMTrainer(model, mesh, ds, batch_size=8, overlap="bucketed",
+                  elastic=object())
+
+
+def test_lm_trainer_bucketed_int8_evaluate():
+    """Regression: the eval step's in_shardings must cover the explicit
+    path's stacked per-rank residual (P("data")), not the param-shaped
+    emulation layout — evaluate() under overlap='bucketed' +
+    grad_compress='int8' used to raise a pjit sharding mismatch."""
+    import math
+
+    from pytorch_distributed_tpu.train.lm import SyntheticTokenDataset
+
+    mesh = _mesh4()
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(64, 16, 32)
+    tr = LMTrainer(model, mesh, ds, batch_size=8, overlap="bucketed",
+                   grad_compress="int8", eval_dataset=ds, eval_batches=1)
+    loss, ppl, acc = tr.evaluate()
+    assert math.isfinite(loss) and ppl > 0 and 0.0 <= acc <= 100.0
+
+
+# --------------------------------------------------- ledger attribution
+
+
+def test_bucket_of_op_name():
+    f = comms.bucket_of_op_name
+    assert f("jit(step)/grad_sync/b3/psum") == 3
+    assert f("transpose(jvp(_MLP))/grad_sync/b0") == 0
+    assert f("optimizer/ag_b2/all_gather") == 2
+    assert f("jit(step)/grad_sync/psum") == -1
+    assert f("bucket12/x") == -1  # only the exact b<k> scope counts
+    assert f("") == -1
+
+
+def test_compiled_buckets_sum_to_monolithic_budget(get_lowering):
+    """Bucketing relabels collectives within grad_sync — it must not move
+    or create bytes: per-phase totals equal the monolithic explicit
+    twin's, and every gradient collective carries a bucket label."""
+    mono = comms.ledger_from_hlo_text(
+        get_lowering("train_image_explicit").text, step="mono")
+    bucketed = comms.ledger_from_hlo_text(
+        get_lowering("train_image_bucketed").text, step="bucketed")
+
+    assert (bucketed.by_phase()["grad_sync"]["bytes"]
+            == mono.by_phase()["grad_sync"]["bytes"])
+    assert bucketed.total_bytes == mono.total_bytes
+
+    grad_entries = [e for e in bucketed.entries if e.phase == "grad_sync"]
+    labeled = {e.bucket for e in grad_entries if e.bucket >= 0}
+    assert len(labeled) >= 2, [e.op_name for e in grad_entries]
+    # monolithic twin has no bucket labels at all
+    assert all(e.bucket == -1 for e in mono.entries)
+
+
+def test_ledger_json_roundtrips_bucket_field(tmp_path, get_lowering):
+    lg = comms.ledger_from_hlo_text(
+        get_lowering("train_image_bucketed").text,
+        step="train_image_bucketed")
+    path = str(tmp_path / "comm_ledger.json")
+    comms.write_ledgers(path, [lg])
+    loaded = comms.load_ledgers(path)["train_image_bucketed"]
+    assert ([e.bucket for e in loaded.entries]
+            == [e.bucket for e in lg.entries])
+
+    # legacy payload without the field loads with the -1 default
+    import json
+
+    data = json.load(open(path))
+    for e in data["train_image_bucketed"]["entries"]:
+        e.pop("bucket")
+    with open(path, "w") as f:
+        json.dump(data, f)
+    legacy = comms.load_ledgers(path)["train_image_bucketed"]
+    assert {e.bucket for e in legacy.entries} == {-1}
+
+
+def test_int8_bucketed_lm_wire_is_quantized(get_lowering):
+    """The GSPMD-migration acceptance pin: with --overlap bucketed and
+    --grad-compress int8 the LM step's compiled gradient collectives
+    carry s8 payloads (f32 is scale side-cars only), i.e. compression
+    rides the real wire instead of a numerics emulation."""
+    lg = comms.ledger_from_hlo_text(
+        get_lowering("lm_train_bucketed_int8").text, step="int8")
+    enc = lg.phase_wire_encodings("grad_sync")
+    assert "int8" in enc, enc
+    assert enc["int8"] > 10 * enc.get("f32", 0.0), enc

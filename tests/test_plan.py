@@ -218,6 +218,62 @@ def test_overlap_from_timeline_folds_into_scores(tmp_path):
                               "--overlap-from", str(path)]) == 0
 
 
+# --------------------------------------------- schedule-derived overlap
+
+def test_bucketed_overlap_schedule_math():
+    """ISSUE 16: the bucketed scheduler's hideable fraction is (K-1)/K —
+    every reverse-autodiff bucket's collective except the last overlaps
+    remaining backward — capped below 1.0 (tail bucket + dispatch are
+    never free)."""
+    mib = 1024.0 * 1024.0
+    assert cost_mod.bucketed_overlap(3.5 * mib, bucket_mb=4.0) == 0.0
+    assert cost_mod.bucketed_overlap(16 * mib, bucket_mb=4.0) \
+        == pytest.approx(3 / 4)
+    assert cost_mod.bucketed_overlap(17 * mib, bucket_mb=4.0) \
+        == pytest.approx(4 / 5)  # ceil: a partial tail bucket counts
+    assert cost_mod.bucketed_overlap(4096 * mib, bucket_mb=4.0) == 0.95
+    with pytest.raises(ValueError):
+        cost_mod.bucketed_overlap(16 * mib, bucket_mb=0.0)
+
+    # spec wrapper: full f32 gradient bytes of the spec's param count
+    spec = tiny_lm_spec()
+    params = cost_mod.step_cost_for(space.Plan(spec=spec, chips=1)).params
+    assert cost_mod.spec_bucketed_overlap(spec, bucket_mb=4.0) \
+        == cost_mod.bucketed_overlap(4.0 * params, bucket_mb=4.0)
+
+
+def test_autoplan_overlap_source_schedule(tmp_path):
+    """``overlap_source="schedule"`` flows through the payload (planner
+    kwarg and the ``--overlap-schedule`` CLI), distinct from the
+    measured-timeline provenance."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts"))
+    import autoplan as autoplan_cli
+
+    frac = cost_mod.spec_bucketed_overlap(lm_spec(), bucket_mb=4.0)
+    payload = planner.autoplan("lm", 32, chip="v5p", top_k=3,
+                               elastic=False, overlap=frac,
+                               overlap_source="schedule")
+    assert payload["overlap_source"] == "schedule"
+    assert payload["overlap"] == pytest.approx(frac)
+    # the explicit kwarg never mislabels the default provenance
+    assert planner.autoplan("lm", 32, chip="v5p", top_k=3,
+                            elastic=False)["overlap_source"] == "assumed"
+
+    # CLI end to end, and exclusive with --overlap-from
+    assert autoplan_cli.main(["lm-tiny", "--chips", "4", "--no-elastic",
+                              "--overlap-schedule"]) == 0
+    report = tmp_path / "timeline.json"
+    report.write_text(json.dumps({"captures": [
+        {"file": "a", "aggregate": {"steps": 1,
+                                    "overlap_pct_mean": 50.0}}]}))
+    with pytest.raises(SystemExit):
+        autoplan_cli.main(["lm-tiny", "--chips", "4", "--no-elastic",
+                           "--overlap-schedule", "--overlap-from",
+                           str(report)])
+
+
 # ------------------------------------------------- rank stability table
 
 def test_rank_stability_against_checked_in_table():

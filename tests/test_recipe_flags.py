@@ -184,3 +184,21 @@ def test_serving_flags_parse_to_their_own_dests():
     assert (args.mode, args.policy) == ("continuous", "fcfs")
     assert (args.slo_ttft_ms, args.slo_kv_pct) == (None, None)
     assert (args.no_watchdog, args.metrics_jsonl) == (False, None)
+
+
+def test_overlap_flags_parse_to_their_own_dests():
+    """ISSUE-16 flags: ``--overlap``/``--bucket-mb`` land in their own
+    dests on both surfaces, default to none/4 MiB, and collide with
+    nothing (the _lint tests above cover the collision half)."""
+    cfg = config_mod.parse_config(
+        ["--overlap", "bucketed", "--bucket-mb", "2.5"])
+    assert (cfg.overlap, cfg.bucket_mb) == ("bucketed", 2.5)
+    cfg = config_mod.parse_config([])
+    assert (cfg.overlap, cfg.bucket_mb) == ("none", 4.0)
+    args = lm_pretrain.build_parser().parse_args(
+        ["--overlap", "bucketed", "--bucket-mb", "0.5",
+         "--precision", "bf16"])
+    assert (args.overlap, args.bucket_mb) == ("bucketed", 0.5)
+    assert args.precision == "bf16"  # the PR-9 symptom, pinned
+    args = lm_pretrain.build_parser().parse_args([])
+    assert (args.overlap, args.bucket_mb) == ("none", 4.0)

@@ -1,6 +1,15 @@
 """Recipe smoke matrix — the de-facto test the reference ran by hand
-(start.sh launches, SURVEY.md §4 item 1), executed on the simulated mesh."""
+(start.sh launches, SURVEY.md §4 item 1), executed on the simulated mesh.
 
+Every smoke recipe trains the identical resnet18/32px/batch-16 config,
+so their train/eval steps are the same program compiled N times.  The
+module-scoped ``shared_step_builders`` fixture memoizes the trainer's
+step builders by build fingerprint (the compile-budget discipline of
+analysis/lowering.py, applied to the smoke matrix's private jit
+compiles), and the tail tests assert the sharing actually happened and
+that the session's AOT compile budget didn't grow."""
+
+import jax
 import numpy as np
 import pytest
 
@@ -29,6 +38,54 @@ SMOKE_ARGS = [
 
 def _args(tmp_path, extra=()):
     return SMOKE_ARGS + ["--checkpoint-dir", str(tmp_path)] + list(extra)
+
+
+def _fingerprint(v):
+    """Hashable build key for one step-builder argument: arrays reduce to
+    shape/dtype (the lowering only depends on avals, and repr'ing ResNet
+    params would materialize them), pytrees recurse, the rest reprs."""
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return ("aval", tuple(v.shape), str(v.dtype))
+    leaves, treedef = jax.tree_util.tree_flatten(v)
+    if len(leaves) != 1 or leaves[0] is not v:
+        return (str(treedef),) + tuple(_fingerprint(l) for l in leaves)
+    return repr(v)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def shared_step_builders():
+    """Memoize make_train_step/make_eval_step at the trainer's import
+    site: identical build fingerprints reuse one jitted step (and so one
+    XLA compile) across the whole smoke matrix."""
+    from pytorch_distributed_tpu.train import trainer as trainer_mod
+
+    real = {"train": trainer_mod.make_train_step,
+            "eval": trainer_mod.make_eval_step}
+    cache = {}
+    stats = {"train_calls": 0, "train_builds": 0,
+             "eval_calls": 0, "eval_builds": 0}
+
+    def _mesh_key(mesh):
+        return (tuple(dict(mesh.shape).items()),
+                tuple(d.id for d in mesh.devices.flat))
+
+    def _memo(which):
+        def build(model, mesh, **kw):
+            stats[f"{which}_calls"] += 1
+            key = (which, str(model), _mesh_key(mesh),
+                   tuple(sorted((k, _fingerprint(v))
+                                for k, v in kw.items())))
+            if key not in cache:
+                stats[f"{which}_builds"] += 1
+                cache[key] = real[which](model, mesh, **kw)
+            return cache[key]
+        return build
+
+    mp = pytest.MonkeyPatch()
+    mp.setattr(trainer_mod, "make_train_step", _memo("train"))
+    mp.setattr(trainer_mod, "make_eval_step", _memo("eval"))
+    yield stats
+    mp.undo()
 
 
 @pytest.mark.parametrize(
@@ -127,3 +184,21 @@ def test_lm_generate_speculative(capsys):
     assert "speculative:" in got and "tok/pass" in got
     tok = [ln for ln in want.splitlines() if ln.startswith("tokens:")]
     assert tok and tok[0] in got
+
+
+def test_smoke_matrix_shared_step_compiles(shared_step_builders):
+    """The migration fence: the smoke matrix's identical configs must
+    land on shared step builds, not one private compile per recipe."""
+    stats = shared_step_builders
+    if stats["train_calls"] < 2:
+        pytest.skip("needs the smoke matrix to have run in this module")
+    assert stats["train_builds"] < stats["train_calls"], stats
+    assert stats["eval_builds"] < stats["eval_calls"], stats
+
+
+def test_aot_compile_budget_not_grown():
+    """The smoke matrix (and this PR's bucketed recipes) must not push
+    the session's AOT sweep over the tier-1 ceiling."""
+    from pytorch_distributed_tpu.analysis import lowering
+
+    lowering.assert_compile_budget()
