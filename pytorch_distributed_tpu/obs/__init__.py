@@ -39,6 +39,12 @@ is three ``.item()`` calls per batch plus a 500 ms nvidia-smi CSV).
   recompile anomaly, bench staleness), latched per episode and booked as
   ``alert`` ft_events; ``scripts/obs_live.py`` is the fleet aggregator
   (scrape every rank + heartbeats → dashboard, exit-1-on-alert for CI).
+- ``reqtrace``  — the request-scoped plane for the serving engine: a
+  bounded per-request span recorder with a propagatable
+  ``TraceContext``, exact TTFT/e2e critical-path attribution
+  (queue wait / prefill / preempt-redo / defrag), tail-based sampling,
+  and Perfetto request tracks; ``scripts/obs_trace.py`` is the
+  jax-free analyzer CLI.
 
 ``scripts/obs_report.py`` folds a run's JSONL + heartbeats + telemetry CSV
 into one human-readable summary (``--format json`` for machines), and

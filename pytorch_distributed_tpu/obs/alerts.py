@@ -32,7 +32,13 @@ Rule kinds (anchors in parentheses):
   (the serving engine's ``ttft_p99_ms`` SLO field, serving/engine.py);
 - ``kv_occupancy``    paged KV pool occupancy above ``max_pct`` — the
   early-warning fence before the pool exhausts and preemption starts
-  (serving/kvpool.py ``kv_occupancy_pct``).
+  (serving/kvpool.py ``kv_occupancy_pct``);
+- ``queue_wait_share``  rolling p99 share of TTFT spent in pure queue
+  wait above ``max_pct`` (obs/reqtrace.py attribution — *why* TTFT is
+  breaching: admission backlog, not compute);
+- ``preempt_redo``    rolling p99 preempt-redo cost per request above
+  ``max_ms`` (obs/reqtrace.py — recompute-storm attribution: the KV
+  pool is thrashing, grow it or cap admission).
 
 Firing alerts are **booked as ``alert`` ft_events** into the same JSONL
 through the engine's ``emit`` callback (the trainers wire it to
@@ -78,11 +84,14 @@ _RULE_SPECS: Dict[str, tuple] = {
     "bench_stale": ({"max_days"}, {"lkg_path", "events_path"}),
     "ttft_p99": ({"max_ms"}, set()),
     "kv_occupancy": ({"max_pct"}, set()),
+    "queue_wait_share": ({"max_pct"}, set()),
+    "preempt_redo": ({"max_ms"}, set()),
 }
 RULE_KINDS = tuple(sorted(_RULE_SPECS))
 
 _STEP_RULE_KINDS = ("step_time_p95", "goodput_floor", "exposed_comm",
-                    "mem_peak", "ttft_p99", "kv_occupancy")
+                    "mem_peak", "ttft_p99", "kv_occupancy",
+                    "queue_wait_share", "preempt_redo")
 
 
 class AlertRuleError(ValueError):
@@ -469,6 +478,36 @@ class AlertEngine:
                     rule, key=key, step=step, value=float(v), threshold=cap,
                     rank=proc,
                     detail=f"KV occupancy {float(v):.1f}% > {cap:g}%")
+            else:
+                self._clear(key)
+
+        for rule in self._by_kind.get("queue_wait_share", ()):
+            v = rec.get("queue_wait_share_p99")
+            if v is None:
+                continue
+            cap = float(rule.params["max_pct"])
+            key = (rule.name, proc)
+            if float(v) > cap:
+                fired += self._fire(
+                    rule, key=key, step=step, value=float(v), threshold=cap,
+                    rank=proc,
+                    detail=f"queue-wait share p99 {float(v):.1f}% of TTFT "
+                           f"> {cap:g}%")
+            else:
+                self._clear(key)
+
+        for rule in self._by_kind.get("preempt_redo", ()):
+            v = rec.get("preempt_redo_ms_p99")
+            if v is None:
+                continue
+            cap = float(rule.params["max_ms"])
+            key = (rule.name, proc)
+            if float(v) > cap:
+                fired += self._fire(
+                    rule, key=key, step=step, value=float(v), threshold=cap,
+                    rank=proc,
+                    detail=f"preempt-redo p99 {float(v):.1f}ms/request "
+                           f"> {cap:g}ms")
             else:
                 self._clear(key)
 

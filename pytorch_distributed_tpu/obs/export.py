@@ -55,6 +55,19 @@ _SERVING_FIELDS = {
     "preemptions": ("ptd_serving_preemptions_total", {}),
     "requests_completed": ("ptd_serving_requests_completed_total", {}),
     "tokens_per_s": ("ptd_serving_tokens_per_second", {}),
+    # request-trace attribution gauges (obs/reqtrace.py step_fields):
+    # the *why* behind a ptd_serving_ttft_ms breach — queue backlog vs
+    # preemption-recompute thrash — live on /metrics.
+    "queue_wait_share_p50": ("ptd_serving_attr_queue_wait_share_pct",
+                             {"quantile": "p50"}),
+    "queue_wait_share_p99": ("ptd_serving_attr_queue_wait_share_pct",
+                             {"quantile": "p99"}),
+    "preempt_redo_ms_p50": ("ptd_serving_attr_preempt_redo_ms",
+                            {"quantile": "p50"}),
+    "preempt_redo_ms_p99": ("ptd_serving_attr_preempt_redo_ms",
+                            {"quantile": "p99"}),
+    "trace_completed": ("ptd_serving_attr_traces_total", {}),
+    "trace_spans_dropped": ("ptd_serving_attr_spans_dropped_total", {}),
 }
 _SKIP_FIELDS = ({"step", "t", "process", "epoch"} | set(_STAT_FIELDS)
                 | set(_SERVING_FIELDS))

@@ -504,6 +504,7 @@ def clock_offsets_from_heartbeats(hb_dir: str) -> Dict[int, float]:
 def to_chrome_trace(timelines: Sequence[Tuple[int, Timeline]],
                     offsets_s: Optional[Dict[int, float]] = None,
                     mem_ledgers: Optional[Sequence[Any]] = None,
+                    req_traces: Optional[Sequence[Dict[str, Any]]] = None,
                     ) -> Dict[str, Any]:
     """Merge per-rank timelines into one Chrome-trace/Perfetto JSON dict.
 
@@ -515,7 +516,13 @@ def to_chrome_trace(timelines: Sequence[Tuple[int, Timeline]],
     ``mem_ledgers``: optional ``obs.memory.MemLedger`` list (from
     ``--mem-ledger``); each ledger's watermark curve is stretched over
     every rank's captured span and merged as a Perfetto counter track
-    ("ph": "C") so the HBM profile reads against the op timeline."""
+    ("ph": "C") so the HBM profile reads against the op timeline.
+
+    ``req_traces``: optional serving trace records (the ``reqtrace``
+    ft_events of obs/reqtrace.py) merged as one per-request track group —
+    a request's queue/prefill/decode/preempt spans read against the
+    engine's step timeline.  Engine-clock seconds; align the capture
+    start to the engine clock zero (both start at the first step)."""
     offsets_s = offsets_s or {}
     events: List[Dict[str, Any]] = []
     for rank, tl in timelines:
@@ -558,6 +565,30 @@ def to_chrome_trace(timelines: Sequence[Tuple[int, Timeline]],
                 events.extend(memory.watermark_counter_events(
                     led, t0_us, t1_us, pid=rank,
                     name=f"hbm_watermark · {led.step}"))
+    if req_traces:
+        # local import via path so a jax-free caller (scripts/obs_trace)
+        # and the package both resolve the same helper.
+        import importlib.util as _ilu
+        import os as _os
+        import sys as _sys
+
+        full = "pytorch_distributed_tpu.obs.reqtrace"
+        mod = _sys.modules.get(full) or _sys.modules.get("_ptd_obs_reqtrace")
+        if mod is None:
+            if "pytorch_distributed_tpu" in _sys.modules:
+                import importlib as _il
+
+                mod = _il.import_module(full)
+            else:
+                spec = _ilu.spec_from_file_location(
+                    "_ptd_obs_reqtrace",
+                    _os.path.join(_os.path.dirname(_os.path.abspath(
+                        __file__)), "reqtrace.py"))
+                mod = _ilu.module_from_spec(spec)
+                _sys.modules["_ptd_obs_reqtrace"] = mod
+                spec.loader.exec_module(mod)
+        pid = max((r for r, _ in timelines), default=-1) + 1
+        events.extend(mod.chrome_events(req_traces, pid=pid))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -169,7 +169,7 @@ class ServingEngine:
                  gamma: int = 0, draft_params=None,
                  policy: str = "fcfs", mode: str = "continuous",
                  defrag_threshold_pct: float = 50.0,
-                 obs=None, watchdog=None, chaos=None,
+                 obs=None, watchdog=None, chaos=None, trace=None,
                  stream: Optional[Callable[[int, int, str], None]] = None,
                  time_fn: Callable[[], float] = time.monotonic,
                  sleep_fn: Callable[[float], None] = time.sleep,
@@ -195,6 +195,11 @@ class ServingEngine:
         self.obs = obs
         self.watchdog = watchdog
         self.chaos = chaos
+        # per-request tracer (obs/reqtrace.ReqTracer).  Every hook below
+        # is guarded by ``is not None`` so the untraced hot path pays
+        # one branch; traced hooks are tuple appends + clock reads —
+        # fenced <2% tokens/s in RESULTS_reqtrace.json.
+        self.trace = trace
         self.stream = stream
         self._time_fn = time_fn
         self._sleep_fn = sleep_fn
@@ -264,7 +269,11 @@ class ServingEngine:
                 f"prompt of {P} tokens does not fit a {cap}-token block "
                 f"table (gamma={self.gamma})")
         req.max_new_tokens = min(req.max_new_tokens, limit)
-        self.sched.submit(req, now=self._now())
+        now = self._now()   # one stamp: tracer submit_t == arrival_time
+        if self.trace is not None:
+            req.trace_ctx = self.trace.on_submit(
+                req.rid, now, priority=req.priority)
+        self.sched.submit(req, now=now)
 
     # --------------------------------------------------------------- prefill
     def _prefill(self, slot: int, req: Request) -> None:
@@ -287,6 +296,7 @@ class ServingEngine:
                            np.int32(valid), self._next_key(),
                            self._next_key()))
         tok = None
+        t_marks = [self._now()] if self.trace is not None else None
         with self._watch("serve_prefill"):
             for chunk, lo, valid, key, dkey in chunks:
                 tok, self.pk, self.pv = self.steps.prefill(
@@ -296,8 +306,17 @@ class ServingEngine:
                     _, self.dpk, self.dpv = self.dsteps.prefill(
                         self.draft_params, self.dpk, self.dpv,
                         chunk, lo, valid, table, dkey)
+                if t_marks is not None:
+                    t_marks.append(self._now())   # chunk dispatch boundary
         seed = int(np.asarray(tok))
         now = self._now()
+        if t_marks is not None:
+            # fold the host sync into the last chunk's span; the prefill
+            # end mark IS the first-token stamp below, so the tracer's
+            # TTFT equals the engine's sample exactly.
+            t_marks[-1] = now
+            self.trace.on_prefill(req.rid, t_marks,
+                                  redo=req.first_token_time is not None)
         if req.first_token_time is None:
             req.first_token_time = now
             self.ttft_samples.append(now - req.arrival_time)
@@ -313,6 +332,8 @@ class ServingEngine:
               first: bool = False) -> None:
         req.generated.append(token)
         self.total_tokens += 1
+        if self.trace is not None:
+            self.trace.on_emit(req.rid, now, first)
         if self.stream is not None:
             self.stream(req.rid, token, "first" if first else "token")
 
@@ -321,6 +342,10 @@ class ServingEngine:
         self.pool.free(req.rid)
         self._offsets[slot] = 0
         self._last[slot] = 0
+        if self.trace is not None:
+            self.trace.on_complete(req.rid, req.finish_time,
+                                   tokens=len(req.generated),
+                                   preemptions=req.preemptions)
         self.finished.append(req)
 
     # ------------------------------------------------------------ preemption
@@ -330,6 +355,8 @@ class ServingEngine:
         self._offsets[slot] = 0
         self._last[slot] = 0
         self.sched.preempt(slot)
+        if self.trace is not None:
+            self.trace.on_preempt(req.rid, self._now())
         if self.obs is not None:
             self.obs.log_event("serve_preempt", step=self._step, rid=req.rid)
 
@@ -373,6 +400,8 @@ class ServingEngine:
                 return True
 
             for slot, req in self.sched.admit(can_admit):
+                if self.trace is not None:
+                    self.trace.on_admit(req.rid, self._now())
                 self._prefill(slot, req)
 
         emitted = 0
@@ -395,13 +424,17 @@ class ServingEngine:
                 emitted += self._decode(live)
 
         if self.pool.fragmentation_pct() > self.defrag_threshold_pct:
+            t_df = self._now() if self.trace is not None else 0.0
             self._defrag()
+            if self.trace is not None:
+                self.trace.on_defrag(t_df, self._now())
 
         if emitted or active:
             self._log_metrics(self._now() - t_start, emitted)
         return emitted
 
     def _decode(self, live) -> int:
+        t_dec = self._now() if self.trace is not None else 0.0
         sids = [None] * self.max_batch
         for slot, req in live:
             sids[slot] = req.rid
@@ -424,6 +457,8 @@ class ServingEngine:
         for slot, req in live:
             toks = (arr[slot][arr[slot] >= 0].tolist()
                     if arr.ndim == 2 else [int(arr[slot])])
+            if self.trace is not None:
+                self.trace.on_decode(req.rid, t_dec, now, len(toks))
             gap = now - self._last_emit[slot]
             for t in toks:
                 self._emit(slot, req, t, now)
@@ -475,8 +510,19 @@ class ServingEngine:
                 v = _pct_ms(samples, q)
                 if v is not None:
                     extra[f"{name}_p{int(q * 100)}_ms"] = v
+        if self.trace is not None:
+            extra.update(self.trace.step_fields())
         self.obs.log_step(self._step, step_time, n_items=emitted,
                           extra=extra)
+        self._drain_traces()
+
+    def _drain_traces(self) -> None:
+        """Lazy flush: book completed trace records as ``reqtrace``
+        ft_events, one per request, once per step — never per token."""
+        if self.trace is None or self.obs is None:
+            return
+        for ev in self.trace.drain():
+            self.obs.log_event("reqtrace", step=self._step, **ev)
 
     # ------------------------------------------------------------------- run
     def run(self, load: List, max_steps: int = 100000) -> Dict[str, Any]:
@@ -496,6 +542,9 @@ class ServingEngine:
                                    0.0))
                 continue
             self.step()
+        # final drain: a request that completes on the run's last step
+        # (or a step whose metrics record was skipped) must still land.
+        self._drain_traces()
         return self.summary()
 
     def summary(self) -> Dict[str, Any]:

@@ -38,6 +38,15 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     preemptions: int = 0
+    # Propagatable trace identity (obs/reqtrace.TraceContext, or any
+    # object with a ``hops`` list).  Duck-typed on purpose: serving/
+    # stays import-free of obs/, and a router can hand in its own
+    # context record — the scheduler just appends lifecycle hops.
+    trace_ctx: Optional[Any] = None
+
+    def _hop(self, name: str) -> None:
+        if self.trace_ctx is not None:
+            self.trace_ctx.hops.append(name)
 
     @property
     def done(self) -> bool:
@@ -72,6 +81,7 @@ class Scheduler:
     def submit(self, req: Request, now: float = 0.0) -> None:
         req.arrival_time = now
         self._order[req.rid] = next(self._seq)
+        req._hop("queue")
         heapq.heappush(self._heap,
                        (self._key(req), next(self._tiebreak), req))
 
@@ -111,6 +121,7 @@ class Scheduler:
             self.slots[slot] = req
             self._admitted_at[req.rid] = next(self._admit_seq)
             self.admitted += 1
+            req._hop("admit")
             placed.append((slot, req))
         return placed
 
@@ -138,6 +149,7 @@ class Scheduler:
         req.generated = []
         req.preemptions += 1
         self.preemptions += 1
+        req._hop("requeue")
         heapq.heappush(self._heap,
                        (self._key(req), next(self._tiebreak), req))
         return req
@@ -150,4 +162,5 @@ class Scheduler:
         self._admitted_at.pop(req.rid, None)
         req.finish_time = now
         self.completed += 1
+        req._hop("finish")
         return req

@@ -137,10 +137,80 @@ def import_resnet_state_dict(state_dict: Mapping) -> Dict[str, Dict]:
     return {"params": params, "batch_stats": stats}
 
 
+def import_lm_state_dict(state_dict: Mapping) -> Dict[str, Dict]:
+    """torch GPT-style LM state_dict → ``{"params": ...}`` matching
+    ``models/transformer.py TransformerLM`` (and the serving engine's
+    ``PagedTransformerLM`` — same tree).
+
+    Expected torch naming (the decoder-only shape of minGPT/nanoGPT-style
+    references, one linear per projection):
+
+    - ``embed.weight``                       [V, D]   (head is tied)
+    - ``blocks.{i}.ln1|ln2.weight/bias``     LayerNorm
+    - ``blocks.{i}.attn.qkv.weight``         [3D, D]  (no bias)
+    - ``blocks.{i}.attn.proj.weight``        [D, D]   (no bias)
+    - ``blocks.{i}.fc1.weight/bias``         [4D, D]
+    - ``blocks.{i}.fc2.weight/bias``         [D, 4D]
+    - ``ln_f.weight/bias``                   final LayerNorm
+
+    An explicit ``head.weight`` is accepted only when it equals
+    ``embed.weight`` (this framework ties the output head); anything else
+    raises with the offending key.
+    """
+    sd = {re.sub(r"^module\.", "", k): v for k, v in state_dict.items()}
+    if "embed.weight" not in sd:
+        raise ValueError(
+            "not an LM state_dict: missing 'embed.weight' "
+            f"(got keys like {sorted(sd)[:3]}...)")
+    if "head.weight" in sd and not np.array_equal(
+            _np(sd["head.weight"]), _np(sd["embed.weight"])):
+        raise ValueError(
+            "untied 'head.weight' is not supported: this framework ties "
+            "the output head to embed.weight")
+
+    def _ln(prefix):
+        return {"scale": _np(sd[f"{prefix}.weight"]).astype(np.float32),
+                "bias": _np(sd[f"{prefix}.bias"]).astype(np.float32)}
+
+    def _linear(key, bias=True):
+        out = {"kernel": _np(sd[f"{key}.weight"]).transpose(1, 0)
+               .astype(np.float32)}  # [out,in] -> [in,out]
+        if bias:
+            out["bias"] = _np(sd[f"{key}.bias"]).astype(np.float32)
+        return out
+
+    idx_re = re.compile(r"^blocks\.(\d+)\.")
+    layers = {int(m.group(1)) for k in sd for m in [idx_re.match(k)] if m}
+    if layers and sorted(layers) != list(range(len(layers))):
+        raise ValueError(f"non-contiguous block indices: {sorted(layers)}")
+    n_layers = len(layers)
+    if n_layers == 0:
+        raise ValueError("LM state_dict has no 'blocks.{i}.*' keys")
+
+    params: Dict[str, Any] = {
+        "embed": {"embedding": _np(sd["embed.weight"]).astype(np.float32)},
+    }
+    for i in range(n_layers):
+        t = f"blocks.{i}"
+        params[f"block_{i}"] = {
+            "ln1": _ln(f"{t}.ln1"),
+            "ln2": _ln(f"{t}.ln2"),
+            "attn": {"qkv": _linear(f"{t}.attn.qkv", bias=False),
+                     "proj": _linear(f"{t}.attn.proj", bias=False)},
+            "fc1": _linear(f"{t}.fc1"),
+            "fc2": _linear(f"{t}.fc2"),
+        }
+    params["ln_f"] = _ln("ln_f")
+    return {"params": params}
+
+
 def import_torch_checkpoint(payload: Mapping) -> Tuple[Dict[str, Dict], Dict]:
     """Reference ``checkpoint.pth.tar`` payload (already ``torch.load``-ed)
-    → ``(variables, meta)``."""
+    → ``(variables, meta)``.  Dispatches on the state_dict's family:
+    ``conv1.weight`` ⇒ torchvision ResNet, ``embed.weight`` ⇒ LM."""
     sd, meta = unwrap_reference_checkpoint(payload)
+    if "embed.weight" in {re.sub(r"^module\.", "", k) for k in sd}:
+        return import_lm_state_dict(sd), meta
     return import_resnet_state_dict(sd), meta
 
 
@@ -162,7 +232,8 @@ def save_as_pretrained(
         "state": {
             "step": np.int32(0),
             "params": params,
-            "batch_stats": variables["batch_stats"],
+            # LMs carry no BN stats -> empty dict keeps the payload shape
+            "batch_stats": variables.get("batch_stats", {}),
             # torch-parity SGD momentum buffers start at zero
             # (train/optim.py sgd_init).
             "momentum": _tree_zeros(params),

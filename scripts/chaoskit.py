@@ -126,6 +126,8 @@ def cmd_drill(args) -> int:
         return _drill_alert(args)
     if args.kind == "serve":
         return _drill_serve(args)
+    if args.kind == "trace":
+        return _drill_trace(args)
     world = args.world
     if world < 2 or world > len(jax.devices()):
         print(f"need 2 <= --world <= {len(jax.devices())} devices, "
@@ -519,6 +521,221 @@ def _drill_serve(args) -> int:
     return 0
 
 
+class _PreemptStorm:
+    """Chaos injector for ``drill trace``: from ``start`` on, evict the
+    scheduler's preferred victim every ``every`` steps (duck-typed into
+    the engine's ``chaos.on_step`` hook).  The guard keeps at least one
+    lane live so the run always terminates."""
+
+    def __init__(self, every: int = 1, start: int = 3):
+        self.every, self.start = every, start
+
+    def on_step(self, eng, step: int) -> None:
+        if step < self.start or step % self.every:
+            return
+        if len(eng.sched.active) > 1:
+            victim = eng.sched.pick_victim()
+            if victim is not None:
+                eng._preempt(victim)
+
+
+def _drill_trace(args) -> int:
+    """Request-tracing drill (ISSUE 17): a seeded preemption storm — a
+    tiny KV pool under priority scheduling plus a ``_PreemptStorm``
+    injector evicting a victim every step — thrashes every request
+    through preempt/requeue/recompute.  Passes iff:
+
+    - every request still completes (bit-exact recompute contract);
+    - the per-request tail attribution (obs/reqtrace.py via
+      ``obs_trace.py --json``) names **preempt_redo** as the dominant
+      TTFT component — the storm is visible as *recompute thrash*, not
+      mis-filed as queue wait;
+    - the ``preempt_redo`` alert fires live on the rank's ``/metrics``
+      exporter (``ptd_alert_firing`` + ``ptd_serving_attr_*`` gauges)
+      and is booked as an ``alert`` ft_event in the JSONL;
+    - ``obs_report`` folds the ``== traces ==`` section from the file.
+    """
+    import json as _json
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    import numpy as np
+
+    from pytorch_distributed_tpu.obs.alerts import AlertEngine, Rule
+    from pytorch_distributed_tpu.obs.export import (
+        MetricsExporter,
+        parse_prometheus,
+    )
+    from pytorch_distributed_tpu.obs.metrics import (
+        MetricsLogger,
+        read_metrics,
+    )
+    from pytorch_distributed_tpu.obs.reqtrace import ReqTracer
+    from pytorch_distributed_tpu.serving.engine import (
+        ServingEngine,
+        init_lm_params,
+    )
+    from pytorch_distributed_tpu.serving.scheduler import Request
+
+    out = args.out or tempfile.mkdtemp(prefix="trace-drill-")
+    os.makedirs(out, exist_ok=True)
+    mpath = os.path.join(out, "serving.jsonl")
+    n_requests, slo_ms = 24, 40.0
+    with socket.socket() as s:  # free localhost port for the exporter
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    print(f"drill trace: preemption storm over a 24-block pool, "
+          f"{n_requests} requests vs {slo_ms:.0f}ms TTFT SLO, exporter "
+          f"on :{port}, artifacts in '{out}'")
+
+    cfg = dict(vocab_size=64, d_model=64, n_heads=4, n_layers=2,
+               max_batch=4, kv_blocks=24, block_size=4, blocks_per_seq=8,
+               chunk_size=4, max_new_tokens=6, policy="priority",
+               defrag_threshold_pct=200.0)  # never defrag: isolate redo
+    params = init_lm_params(cfg["vocab_size"], cfg["d_model"],
+                            cfg["n_heads"], cfg["n_layers"],
+                            block_size=cfg["block_size"], seed=args.seed)
+
+    # warmup engine: same jit cache (lru_cached step fns), so the
+    # measured run's first prefill doesn't carry compile time into its
+    # attribution
+    warm = ServingEngine(params, seed=args.seed, **cfg)
+    warm.run([(0.0, Request(rid=0, prompt=[1] * 8, max_new_tokens=2))])
+
+    obs = MetricsLogger(mpath, flush_every=1)
+    alert_engine = AlertEngine(
+        [Rule("preempt_redo", "preempt_redo", "page", {"max_ms": 50.0}),
+         Rule("queue_wait_share", "queue_wait_share", "warn",
+              {"max_pct": 15.0})],
+        emit=lambda **f: obs.log_event("alert", **f))
+    exporter = MetricsExporter(port, rank=0, engine=alert_engine)
+    exporter.start()
+    obs.register(alert_engine.observe)
+    obs.register(exporter.update)
+    tracer = ReqTracer(slo_ms=slo_ms, sample=1.0)
+
+    # scrape /metrics concurrently: the preempt_redo alert and the
+    # ptd_serving_attr_* gauges must be visible while the run is live
+    seen = {"firing": set(), "gauges": set(), "scrapes": 0}
+    stop = threading.Event()
+
+    def _scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1.0) as r:
+                    samples = parse_prometheus(
+                        r.read().decode("utf-8", "replace"))
+                seen["scrapes"] += 1
+                for name, lab, v in samples:
+                    if name == "ptd_alert_firing" and v:
+                        seen["firing"].add(lab.get("rule"))
+                    if name.startswith("ptd_serving_attr_"):
+                        seen["gauges"].add(name)
+            except Exception:
+                pass
+            stop.wait(0.05)
+
+    th = threading.Thread(target=_scrape, daemon=True)
+    th.start()
+
+    eng = ServingEngine(params, obs=obs, chaos=_PreemptStorm(every=1,
+                                                             start=3),
+                        trace=tracer, seed=args.seed, **cfg)
+    rng = np.random.RandomState(7)
+    load = []
+    for i in range(n_requests):
+        prompt = [int(x) for x in
+                  rng.randint(1, cfg["vocab_size"],
+                              size=int(rng.randint(20, 29)))]
+        load.append((i * 0.002, Request(
+            rid=i, prompt=prompt, max_new_tokens=cfg["max_new_tokens"],
+            priority=2 if i % 3 == 0 else 0)))
+    try:
+        summary = eng.run(load)
+    finally:
+        stop.set()
+        th.join(timeout=2.0)
+        exporter.stop()
+        obs.close()
+
+    ok = True
+    if summary["completed"] != n_requests:
+        print(f"FAIL: {summary['completed']}/{n_requests} requests "
+              f"completed under the storm")
+        ok = False
+    if summary.get("preemptions", 0) < n_requests:
+        print(f"FAIL: storm too weak — {summary.get('preemptions')} "
+              f"preemption(s)")
+        ok = False
+
+    scripts = os.path.dirname(os.path.abspath(__file__))
+    probe = subprocess.run(
+        [sys.executable, os.path.join(scripts, "obs_trace.py"),
+         "--metrics-jsonl", mpath, "--json"],
+        capture_output=True, text=True)
+    attr = _json.loads(probe.stdout) if probe.returncode == 0 else {}
+    dominant = (attr.get("tail") or {}).get("dominant")
+    if dominant != "preempt_redo":
+        print(f"FAIL: tail attribution names {dominant!r}, want "
+              f"'preempt_redo' (obs_trace rc {probe.returncode})")
+        ok = False
+    if attr and attr.get("recon_err_ms_max", 1e9) >= 0.05:
+        print(f"FAIL: component sums drifted from TTFT by "
+              f"{attr['recon_err_ms_max']:.3f}ms")
+        ok = False
+    if attr.get("violations", 0) < 1:
+        print("FAIL: storm produced no SLO violations to attribute")
+        ok = False
+
+    if "preempt_redo" not in seen["firing"]:
+        print(f"FAIL: live scrape never saw ptd_alert_firing{{rule="
+              f"\"preempt_redo\"}} ({seen['scrapes']} scrape(s), saw "
+              f"{sorted(seen['firing'])})")
+        ok = False
+    if "ptd_serving_attr_preempt_redo_ms" not in seen["gauges"]:
+        print(f"FAIL: live scrape never saw the ptd_serving_attr_* "
+              f"gauges (saw {sorted(seen['gauges'])})")
+        ok = False
+    booked = {str(e.get("alert")) for e in read_metrics(mpath)
+              if e.get("ft_event") == "alert"}
+    if "preempt_redo" not in booked:
+        print(f"FAIL: no 'preempt_redo' alert ft_event in '{mpath}' "
+              f"(booked: {sorted(booked)})")
+        ok = False
+
+    rep = subprocess.run(
+        [sys.executable, os.path.join(scripts, "obs_report.py"),
+         "--metrics-jsonl", mpath],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    for needle in ("== traces ==", "dominant tail component: preempt_redo",
+                   "== alerts =="):
+        if needle not in rep.stdout:
+            print(f"FAIL: obs_report did not fold {needle!r} "
+                  f"(rc {rep.returncode})")
+            ok = False
+    if not ok:
+        return 1
+    shares = (attr["tail"]["shares_pct"] if attr else {})
+    print(_json.dumps({"completed": summary["completed"],
+                       "preemptions": summary.get("preemptions"),
+                       "violations": attr.get("violations"),
+                       "preempt_redo_ms_p99":
+                           attr.get("preempt_redo_ms_p99"),
+                       "redo_share_pct":
+                           round(shares.get("preempt_redo", 0.0), 1)},
+                      sort_keys=True))
+    print(f"drill trace: preempt_redo owns "
+          f"{shares.get('preempt_redo', 0.0):.0f}% of the p99 TTFT, "
+          f"alert booked live")
+    print("drill trace: OK")
+    return 0
+
+
 def _selftest() -> int:
     """No-mesh FT fast path: every assertion here runs in well under a
     second with zero jax involvement."""
@@ -662,14 +879,18 @@ def main(argv=None) -> int:
     d = sub.add_parser("drill",
                        help="run an end-to-end elastic membership drill")
     d.add_argument("kind",
-                   choices=("shrink", "grow", "hang", "alert", "serve"),
+                   choices=("shrink", "grow", "hang", "alert", "serve",
+                            "trace"),
                    help="shrink: lose a rank and continue; grow: lose "
                         "then re-admit it; hang: stall a rank inside a "
                         "collective and let the watchdog catch it; "
                         "alert: slow/dead/stale injections must each "
                         "raise their matching live alert; serve: a "
                         "straggler under the serving engine must fire "
-                        "the ttft_p99 SLO alert live")
+                        "the ttft_p99 SLO alert live; trace: a "
+                        "preemption storm whose request-trace tail "
+                        "attribution must name preempt_redo and fire "
+                        "the preempt_redo alert live")
     d.add_argument("--world", type=int, default=4,
                    help="starting data-parallel world size")
     d.add_argument("--steps", type=int, default=12)

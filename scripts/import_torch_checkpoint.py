@@ -1,59 +1,34 @@
 #!/usr/bin/env python
-"""Convert a reference/torchvision ResNet checkpoint to this framework.
+"""Convert a reference/torchvision checkpoint to this framework.
 
 Usage:
     python scripts/import_torch_checkpoint.py \
         --input checkpoint.pth.tar --arch resnet50 --out-dir pretrained
+    python scripts/import_torch_checkpoint.py \
+        --input gpt_mini.pth --arch lm_mini --out-dir pretrained   # LM
 
 Reads the reference's ``checkpoint.pth.tar`` (payload layout of reference
-distributed.py:219-225) or a bare torchvision ``state_dict`` file, converts
-layouts (see utils/torch_import.py), validates the tree against a fresh
-``create_model(arch)`` init, and writes ``<out-dir>/<arch>.msgpack`` — ready
-for ``--pretrained`` (with ``PTD_TPU_PRETRAINED_DIR=<out-dir>``).
+distributed.py:219-225) or a bare ``state_dict`` file, converts layouts
+(see utils/torch_import.py), validates the tree against a fresh model
+init, and writes ``<out-dir>/<arch>.msgpack``.  The family is detected
+from the state_dict itself: ``conv1.weight`` ⇒ torchvision ResNet
+(validated against ``create_model(arch)``, ready for ``--pretrained``
+with ``PTD_TPU_PRETRAINED_DIR=<out-dir>``); ``embed.weight`` ⇒ GPT-style
+LM (validated against ``TransformerLM``, ready for
+``serve_lm.py --checkpoint <path>``).
 """
 
 import argparse
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--input", required=True, help="torch .pth/.pth.tar file")
-    ap.add_argument("--arch", default=None,
-                    help="arch name (defaults to the checkpoint's own "
-                         "'arch' field)")
-    ap.add_argument("--out-dir", default="pretrained")
-    ap.add_argument("--num-classes", type=int, default=1000)
-    args = ap.parse_args()
 
-    import torch  # CPU build is enough
+def _validate_tree(ref, variables, arch, colls) -> None:
+    import flax
 
-    payload = torch.load(args.input, map_location="cpu", weights_only=False)
-    from pytorch_distributed_tpu.utils.torch_import import (
-        import_torch_checkpoint, save_as_pretrained,
-    )
-
-    variables, meta = import_torch_checkpoint(payload)
-    arch = args.arch or meta.get("arch")
-    if not arch:
-        sys.exit("--arch required: checkpoint has no 'arch' field")
-
-    # Validate against a fresh init of the same arch (shape + structure).
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
-    from pytorch_distributed_tpu import models
-
-    model = models.create_model(arch, num_classes=args.num_classes)
-    ref = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((1, 64, 64, 3)), train=False)
-    )
-    for coll in ("params", "batch_stats"):
-        import flax
-
+    for coll in colls:
         want = flax.traverse_util.flatten_dict(ref[coll])
         got = flax.traverse_util.flatten_dict(variables[coll])
         if set(want) != set(got):
@@ -65,6 +40,59 @@ def main() -> int:
             if tuple(want[k].shape) != tuple(got[k].shape):
                 sys.exit(f"shape mismatch at {'/'.join(k)}: "
                          f"checkpoint {got[k].shape} vs model {want[k].shape}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--input", required=True, help="torch .pth/.pth.tar file")
+    ap.add_argument("--arch", default=None,
+                    help="arch name (defaults to the checkpoint's own "
+                         "'arch' field; LMs fall back to 'lm')")
+    ap.add_argument("--out-dir", default="pretrained")
+    ap.add_argument("--num-classes", type=int, default=1000)
+    args = ap.parse_args(argv)
+
+    import torch  # CPU build is enough
+
+    payload = torch.load(args.input, map_location="cpu", weights_only=False)
+    from pytorch_distributed_tpu.utils.torch_import import (
+        import_torch_checkpoint, save_as_pretrained,
+    )
+
+    variables, meta = import_torch_checkpoint(payload)
+    is_lm = "embed" in variables["params"]
+    arch = args.arch or meta.get("arch") or ("lm" if is_lm else None)
+    if not arch:
+        sys.exit("--arch required: checkpoint has no 'arch' field")
+
+    # Validate against a fresh init of the same shape (structure + dims).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    if is_lm:
+        from pytorch_distributed_tpu.models.transformer import TransformerLM
+
+        vocab, d_model = variables["params"]["embed"]["embedding"].shape
+        n_layers = sum(1 for k in variables["params"]
+                       if k.startswith("block_"))
+        # n_heads never shapes the param tree (qkv is one [D,3D] matmul)
+        model = TransformerLM(vocab_size=int(vocab), d_model=int(d_model),
+                              n_heads=1, n_layers=n_layers)
+        ref = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), dtype=jnp.int32)))
+        _validate_tree(ref, variables, arch, ("params",))
+    else:
+        from pytorch_distributed_tpu import models
+
+        model = models.create_model(arch, num_classes=args.num_classes)
+        ref = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 64, 64, 3)), train=False)
+        )
+        _validate_tree(ref, variables, arch, ("params", "batch_stats"))
 
     path = save_as_pretrained(args.out_dir, arch, variables, meta)
     print(f"wrote {path} (epoch={meta.get('epoch', 0)}, "

@@ -120,6 +120,14 @@ def pseudo_record(samples, rank: int):
     for name, lab, v in samples:
         if name == "ptd_metric" and lab.get("rank") == str(rank):
             rec.setdefault(lab.get("field", "?"), float(v))
+    # serving gauges (ptd_serving_*, incl. the ptd_serving_attr_* request-
+    # trace attribution) fold back into their record fields, so the
+    # aggregator evaluates ttft_p99 / queue_wait_share / preempt_redo
+    # rules from a scrape exactly like the engine does from the record
+    for field, (gname, labels) in export._SERVING_FIELDS.items():
+        v = export.sample_value(samples, gname, rank=rank, **labels)
+        if v is not None:
+            rec.setdefault(field, float(v))
     age = export.sample_value(samples, "ptd_record_age_seconds", rank=rank)
     rec["t"] = time.time() - float(age or 0.0)
     return rec if "step_time" in rec else None
@@ -210,6 +218,11 @@ class FleetMonitor:
                 "alerts_total": export.sample_value(samples,
                                                     "ptd_alerts_total",
                                                     rank=rank),
+                "q_share_p99": (rec.get("queue_wait_share_p99")
+                                if rec else None),
+                "redo_p99_ms": (rec.get("preempt_redo_ms_p99")
+                                if rec else None),
+                "traces": rec.get("trace_completed") if rec else None,
             }
         beats = {}
         if self.hb_dir:
@@ -260,6 +273,20 @@ class FleetMonitor:
                 f"{_fmt((r.get('mem_bytes') or 0) / 2**20 if r.get('mem_bytes') else None, '.1f'):>8}  "
                 f"{_fmt(r.get('rec_age_s'), '.1f'):>7}  "
                 f"{_fmt(r.get('beat_age_s'), '.1f'):>8}")
+        attr = [r for _k, r in sorted(self.rows.items(), key=lambda kv:
+                                      str(kv[0]))
+                if r.get("q_share_p99") is not None
+                or r.get("redo_p99_ms") is not None]
+        if attr:
+            lines.append("-- serving attribution (why TTFT moves) --")
+            for r in attr:
+                lines.append(
+                    f"  rank {_fmt(r.get('rank'), 'd', '?')}: "
+                    f"queue-wait share p99 "
+                    f"{_fmt(r.get('q_share_p99'), '.1f')}% of TTFT;  "
+                    f"preempt-redo p99 "
+                    f"{_fmt(r.get('redo_p99_ms'), '.1f')}ms;  "
+                    f"traces {_fmt(r.get('traces'), '.0f')}")
         active = self.engine.active()
         if active:
             lines.append("-- alerts firing (aggregator) --")
@@ -331,7 +358,10 @@ def _selftest() -> int:
                     "step_time": 0.020, "step_time_ema": 0.021,
                     "step_time_p50": 0.019, "step_time_p95": 0.028,
                     "step_time_max": 0.030, "throughput": 51200.0,
-                    "loss": 2.5})
+                    "loss": 2.5, "serving": 1.0,
+                    "queue_wait_share_p99": 61.5,
+                    "preempt_redo_ms_p99": 209.6,
+                    "trace_completed": 24.0})
         exp.update({"ft_event": "alert", "t": time.time(), "process": 3,
                     "alert": "x", "rule": "hang", "severity": "page"})
         exp.start()
@@ -347,6 +377,24 @@ def _selftest() -> int:
             with urllib.request.urlopen(
                     f"http://127.0.0.1:{exp.port}/healthz") as r:
                 assert json.loads(r.read())["ok"] is True
+            # ptd_serving_attr_* gauges fold back into the pseudo-record
+            # so the aggregator can alert on *why* TTFT is breaching,
+            # and the dashboard names the attribution per rank
+            assert abs(rec["queue_wait_share_p99"] - 61.5) < 1e-9, rec
+            assert abs(rec["preempt_redo_ms_p99"] - 209.6) < 1e-9, rec
+            mon_s = FleetMonitor([url], rules=[
+                alerts.Rule("queue_wait_share", "qw", "warn",
+                            {"max_pct": 50.0}),
+                alerts.Rule("preempt_redo", "redo", "warn",
+                            {"max_ms": 100.0})])
+            fired_s = mon_s.cycle()
+            assert {a.name for a in fired_s} == {"qw", "redo"}, fired_s
+            assert mon_s.any_firing()
+            dash_s = mon_s.dashboard()
+            for needle in ("-- serving attribution", "61.5% of TTFT",
+                           "preempt-redo p99 209.6ms", "traces 24"):
+                assert needle in dash_s, \
+                    f"dashboard missing {needle!r}\n{dash_s}"
         finally:
             exp.stop()
 

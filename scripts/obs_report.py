@@ -627,6 +627,11 @@ def serving_stats(records: List[dict]) -> Optional[Dict]:
     out["kv_frag_peak_pct"] = peak("kv_frag_pct")
     out["defrags"] = float(sum(1 for r in records
                                if r.get("ft_event") == "serve_defrag"))
+    # per-request attribution quantiles (obs/reqtrace.py step_fields):
+    # stamped on serving step records when --req-trace is on; None keeps
+    # untraced serving runs unchanged
+    out["queue_wait_share_p99"] = last("queue_wait_share_p99")
+    out["preempt_redo_ms_p99"] = last("preempt_redo_ms_p99")
     return out
 
 
@@ -655,6 +660,44 @@ def summarize_serving(records: List[dict]) -> List[str]:
     ]
 
 
+def trace_stats(records: List[dict]) -> Optional[Dict]:
+    """Attribution summary over the run's per-request ``reqtrace``
+    events (obs/reqtrace.py); None when tracing was off."""
+    from pytorch_distributed_tpu.obs.reqtrace import (
+        attribution_summary,
+        trace_records,
+    )
+
+    return attribution_summary(trace_records(records))
+
+
+def summarize_traces(records: List[dict]) -> List[str]:
+    """The ``== traces ==`` fold (ISSUE 17): per-request TTFT/e2e
+    critical-path attribution + the tail rollup that names the dominant
+    component behind the p99."""
+    s = trace_stats(records)
+    if s is None:
+        return []
+    from pytorch_distributed_tpu.obs.reqtrace import format_tail_line
+
+    lines = [
+        "== traces ==",
+        f"  {s['requests']} request trace(s); {s['violations']} SLO "
+        f"violation(s); {s['preemptions']} preemption(s); "
+        f"spans kept {s['sampled_kept']}, dropped {s['spans_dropped']}",
+        f"  TTFT p50/p99      {s['ttft_p50_ms']:.1f}ms / "
+        f"{s['ttft_p99_ms']:.1f}ms;  e2e p99 {s['e2e_p99_ms']:.1f}ms;  "
+        f"recon err max {s['recon_err_ms_max']:.3f}ms",
+        f"  queue-wait share p99 {s['queue_wait_share_p99']:.1f}% of "
+        f"TTFT;  preempt-redo p99 {s['preempt_redo_ms_p99']:.1f}ms",
+    ]
+    tail = s.get("tail")
+    if tail:
+        lines.append("  tail attribution: " + format_tail_line(tail))
+        lines.append(f"  dominant tail component: {tail['dominant']}")
+    return lines
+
+
 def report(args) -> str:
     sections = []
     records: List[dict] = []
@@ -676,6 +719,7 @@ def report(args) -> str:
                                      getattr(args, "mem_ledger", None))
         sections += summarize_bench(records, bench_staleness_info(args))
         sections += summarize_serving(records)
+        sections += summarize_traces(records)
     else:
         if getattr(args, "comm_ledger", None):
             sections += summarize_comms([], args.comm_ledger,
@@ -746,6 +790,9 @@ def report_json(args) -> Dict:
         srv = serving_stats(records)
         if srv is not None:
             out["serving"] = srv
+        trc = trace_stats(records)
+        if trc is not None:
+            out["traces"] = trc
     staleness = bench_staleness_info(args)
     if staleness is not None:
         out["bench_staleness"] = staleness
@@ -805,6 +852,17 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
     gp = compute_goodput(records)
     cs = comm_stats(records)
     srv = serving_stats(records)
+    trc = trace_stats(records)
+
+    def attr(field):
+        # prefer the step-record stamp (windowed, what the run saw live);
+        # fall back to the reqtrace events so a trace-only JSONL still
+        # fences — None when neither plane was on
+        v = srv.get(field) if srv else None
+        if v is None and trc is not None:
+            v = trc.get(field)
+        return v
+
     return {
         "steps": float(len(steps)),
         "step_time_p50": _pct(times, .5) if times else None,
@@ -821,6 +879,9 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         # serving SLO fences (None for training runs -> rows skip)
         "ttft_p99_ms": srv["ttft_p99_ms"] if srv else None,
         "tokens_per_s": srv["tokens_per_s"] if srv else None,
+        # per-request attribution fences (--req-trace runs only)
+        "queue_wait_share_p99": attr("queue_wait_share_p99"),
+        "preempt_redo_ms_p99": attr("preempt_redo_ms_p99"),
     }
 
 
@@ -853,6 +914,12 @@ _DIFF_METRICS = (
     # both rows skip, so training diffs are untouched.
     ("ttft_p99_ms", True, False),
     ("tokens_per_s", False, False),
+    # per-request attribution fences (obs/reqtrace.py): both absolute —
+    # the share is percentage points, and a clean baseline books
+    # preempt_redo_ms_p99 == 0 so a relative row would hide a planted
+    # preemption storm behind the zero-baseline guard.
+    ("queue_wait_share_p99", True, True),
+    ("preempt_redo_ms_p99", True, True),
 )
 
 
@@ -909,7 +976,7 @@ def diff_report(a_records: List[dict], b_records: List[dict],
     d = diff_data(a_records, b_records, threshold_pct=threshold_pct,
                   goodput_threshold_pp=goodput_threshold_pp,
                   label_a=label_a, label_b=label_b)
-    w = 16
+    w = 20
     lines = [
         "== diff ==",
         f"  baseline {d['baseline']}: {d['steps_a']:.0f} steps;  "
@@ -930,6 +997,10 @@ def diff_report(a_records: List[dict], b_records: List[dict],
             if name == "alerts":  # a count, not a percentage
                 dtxt = f"{row['delta_pp']:+.0f}"
                 fa, fb = f"{va:.0f}", f"{vb:.0f}"
+            elif name.endswith("_ms") or name.endswith("_ms_p99"):
+                # absolute but milliseconds (preempt_redo_ms_p99)
+                dtxt = f"{row['delta_pp']:+.1f}ms"
+                fa, fb = f"{va:.1f}ms", f"{vb:.1f}ms"
             else:
                 dtxt = f"{row['delta_pp']:+.1f}pp"
                 fa, fb = f"{va:.1f}%", f"{vb:.1f}%"
@@ -1449,6 +1520,84 @@ def _selftest() -> int:
         # training-only diffs skip the serving rows (missing, not a fail)
         assert {r["metric"]: r for r in diff_data(a_recs, b_recs)[
             "metrics"]}["ttft_p99_ms"]["verdict"] == "missing"
+        # ...and untraced serving runs skip the attribution rows
+        assert by_srv["queue_wait_share_p99"]["verdict"] == "missing", ds
+        assert by_srv["preempt_redo_ms_p99"]["verdict"] == "missing", ds
+
+        # ---- traces plane (ISSUE 17): section, json twin, tail rollup ----
+        tpath = os.path.join(d, "traces.jsonl")
+        with MetricsLogger(tpath, flush_every=50) as log:
+            for i in range(8):
+                storm = i >= 6  # two tail requests dominated by redo
+                ttft = 300.0 if storm else 50.0
+                redo = 240.0 if storm else 0.0
+                queue = 40.0 if storm else 35.0
+                log.log_event(
+                    "reqtrace", step=i, rid=i,
+                    trace_id=f"ptd-engine:0-{i:08x}",
+                    ttft_ms=ttft, e2e_ms=ttft + 20.0, tokens=8,
+                    preemptions=3 if storm else 0,
+                    queue_wait_ms=queue, prefill_ms=10.0,
+                    redo_wait_ms=redo, defrag_wait_ms=0.0,
+                    other_wait_ms=ttft - queue - 10.0 - redo,
+                    decode_ms=18.0, redo_own_ms=0.0, defrag_run_ms=0.0,
+                    other_run_ms=2.0, preempt_redo_ms=redo,
+                    queue_wait_share_pct=100.0 * queue / ttft,
+                    violated=1 if storm else 0, n_spans=12,
+                    spans_dropped=0, sampled=1)
+        ns_t = argparse.Namespace(
+            metrics_jsonl=tpath, hb_dir=None, telemetry_csv=None, now=now,
+            max_step_lag=3, max_beat_age=60.0, bench_lkg=None,
+            bench_events=None, bench_max_stale_days=14.0, plan=None,
+            flight_dir=None)
+        trc_out = report(ns_t)
+        for needle in ("== traces ==", "8 request trace(s)",
+                       "2 SLO violation(s)", "6 preemption(s)",
+                       "tail attribution:",
+                       "dominant tail component: preempt_redo"):
+            assert needle in trc_out, (
+                f"selftest: {needle!r} missing from:\n{trc_out}")
+        js_t = report_json(ns_t)
+        assert js_t["traces"]["requests"] == 8, js_t["traces"]
+        assert js_t["traces"]["tail"]["dominant"] == "preempt_redo", (
+            js_t["traces"])
+        json.dumps(js_t)
+        # an untraced run must not grow the section
+        assert "== traces ==" not in srv_out, srv_out
+
+        # planted preemption storm: identical step times / throughput /
+        # TTFT fence inputs -- the NEW attribution rows (and only they)
+        # must flip the diff to REGRESS and the CLI to exit 1
+        base_t = os.path.join(d, "attr_base.jsonl")
+        bad_t = os.path.join(d, "attr_storm.jsonl")
+        for path, (share, redo_ms) in ((base_t, (12.0, 0.0)),
+                                       (bad_t, (55.0, 210.0))):
+            with MetricsLogger(path, flush_every=50) as log:
+                for i in range(10):
+                    log.log_step(i, step_time=0.005, n_items=32,
+                                 extra={"serving": 1.0,
+                                        "tokens_per_s": 512.0,
+                                        "ttft_p99_ms": 80.0,
+                                        "queue_wait_share_p99": share,
+                                        "preempt_redo_ms_p99": redo_ms})
+        ta_recs, _ = load_metrics(base_t)
+        tb_recs, _ = load_metrics(bad_t)
+        dt = diff_data(ta_recs, tb_recs)
+        by_t = {r["metric"]: r for r in dt["metrics"]}
+        assert by_t["queue_wait_share_p99"]["verdict"] == "REGRESS", dt
+        assert by_t["preempt_redo_ms_p99"]["verdict"] == "REGRESS", dt
+        assert by_t["ttft_p99_ms"]["verdict"] == "PASS", dt
+        # the improvement direction passes both rows
+        by_rt = {r["metric"]: r
+                 for r in diff_data(tb_recs, ta_recs)["metrics"]}
+        assert by_rt["queue_wait_share_p99"]["verdict"] == "PASS", by_rt
+        assert by_rt["preempt_redo_ms_p99"]["verdict"] == "PASS", by_rt
+        buf_t = io.StringIO()
+        with contextlib.redirect_stdout(buf_t):
+            rc_t = run_diff(base_t, bad_t, 10.0, 5.0)
+        assert rc_t == 1, (
+            "selftest: planted preemption storm must exit 1")
+        assert "preempt_redo_ms_p99" in buf_t.getvalue(), buf_t.getvalue()
 
         # ---- --flight-dir: the postmortem fold (ISSUE 13) ----
         pm = _postmortem_mod()

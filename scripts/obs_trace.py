@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""obs_trace — per-request serving-trace analyzer (ISSUE 17).
+
+Reads the ``reqtrace`` ft_events the serving engine books into the
+metrics JSONL (``serve_lm.py --req-trace --metrics-jsonl ...``, recorder
+in obs/reqtrace.py) and answers the question the aggregate quantiles
+can't: *where did the TTFT tail come from?*
+
+    # human report: per-component attribution + tail rollup + slowest
+    obs_trace.py --metrics-jsonl /tmp/serve.jsonl
+
+    # machine form; recount SLO violations against a different target
+    obs_trace.py --metrics-jsonl /tmp/serve.jsonl --json --slo-ms 250
+
+    # standalone Perfetto file of the per-request tracks
+    obs_trace.py --metrics-jsonl /tmp/serve.jsonl --perfetto /tmp/req.json
+
+The Perfetto output holds the request tracks alone; to read them against
+the engine's step timeline, pass the same records to
+``obs.timeline.to_chrome_trace(..., req_traces=...)`` (the
+``scripts/obs_timeline.py`` merge path).
+
+Runs with **no jax in the process** — obs/reqtrace.py is loaded by file
+path, never through the package ``__init__`` (which imports jax for the
+shard_map bridge); ``--selftest`` asserts it, like obs_live.py, and
+round-trips the checked-in fixture ``tests/data/reqtrace_fixture.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OBS = os.path.join(_REPO, "pytorch_distributed_tpu", "obs")
+FIXTURE = os.path.join(_REPO, "tests", "data", "reqtrace_fixture.jsonl")
+
+
+def _load_obs(name: str):
+    """Load ``pytorch_distributed_tpu/obs/<name>.py`` by path under the
+    same ``_ptd_obs_<name>`` alias obs/alerts.py uses, so the sibling
+    modules share one instance and jax never enters the process."""
+    import importlib.util
+
+    full = f"pytorch_distributed_tpu.obs.{name}"
+    if full in sys.modules:
+        return sys.modules[full]
+    alias = f"_ptd_obs_{name}"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(
+        alias, os.path.join(_OBS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+reqtrace = _load_obs("reqtrace")
+metrics = _load_obs("metrics")
+
+
+# ------------------------------------------------------------------ analysis
+
+def analyze(path: str, slo_ms=None):
+    """Parse the JSONL, optionally re-judge violations against
+    ``slo_ms``, and return (records, attribution summary dict)."""
+    records = metrics.read_metrics(path)
+    trs = reqtrace.trace_records(records)
+    if slo_ms is not None:
+        for r in trs:
+            r["violated"] = 1 if float(r.get("ttft_ms", 0)) > slo_ms else 0
+    return trs, reqtrace.attribution_summary(trs)
+
+
+def render(summ, trs, slo_ms=None) -> str:
+    lines = ["== request traces =="]
+    if summ is None:
+        lines.append("no reqtrace events (run serve_lm.py --req-trace)")
+        return "\n".join(lines)
+    lines.append(
+        f"requests {summ['requests']}  violations {summ['violations']}"
+        + (f" (slo {slo_ms:g}ms)" if slo_ms is not None else "")
+        + f"  spans kept {summ['sampled_kept']}"
+          f"  spans dropped {summ['spans_dropped']}"
+          f"  preemptions {summ['preemptions']}")
+    lines.append(
+        f"ttft p50 {summ['ttft_p50_ms']:.1f}ms  "
+        f"p99 {summ['ttft_p99_ms']:.1f}ms  "
+        f"e2e p99 {summ['e2e_p99_ms']:.1f}ms  "
+        f"recon err max {summ['recon_err_ms_max']:.3f}ms")
+    lines.append(
+        f"queue_wait_share_p99 {summ['queue_wait_share_p99']:.1f}%  "
+        f"preempt_redo_ms_p99 {summ['preempt_redo_ms_p99']:.1f}ms")
+    tail = summ.get("tail")
+    if tail:
+        lines.append("tail attribution: " + reqtrace.format_tail_line(tail))
+        lines.append(f"dominant tail component: {tail['dominant']}")
+    slow = sorted(trs, key=lambda r: -float(r.get("ttft_ms", 0)))[:5]
+    if slow:
+        lines.append("slowest requests (ttft | queue/prefill/redo/defrag):")
+        for r in slow:
+            lines.append(
+                f"  {r.get('trace_id', '?'):<24} {r['ttft_ms']:8.1f}ms | "
+                f"{r['queue_wait_ms']:.1f} / {r['prefill_ms']:.1f} / "
+                f"{r['redo_wait_ms']:.1f} / {r['defrag_wait_ms']:.1f}"
+                f"  (preempts {r.get('preemptions', 0)},"
+                f" hops {len(json.loads(r['ctx'])['hops'])})")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ selftest
+
+def _selftest() -> int:
+    assert "jax" not in sys.modules, \
+        "obs_trace selftest must run jax-free (import-time hygiene)"
+    assert os.path.exists(FIXTURE), f"missing fixture {FIXTURE}"
+
+    trs, summ = analyze(FIXTURE)
+    assert summ is not None and summ["requests"] >= 4, summ
+    # every record reconciles: component sum == ttft (the recorder's
+    # exactness contract, re-checked on the checked-in artifact)
+    assert summ["recon_err_ms_max"] < 0.05, summ["recon_err_ms_max"]
+    # the fixture is a preemption storm: redo must dominate the tail
+    assert summ["tail"]["dominant"] == "preempt_redo", summ["tail"]
+    # tail sampling kept every violator's spans
+    for r in trs:
+        if r.get("violated"):
+            assert r.get("spans"), f"violator {r['rid']} lost its spans"
+    out = render(summ, trs)
+    for needle in ("== request traces ==", "tail attribution:",
+                   "preempt_redo", "slowest requests"):
+        assert needle in out, f"missing {needle!r} in:\n{out}"
+
+    # --slo-ms re-judging: an absurdly high SLO clears all violations
+    _, relaxed = analyze(FIXTURE, slo_ms=1e9)
+    assert relaxed["violations"] == 0, relaxed["violations"]
+
+    # round-trip: records -> chrome events -> a request track exists
+    evs = reqtrace.chrome_events(trs)
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert any(n.startswith("req ") for n in names), names
+    kinds = {e["name"] for e in evs if e.get("ph") == "X"}
+    assert {"queue", "prefill", "decode"} <= kinds, kinds
+    assert "redo_prefill" in kinds, kinds
+
+    # context wire round-trip (the router-propagation contract)
+    ctx = reqtrace.TraceContext.from_wire(json.loads(trs[0]["ctx"]))
+    assert ctx.to_wire() == json.loads(trs[0]["ctx"])
+    assert ctx.hops and ctx.hops[0].startswith("engine"), ctx.hops
+
+    assert "jax" not in sys.modules
+    print("obs_trace selftest: OK")
+    return 0
+
+
+# ---------------------------------------------------------------------- main
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-request serving-trace attribution")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="metrics JSONL holding reqtrace events")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable attribution summary")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="re-judge SLO violations against this TTFT target")
+    ap.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="write per-request tracks as a Chrome-trace JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="fixture round-trip + jax-free assertion")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+    if not args.metrics_jsonl:
+        ap.error("--metrics-jsonl is required (or --selftest)")
+    trs, summ = analyze(args.metrics_jsonl, slo_ms=args.slo_ms)
+    if args.perfetto:
+        trace = {"traceEvents": reqtrace.chrome_events(trs),
+                 "displayTimeUnit": "ms"}
+        with open(args.perfetto, "w") as f:
+            json.dump(trace, f)
+        print(f"wrote {args.perfetto} "
+              f"({len(trace['traceEvents'])} events)")
+    if args.as_json:
+        print(json.dumps(summ, indent=2, sort_keys=True))
+    else:
+        print(render(summ, trs, slo_ms=args.slo_ms))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

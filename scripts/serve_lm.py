@@ -22,6 +22,14 @@ Examples:
     python scripts/serve_lm.py --mode static ...   # naive wave baseline
     python scripts/serve_lm.py --gamma 3 ...       # speculative decode
     python scripts/serve_lm.py --quant int8 ...    # int8 weight-only
+    python scripts/serve_lm.py --req-trace --trace-sample 0.25 ...
+    python scripts/serve_lm.py --checkpoint pretrained/lm.msgpack ...
+
+``--req-trace`` arms the per-request span recorder (obs/reqtrace.py):
+every request's TTFT/e2e decomposes into queue-wait / prefill /
+preempt-redo / defrag components, booked as ``reqtrace`` ft_events and
+analyzed by ``scripts/obs_trace.py``; ``--checkpoint`` serves real
+weights imported from a torch LM (scripts/import_torch_checkpoint.py).
 """
 
 from __future__ import annotations
@@ -46,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--quant", choices=("", "int8"), default="",
                    help="int8 = weight-only quantized serving "
                         "(models/quant.py)")
+    m.add_argument("--checkpoint", default=None,
+                   help="serve real weights: an LM msgpack written by "
+                        "scripts/import_torch_checkpoint.py (vocab/"
+                        "d-model/n-layers come from the tree; --quant "
+                        "still composes)")
     m.add_argument("--gamma", type=int, default=0,
                    help="speculative draft length (0 = off; greedy only)")
     m.add_argument("--draft-d-model", type=int, default=16)
@@ -89,11 +102,40 @@ def build_parser() -> argparse.ArgumentParser:
                    help="arm a live ttft_p99 alert rule at this ceiling")
     o.add_argument("--slo-kv-pct", type=float, default=None,
                    help="arm a live kv_occupancy alert rule at this pct")
+    o.add_argument("--req-trace", action="store_true", dest="req_trace",
+                   help="per-request span tracing (obs/reqtrace.py): "
+                        "TTFT/e2e critical-path attribution booked as "
+                        "reqtrace ft_events; analyze with "
+                        "scripts/obs_trace.py")
+    o.add_argument("--trace-sample", type=float, default=0.05,
+                   dest="trace_sample",
+                   help="span retention rate for non-violating requests "
+                        "(SLO violators always keep their spans)")
     o.add_argument("--no-watchdog", action="store_true",
                    help="disable the recompile watchdog around the steps")
     o.add_argument("--summary-json", default=None,
                    help="write the run summary dict to this path")
     return ap
+
+
+def load_checkpoint_params(path: str):
+    """Read a ``save_as_pretrained`` LM msgpack (written by
+    scripts/import_torch_checkpoint.py) and return
+    ``(params, vocab_size, d_model, n_layers)`` with the dims inferred
+    from the tree itself (n_heads never shapes it)."""
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    state = payload.get("state", payload)
+    params = state.get("params", state)
+    if "embed" not in params:
+        raise SystemExit(
+            f"--checkpoint {path}: not an LM param tree (missing 'embed');"
+            " convert with scripts/import_torch_checkpoint.py")
+    vocab, d_model = params["embed"]["embedding"].shape
+    n_layers = sum(1 for k in params if k.startswith("block_"))
+    return params, int(vocab), int(d_model), n_layers
 
 
 def main(argv=None) -> int:
@@ -111,9 +153,13 @@ def main(argv=None) -> int:
         generate_load,
     )
 
-    params = init_lm_params(args.vocab_size, args.d_model, args.n_heads,
-                            args.n_layers, block_size=args.block_size,
-                            seed=args.seed)
+    if args.checkpoint:
+        (params, args.vocab_size, args.d_model,
+         args.n_layers) = load_checkpoint_params(args.checkpoint)
+    else:
+        params = init_lm_params(args.vocab_size, args.d_model, args.n_heads,
+                                args.n_layers, block_size=args.block_size,
+                                seed=args.seed)
     if args.quant == "int8":
         from pytorch_distributed_tpu.models.quant import quantize_lm_params
 
@@ -143,6 +189,13 @@ def main(argv=None) -> int:
         wd = RecompileWatchdog(obs=obs)
         wd.install()
 
+    tracer = None
+    if args.req_trace:
+        from pytorch_distributed_tpu.obs.reqtrace import ReqTracer
+
+        tracer = ReqTracer(slo_ms=args.slo_ttft_ms,
+                           sample=args.trace_sample)
+
     eng = ServingEngine(
         params, vocab_size=args.vocab_size, d_model=args.d_model,
         n_heads=args.n_heads, n_layers=args.n_layers,
@@ -153,7 +206,7 @@ def main(argv=None) -> int:
         quant=args.quant, gamma=args.gamma, draft_params=draft,
         policy=args.policy, mode=args.mode,
         defrag_threshold_pct=args.defrag_threshold_pct,
-        obs=obs, watchdog=wd, seed=args.seed)
+        obs=obs, watchdog=wd, trace=tracer, seed=args.seed)
 
     load = generate_load(LoadConfig(
         n_requests=args.requests, rate_rps=args.rate_rps,
@@ -169,6 +222,10 @@ def main(argv=None) -> int:
         obs.close()
 
     summary["recompile_anomalies"] = len(wd.anomalies) if wd else None
+    if tracer is not None:
+        summary["traces_completed"] = tracer.completed
+        summary["trace_violations"] = tracer.violations
+        summary["trace_spans_dropped"] = tracer.spans_dropped
     print(json.dumps(summary, indent=2, sort_keys=True))
     if args.summary_json:
         with open(args.summary_json, "w") as f:

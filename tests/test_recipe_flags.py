@@ -202,3 +202,19 @@ def test_overlap_flags_parse_to_their_own_dests():
     assert args.precision == "bf16"  # the PR-9 symptom, pinned
     args = lm_pretrain.build_parser().parse_args([])
     assert (args.overlap, args.bucket_mb) == ("none", 4.0)
+
+
+def test_trace_and_checkpoint_flags_parse_to_their_own_dests():
+    """ISSUE-17 flags: serve_lm's ``--req-trace``/``--trace-sample``
+    tracing pair and ``--checkpoint`` land in their own dests, default
+    off/0.05/None, and collide with nothing (the parametrized _lint
+    tests above cover the collision half for this parser)."""
+    ap = _load_serve_lm().build_parser()
+    args = ap.parse_args(
+        ["--req-trace", "--trace-sample", "0.25",
+         "--checkpoint", "/tmp/lm_tiny.msgpack"])
+    assert (args.req_trace, args.trace_sample) == (True, 0.25)
+    assert args.checkpoint == "/tmp/lm_tiny.msgpack"
+    args = ap.parse_args([])
+    assert (args.req_trace, args.trace_sample) == (False, 0.05)
+    assert args.checkpoint is None
