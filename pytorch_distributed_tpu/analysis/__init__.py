@@ -8,7 +8,11 @@ and ``core`` import jax lazily so that merely importing the package never
 initializes a backend.  ``lowering`` is the shared AOT sweep service
 (one compile per recipe, persisted ``<name>.hlo``/``<name>.json``
 artifacts, the process-wide compile-count budget) that every static
-consumer — detectors, both ledgers, autoplan validation — rides."""
+consumer — detectors, both ledgers, autoplan validation — rides.
+``synclint`` and ``syncproto`` (the cross-rank collective-congruence
+verifier, scripts/synclint.py) follow the same discipline: pure
+text/AST/state-machine work with jax imported only inside the
+recipe-sweep entry points."""
 
 from pytorch_distributed_tpu.analysis.report import (  # noqa: F401
     Finding,

@@ -24,6 +24,25 @@ is not a sync), and a line ending in ``# shardlint: allow-sync`` is
 exempt — the escape hatch for a loop that genuinely must sync (e.g. an
 eval loop doing exact host-side aggregation, which is a *documented*
 per-batch sync, not an accident).
+
+The second pass here is the *desync* lint (synclint layer 2): for each
+registered hot function it flags any jitted-step or collective-issuing
+call reachable under a branch whose condition is rank-dependent
+(``jax.process_index()``, ``rank`` locals, pids) or locally-data-
+dependent (``float()``/``.item()`` host reads, clocks, ``random``,
+filesystem probes) and not routed through an agreement point.  A branch
+every rank evaluates identically is fine; a branch only *this* rank can
+see is how one rank skips an all-reduce its peers are blocked in — the
+PR 13 two-rank hang class, caught before launch instead of by the
+watchdog.  Two markers scope the verdicts:
+
+- ``# synclint: agreement`` on an ``if``/``while`` line declares the
+  condition an agreement point (the preemption-agreement all-reduce,
+  the membership-epoch poll); on a ``def`` line it blesses every branch
+  in that function.
+- ``# synclint: allow`` on a collective call line (or a ``def`` line)
+  suppresses the finding — the documented escape hatch mirroring
+  ``allow-sync``.
 """
 
 from __future__ import annotations
@@ -174,3 +193,281 @@ def lint_file(path: str,
               hot_functions: Optional[Iterable[str]] = None) -> List[Finding]:
     with open(path) as f:
         return lint_source(f.read(), path=path, hot_functions=hot_functions)
+
+
+# ------------------------------------------------------- desync pass (L2)
+
+AGREEMENT_MARKER = "synclint: agreement"
+DESYNC_ALLOW_MARKER = "synclint: allow"
+
+# Rank-identity sources: a condition touching these can evaluate
+# differently on different processes by construction.
+RANK_NAMES = frozenset({"rank", "local_rank", "world_rank", "proc_id",
+                        "process_id"})
+RANK_CALLS = frozenset({"process_index", "getpid", "gethostname"})
+# Locally-observed data: host reads of device values, clocks, RNG,
+# filesystem probes, and the repo's own local-state drains (a divergence
+# flag, a membership poll) — identical *types* of decision, same hazard:
+# only this rank sees the value the branch keys on.  Sites where such a
+# value is in fact agreed (all-reduced in-step, epoch-committed by the
+# coordinator) declare it with ``# synclint: agreement``.
+LOCAL_CALLS = frozenset({"item", "time", "monotonic", "perf_counter",
+                         "random", "uniform", "exists", "isfile",
+                         "getenv", "float", "int", "drain", "poll"})
+LOCAL_ATTRS = frozenset({"triggered", "should_stop"})
+
+# Default collective-issuing call names: jax collectives, the jitted-step
+# convention, and the gather-everything checkpoint paths.  Inter-
+# procedural propagation extends this set with any same-module function
+# that (transitively) calls one of these.
+COLLECTIVE_CALLS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_reduce",
+    "all_to_all", "ppermute", "sync_global_devices",
+    "step_fn", "train_step", "eval_step", "update_fn",
+    "save_checkpoint", "_save_checkpoint", "restore_checkpoint",
+    # every rank must restore/re-mesh in lockstep: a snapshot restore
+    # re-materializes sharded state and a re-mesh re-grids it — a rank
+    # doing either alone leaves its peers' next collective unmatched
+    "restore", "remesh",
+})
+
+
+def _final_name(func: ast.AST) -> Optional[str]:
+    """The last path component of a call target (``f`` / ``mod.f`` /
+    ``self.f`` all resolve to ``f``)."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def collective_functions(tree: ast.AST,
+                         seeds: frozenset = COLLECTIVE_CALLS) -> Set[str]:
+    """Names of module functions that transitively issue a collective.
+
+    Builds a last-component call graph over every def in the module and
+    runs the obvious fixpoint: a function is collective-issuing when it
+    calls a seed or another collective-issuing function.  Last-component
+    matching (``self.f`` ≡ ``f``) deliberately over-approximates — for a
+    *verifier* a false edge is a nuisance, a missed edge is a hang."""
+    finder = _HotFunctionFinder()
+    finder.visit(tree)
+    calls: Dict[str, Set[str]] = {}
+    for qualname, node in finder.defs.items():
+        short = qualname.rsplit(".", 1)[-1]
+        out = calls.setdefault(short, set())
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _final_name(sub.func)
+                if name is not None:
+                    out.add(name)
+    issuing: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for fn, callees in calls.items():
+            if fn in issuing:
+                continue
+            if callees & seeds or callees & issuing:
+                issuing.add(fn)
+                changed = True
+    return issuing
+
+
+class _TaintMap:
+    """Flow-insensitive name-taint fixpoint over one function body.
+
+    An assignment line carrying ``# synclint: agreement`` is a taint
+    *sink*: its targets are declared agreed (the membership-epoch poll,
+    the all-reduced divergence flag) and stay clean — the assignment-
+    statement half of the agreement-anchor contract."""
+
+    def __init__(self, node: ast.AST, lines: Sequence[str] = ()):
+        self.taints: Dict[str, str] = {}  # name -> "rank" | "local"
+        self._lines = lines
+        body = getattr(node, "body", [])
+        changed = True
+        while changed:
+            changed = False
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    targets: List[ast.AST] = []
+                    value = None
+                    if isinstance(sub, ast.Assign):
+                        targets, value = sub.targets, sub.value
+                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                        targets, value = [sub.target], sub.value
+                    elif isinstance(sub, ast.NamedExpr):
+                        targets, value = [sub.target], sub.value
+                    elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                        targets, value = [sub.target], sub.iter
+                    if value is None:
+                        continue
+                    i = getattr(sub, "lineno", 0) - 1
+                    if (0 <= i < len(self._lines)
+                            and AGREEMENT_MARKER in self._lines[i]):
+                        continue  # declared agreement point: taint sink
+                    taint = self.expr_taint(value)
+                    if taint is None:
+                        continue
+                    for tgt in targets:
+                        for leaf in ast.walk(tgt):
+                            if isinstance(leaf, ast.Name):
+                                if self._add(leaf.id, taint):
+                                    changed = True
+
+    def _add(self, name: str, taint: str) -> bool:
+        prev = self.taints.get(name)
+        if prev == taint or prev == "rank":
+            return False
+        self.taints[name] = taint  # None -> taint, "local" -> "rank"
+        return True
+
+    def expr_taint(self, expr: ast.AST) -> Optional[str]:
+        """``"rank"`` / ``"local"`` / None for an expression ("rank"
+        dominates when both appear)."""
+        found: Optional[str] = None
+        for sub in ast.walk(expr):
+            taint = None
+            if isinstance(sub, ast.Name):
+                if sub.id in RANK_NAMES:
+                    taint = "rank"
+                elif sub.id in self.taints:
+                    taint = self.taints[sub.id]
+            elif isinstance(sub, ast.Attribute):
+                if sub.attr in RANK_NAMES:
+                    taint = "rank"
+                elif sub.attr in LOCAL_ATTRS:
+                    taint = "local"
+            elif isinstance(sub, ast.Call):
+                name = _final_name(sub.func)
+                if name in RANK_CALLS:
+                    taint = "rank"
+                elif name in LOCAL_CALLS:
+                    taint = "local"
+            if taint == "rank":
+                return "rank"
+            found = found or taint
+        return found
+
+
+class _DesyncScanner(ast.NodeVisitor):
+    """Collects collective calls guarded by tainted, un-agreed branches."""
+
+    def __init__(self, lines: Sequence[str], taints: _TaintMap,
+                 issuing: Set[str], fn_blessed: bool):
+        self.lines = lines
+        self.taints = taints
+        self.issuing = issuing
+        self.fn_blessed = fn_blessed  # def-line agreement marker
+        # (branch lineno, taint kind) for active tainted un-agreed guards
+        self.guards: List[tuple] = []
+        self.hits: List[tuple] = []  # (call node, label, guard lineno, taint)
+
+    def _marked(self, lineno: int, marker: str) -> bool:
+        i = lineno - 1
+        return 0 <= i < len(self.lines) and marker in self.lines[i]
+
+    def _branch(self, node, test) -> None:
+        taint = self.taints.expr_taint(test)
+        guarded = (taint is not None and not self.fn_blessed
+                   and not self._marked(node.lineno, AGREEMENT_MARKER))
+        if guarded:
+            self.guards.append((node.lineno, taint))
+        for child in node.body:
+            self.visit(child)
+        for child in getattr(node, "orelse", []):
+            self.visit(child)
+        if guarded:
+            self.guards.pop()
+
+    def visit_If(self, node):  # noqa: N802
+        self._branch(node, node.test)
+
+    def visit_While(self, node):  # noqa: N802
+        self._branch(node, node.test)
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass  # a nested def only *defines*; its body runs elsewhere
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # noqa: N815
+    visit_Lambda = visit_FunctionDef  # noqa: N815
+
+    def visit_Call(self, node):  # noqa: N802
+        name = _final_name(node.func)
+        if (self.guards and name is not None
+                and (name in COLLECTIVE_CALLS or name in self.issuing)
+                and not self._marked(node.lineno, DESYNC_ALLOW_MARKER)):
+            lineno, taint = self.guards[0]  # outermost divergence point
+            self.hits.append((node, name, lineno, taint))
+        self.generic_visit(node)
+
+
+def lint_desync_source(
+    source: str,
+    path: str = "<string>",
+    hot_functions: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Desync-lint ``source``; returns ``collective-desync`` findings.
+
+    Same contract as :func:`lint_source`: ``hot_functions`` names the
+    in-scope qualified defs (None = every def, the planted-source mode).
+    A finding names both the collective call and the branch site so the
+    operator sees the full divergence story in one line."""
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    finder = _HotFunctionFinder()
+    finder.visit(tree)
+    if hot_functions is None:
+        targets: Dict[str, ast.AST] = dict(finder.defs)
+    else:
+        targets = {}
+        missing: Set[str] = set()
+        for name in hot_functions:
+            if name in finder.defs:
+                targets[name] = finder.defs[name]
+            else:
+                missing.add(name)
+        if missing:
+            raise ValueError(
+                f"hot functions {sorted(missing)} not found in {path}; "
+                "update the synclint SYNC_SCOPES registry after renames")
+    issuing = collective_functions(tree)
+    findings: List[Finding] = []
+    for qualname, node in sorted(targets.items()):
+        fn_blessed = (
+            (0 <= node.lineno - 1 < len(lines)
+             and AGREEMENT_MARKER in lines[node.lineno - 1])
+            or (0 <= node.lineno - 1 < len(lines)
+                and DESYNC_ALLOW_MARKER in lines[node.lineno - 1]))
+        scanner = _DesyncScanner(lines, _TaintMap(node, lines), issuing,
+                                 fn_blessed)
+        for stmt in getattr(node, "body", []):
+            scanner.visit(stmt)
+        for call, label, branch_line, taint in scanner.hits:
+            kind_txt = ("rank-dependent" if taint == "rank"
+                        else "locally-data-dependent")
+            findings.append(Finding(
+                kind="collective-desync",
+                severity="error",
+                where=f"{path}:{call.lineno}",
+                message=(f"collective call {label}() in {qualname} is "
+                         f"reachable under a {kind_txt} branch at "
+                         f"{path}:{branch_line} with no agreement point "
+                         "— a rank that takes the other arm skips the "
+                         "collective its peers are blocked in; route the "
+                         "decision through an agreed value and mark the "
+                         f"branch '# {AGREEMENT_MARKER}', or mark the "
+                         f"call '# {DESYNC_ALLOW_MARKER}'"),
+            ))
+    return findings
+
+
+def lint_desync_file(
+    path: str,
+    hot_functions: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    with open(path) as f:
+        return lint_desync_source(f.read(), path=path,
+                                  hot_functions=hot_functions)

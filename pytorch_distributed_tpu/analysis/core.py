@@ -887,6 +887,23 @@ def build_synthetic_bad_step(mesh, data_axis: str = "data"):
     return jitted, args, (0,)
 
 
+_SYNTHETIC_BAD_LOWERING: Optional[Lowering] = None
+
+
+def get_synthetic_bad_lowering() -> Lowering:
+    """Session-memoized lowering of the planted synthetic-bad step on the
+    4-way data mesh — the same one-compile discipline as
+    :func:`get_lowering`, so ``selftest`` and the shardlint tests share a
+    single compile instead of each paying their own."""
+    global _SYNTHETIC_BAD_LOWERING
+    if _SYNTHETIC_BAD_LOWERING is None:
+        mesh = _mesh(("data",), (4,))
+        jitted, args, donate = build_synthetic_bad_step(mesh)
+        _SYNTHETIC_BAD_LOWERING = lower_jitted(
+            jitted, args, name="synthetic-bad", mesh=mesh, donate=donate)
+    return _SYNTHETIC_BAD_LOWERING
+
+
 _PLANTED_SYNC_SRC = '''\
 def fit(self, steps):
     total = 0.0
@@ -917,11 +934,9 @@ def selftest(verbose: bool = False) -> Dict[str, Any]:
         if verbose:
             print(f"  [selftest] {msg}")
 
-    # 1. planted hazards all detected
-    mesh = _mesh(("data",), (4,))
-    jitted, args, donate = build_synthetic_bad_step(mesh)
-    rep = analyze_jitted(jitted, args, name="synthetic-bad", mesh=mesh,
-                         donate=donate)
+    # 1. planted hazards all detected (memoized: one compile per session
+    #    shared with the shardlint tests)
+    rep = analyze_lowering(get_synthetic_bad_lowering())
     kinds = {f.kind for f in rep.findings}
     assert "replicated-large-tensor" in kinds, rep.findings
     assert any(f.kind == "replicated-large-tensor" and f.shape == (2048, 128)

@@ -285,6 +285,65 @@ def parse_replica_groups(line: str) -> Tuple[int, int]:
     return (1, 1)
 
 
+_CHANNEL_ID_RE = re.compile(r"\bchannel_id=(\d+)")
+
+
+def parse_channel_id(line: str) -> int:
+    """``channel_id=N`` of a collective instruction line, or ``-1``.
+
+    Cross-module (multi-process) collectives carry a channel id that must
+    match across every participating program — it is the rendezvous key
+    NCCL/ICI uses to pair the ops up.  Single-module SPMD collectives may
+    omit it; synclint canonicalizes the absent case to ``-1`` so schedule
+    digests stay stable either way."""
+    m = _CHANNEL_ID_RE.search(line)
+    return int(m.group(1)) if m else -1
+
+
+def parse_replica_group_members(line: str) -> Optional[List[List[int]]]:
+    """Explicit device-id membership of each replica group, or ``None``.
+
+    Three encodings appear in post-optimization text:
+
+    - explicit nested braces ``replica_groups={{0,1},{2,3}}`` → member
+      lists verbatim;
+    - the iota form ``replica_groups=[G,S]<=[N]`` → G sequential groups of
+      S ids covering ``range(N)`` (XLA's compressed spelling of the same
+      partition), synthesized here so congruence checks see one shape;
+    - ``source_target_pairs={{s,t},...}`` (collective-permute) → one
+      2-element ``[s, t]`` list per pair (pairs may legitimately repeat a
+      device across *different* pairs, so callers must not apply the
+      disjoint-partition rule to permutes).
+
+    Returns ``None`` when the line carries no group annotation at all —
+    distinct from ``[[...]]`` so callers can tell "no groups" apart from
+    "one group of everything"."""
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        dims = [int(d) for d in m.group(1).split(",")]
+        size = dims[-1] if dims else 1
+        groups = 1
+        for d in dims[:-1]:
+            groups *= d
+        ids = iter(range(groups * size))
+        return [[next(ids) for _ in range(size)] for _ in range(groups)]
+    key = "replica_groups={"
+    start = line.find(key)
+    if start >= 0:
+        block = _balanced_braces(line, start + len(key) - 1)
+        groups = re.findall(r"\{([0-9,\s]*)\}", block)
+        if groups:
+            return [[int(t) for t in g.split(",") if t.strip()]
+                    for g in groups]
+        return [[]]  # replica_groups={} — one all-device group
+    m = _PAIRS_RE.search(line)
+    if m:
+        block = _balanced_braces(line, m.end() - 1)
+        return [[int(t) for t in pair.split(",") if t.strip()]
+                for pair in re.findall(r"\{([0-9,\s]*)\}", block)]
+    return None
+
+
 def parse_op_metadata(line: str) -> Tuple[str, str]:
     """``(op_name, "file:line")`` from an instruction's ``metadata={...}``
     annotation; empty strings when absent.  ``op_name`` is the full jax

@@ -56,12 +56,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from pytorch_distributed_tpu.analysis import core
 
-# Extra counted compiles tier-1 tolerates beyond the recipe sweep itself:
-# the shardlint selftest's planted synthetic-bad steps and the handful of
-# analyze_jitted probes tests run against non-recipe steps.  The budget
-# assert (tests/test_plan.py) fails CI when a change sneaks per-consumer
-# recompiles back in.
-EXTRA_COMPILE_ALLOWANCE = 8
+# Extra counted compiles tier-1 tolerates beyond the recipe sweep itself.
+# Measured usage is exactly 2: the planted synthetic-bad step (memoized in
+# ``core.get_synthetic_bad_lowering`` — selftest and test_shardlint share
+# the one compile) and test_shardlint's undonated-opportunity probe.  The
+# allowance leaves headroom for two more probes before the budget assert
+# (tests/test_plan.py, tests/test_recipes.py) fails CI — a change that
+# sneaks per-consumer recompiles back in blows through it immediately.
+EXTRA_COMPILE_ALLOWANCE = 4
 
 
 def compile_count() -> int:
@@ -319,6 +321,25 @@ def persistent_cache_selfcheck(cache_dir: str, *, timeout: float = 120.0,
     return ok
 
 
+# The gate verdict is logged exactly once per interpreter session: the
+# gate is funneled through by every test session (conftest) and CLI
+# entry, and the one stderr line — detected jaxlib + enabled/disabled +
+# why — is the breadcrumb the ROADMAP's "revisit at jaxlib 0.5.0" item
+# needs when reading CI logs.  Reset by tests to assert the logging.
+_GATE_VERDICT_LOGGED = False
+
+
+def _log_gate_verdict(verdict: Dict[str, Any]) -> None:
+    global _GATE_VERDICT_LOGGED
+    if _GATE_VERDICT_LOGGED:
+        return
+    _GATE_VERDICT_LOGGED = True
+    state = "enabled" if verdict.get("enabled") else "disabled"
+    ver = ".".join(map(str, jaxlib_version_tuple()))
+    print(f"[lowering] persistent compilation cache {state} "
+          f"(jaxlib {ver}): {verdict['reason']}", file=sys.stderr)
+
+
 def maybe_enable_persistent_cache(
         cache_dir: Optional[str] = None) -> Dict[str, Any]:
     """Version-gated re-attempt of jax's persistent compilation cache.
@@ -329,7 +350,15 @@ def maybe_enable_persistent_cache(
     launches.  On newer jaxlibs the populate+warm subprocess round-trip
     must pass before the cache dir is handed to jax.  ``PTD_PERSISTENT_
     CACHE=0`` force-disables; ``=1`` skips the version gate but NOT the
-    self-check.  Returns ``{"enabled": bool, "reason": str}``."""
+    self-check.  Returns ``{"enabled": bool, "reason": str}``; the
+    detected jaxlib + verdict is logged to stderr once per session."""
+    verdict = _gate_persistent_cache(cache_dir)
+    _log_gate_verdict(verdict)
+    return verdict
+
+
+def _gate_persistent_cache(
+        cache_dir: Optional[str] = None) -> Dict[str, Any]:
     env = os.environ.get("PTD_PERSISTENT_CACHE", "")
     if env == "0":
         return {"enabled": False, "reason": "disabled by PTD_PERSISTENT_CACHE=0"}

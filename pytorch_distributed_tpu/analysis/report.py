@@ -24,6 +24,20 @@ kinds, so CI can gate on severity instead of grepping HLO text per PR:
   PR-1 replicated-accumulator class caught by *bytes*, not pattern.
 - ``host-sync`` (error) — a blocking device→host conversion inside a train
   hot loop (analysis/astlint.py).
+- ``collective-incongruence`` (error) — a recipe's collective schedule
+  fails cross-device congruence or replica-group partition validity
+  (analysis/synclint.py layer 1: duplicate/out-of-range device ids,
+  non-covering partitions, mismatched group sizes).
+- ``sync-digest-drift`` (error) — the canonical collective-schedule digest
+  of a recipe no longer matches the checked-in baseline pin: the *order*
+  or shape of the collective sequence changed, which is a cross-rank
+  deadlock risk even when counts and bytes stay inside budget.
+- ``collective-desync`` (error) — a jitted-step or collective-issuing call
+  reachable under a rank-dependent or locally-data-dependent branch that
+  is not routed through an agreement point (astlint desync pass).
+- ``protocol-desync`` (error) — the explicit-state protocol explorer found
+  a reachable interleaving where ranks disagree on the next collective
+  (analysis/syncproto.py).
 """
 
 from __future__ import annotations
@@ -47,6 +61,10 @@ KINDS = (
     "collective-regression",
     "memory-budget",
     "host-sync",
+    "collective-incongruence",
+    "sync-digest-drift",
+    "collective-desync",
+    "protocol-desync",
 )
 
 
@@ -94,6 +112,9 @@ class StepReport:
     memory: Dict[str, int] = dataclasses.field(default_factory=dict)
     # donation accounting: requested/expected/aliased leaf counts + bytes
     donation: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # canonical collective-schedule digest (analysis/synclint.py); "" when
+    # the sync layer did not run or the step has no mesh
+    sync_digest: str = ""
 
     def add(self, finding: Finding) -> None:
         self.findings.append(finding)
@@ -112,6 +133,7 @@ class StepReport:
             "collectives": self.collectives,
             "memory": self.memory,
             "donation": self.donation,
+            "sync_digest": self.sync_digest,
         }
 
 
@@ -127,8 +149,13 @@ def baseline_entry(report: StepReport) -> Dict[str, Any]:
 
     ``peak_hbm_bytes`` pins the per-device compiled footprint (temp +
     argument + output from ``memory_analysis()``) so a layout change that
-    silently re-replicates state fails shardlint by *bytes*."""
-    return {
+    silently re-replicates state fails shardlint by *bytes*.
+
+    ``sync_digest`` pins the canonical *ordered* collective schedule
+    (analysis/synclint.py): two modules can match every count/bytes line
+    above yet reorder collectives relative to each other, which is exactly
+    the cross-rank deadlock class — so order is pinned by digest."""
+    out = {
         "collectives": {
             k: {"count": v["count"], "bytes": v["bytes"]}
             for k, v in sorted(report.collectives.items())
@@ -136,6 +163,9 @@ def baseline_entry(report: StepReport) -> Dict[str, Any]:
         "total_bytes": sum(v["bytes"] for v in report.collectives.values()),
         "peak_hbm_bytes": sum(report.memory.values()),
     }
+    if report.sync_digest:
+        out["sync_digest"] = report.sync_digest
+    return out
 
 
 def diff_against_baseline(report: StepReport,
@@ -211,6 +241,22 @@ def diff_against_baseline(report: StepReport,
                 message=(f"peak HBM below baseline ({now_peak} B vs "
                          f"{ref_peak} B): refresh with --update-baseline"),
             ))
+    # the pinned collective-schedule digest (absent from pre-synclint
+    # baselines: skipped until --update-baseline refreshes the pin).
+    # Drift is always an error — a reordered schedule deadlocks a
+    # multi-process mesh even when every count/bytes budget holds.
+    ref_digest = entry.get("sync_digest")
+    if ref_digest and report.sync_digest \
+            and report.sync_digest != ref_digest:
+        findings.append(Finding(
+            kind="sync-digest-drift", severity="error",
+            where=f"{report.name}:sync_digest",
+            message=(f"collective-schedule digest drifted: "
+                     f"{report.sync_digest[:12]} vs baseline "
+                     f"{ref_digest[:12]} — the ordered collective "
+                     "sequence changed; audit the reorder, then "
+                     "--update-baseline to re-pin"),
+        ))
     return findings
 
 

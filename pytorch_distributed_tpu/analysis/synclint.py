@@ -1,0 +1,339 @@
+"""synclint: the cross-rank collective-congruence verifier.
+
+shardlint answers "is each step's *layout* right?"; synclint answers the
+orthogonal question multi-process meshes die on: **do all ranks execute
+a congruent collective schedule down every reachable host path?**  One
+rank issuing a different collective sequence than its peers does not
+error — it hangs the whole job in NCCL/ICI, which is exactly what the
+PR 13 flight recorder diagnoses post-mortem.  Synclint moves that class
+pre-launch with three layers:
+
+1. **HLO congruence** (this module): extract each recipe's ordered
+   per-device collective schedule (kind, channel id, replica groups,
+   shapes) from the already-compiled module text — riding the shared
+   lowering sweep, zero extra compiles — and verify replica-group
+   partition validity (disjoint, in-range, uniform, covering) plus
+   schedule well-formedness.  The canonical schedule is pinned into
+   ``analysis/baseline.json`` as a sha256 digest; drift = error.
+2. **Host control-flow desync** (analysis/astlint.py desync pass, driven
+   by the ``SYNC_SCOPES`` registry here): flag jitted-step / collective
+   calls reachable under rank-dependent or locally-data-dependent
+   branches not routed through a ``# synclint: agreement`` point.
+3. **Protocol model check** (analysis/syncproto.py): explicit-state
+   exploration of the repo's multi-step protocols (divergence rollback,
+   elastic shrink/grow, checkpoint fallback, preemption stop).
+
+Everything in this module except :func:`sweep` is pure text/AST work —
+no jax import — so the CLI selftest and the drill fixtures run jax-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.analysis import astlint
+from pytorch_distributed_tpu.analysis import hlo as hlo_mod
+from pytorch_distributed_tpu.analysis import syncproto
+from pytorch_distributed_tpu.analysis.report import Finding, StepReport
+
+# ------------------------------------------------- layer 1: HLO congruence
+
+# collective-permute's source_target_pairs may repeat a device across
+# pairs (a ring names every device twice) — the disjoint-partition rule
+# applies to every *other* collective's replica groups.
+_PERMUTE_KINDS = frozenset({"collective-permute"})
+
+
+@dataclasses.dataclass
+class ScheduleEntry:
+    """One collective in a module's ordered per-device schedule."""
+
+    kind: str                      # normalized opcode (-start folded in)
+    channel_id: int                # -1 when the op carries none
+    groups: Optional[List[List[int]]]  # explicit member ids, or None
+    shapes: List[hlo_mod.Shape]
+    name: str                      # HLO instruction name (not digested)
+    source: str                    # "file:line" metadata (not digested)
+    computation: str
+
+    def canonical(self) -> list:
+        """The digested identity: everything every rank must agree on,
+        nothing the compiler is free to rename.  Instruction names and
+        source metadata are excluded — they churn across point releases
+        without changing what goes on the wire."""
+        return [
+            self.kind,
+            self.channel_id,
+            self.groups if self.groups is not None else "none",
+            sorted([dt, list(dims)] for dt, dims in self.shapes),
+        ]
+
+
+def extract_schedule(hlo_text: str) -> List[ScheduleEntry]:
+    """The module's ordered collective schedule, async pairs counted once
+    at their ``-start`` (the payload op; ``-done`` is bookkeeping)."""
+    out: List[ScheduleEntry] = []
+    for ins in hlo_mod.parse_instructions(hlo_text):
+        if ins.opcode not in hlo_mod._COLLECTIVE_SET:
+            continue
+        kind = ins.opcode[:-len("-start")] \
+            if ins.opcode.endswith("-start") else ins.opcode
+        _, source = hlo_mod.parse_op_metadata(ins.line)
+        out.append(ScheduleEntry(
+            kind=kind,
+            channel_id=hlo_mod.parse_channel_id(ins.line),
+            groups=hlo_mod.parse_replica_group_members(ins.line),
+            shapes=list(ins.shapes),
+            name=ins.name,
+            source=source,
+            computation=ins.computation))
+    return out
+
+
+def schedule_digest(schedule: Sequence[ScheduleEntry]) -> str:
+    """sha256 over the canonical ordered schedule — the baseline pin."""
+    payload = json.dumps([e.canonical() for e in schedule],
+                         sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def verify_congruence(hlo_text: str, name: str,
+                      n_devices: Optional[int] = None) -> List[Finding]:
+    """Replica-group partition validity for every collective in the
+    module.  With one SPMD module shared by all devices, cross-device
+    congruence *is* partition validity: every device must appear in
+    exactly one group of every collective it participates in (disjoint,
+    in-range, uniform sizes, and — when the mesh size is known — exactly
+    covering).  A malformed partition means some device waits on a
+    rendezvous its peers never enter."""
+    findings: List[Finding] = []
+    for i, entry in enumerate(extract_schedule(hlo_text)):
+        where = f"{name}:#{i}:{entry.kind}"
+        groups = entry.groups
+        if groups is None:
+            continue  # single-device module: nothing to partition
+        if entry.kind in _PERMUTE_KINDS:
+            # pairs, not a partition: sources and targets must each be
+            # unique or two sends race into one receive buffer
+            srcs = [g[0] for g in groups if len(g) == 2]
+            tgts = [g[1] for g in groups if len(g) == 2]
+            if any(len(g) != 2 for g in groups):
+                findings.append(Finding(
+                    kind="collective-incongruence", severity="error",
+                    where=where,
+                    message=f"malformed source_target_pairs {groups}"))
+            elif len(set(srcs)) != len(srcs) or len(set(tgts)) != len(tgts):
+                findings.append(Finding(
+                    kind="collective-incongruence", severity="error",
+                    where=where,
+                    message=(f"collective-permute pairs are not a "
+                             f"permutation: sources {srcs} targets {tgts}")))
+            continue
+        flat = [d for g in groups for d in g]
+        sizes = {len(g) for g in groups}
+        if len(sizes) > 1:
+            findings.append(Finding(
+                kind="collective-incongruence", severity="error",
+                where=where,
+                message=(f"replica groups have mismatched sizes "
+                         f"{sorted(sizes)}: {groups} — ranks in the small "
+                         "group rendezvous with fewer peers than the op "
+                         "declares elsewhere")))
+        if len(set(flat)) != len(flat):
+            dupes = sorted({d for d in flat if flat.count(d) > 1})
+            findings.append(Finding(
+                kind="collective-incongruence", severity="error",
+                where=where,
+                message=(f"device id(s) {dupes} appear in more than one "
+                         f"replica group: {groups} — a device cannot "
+                         "participate twice in one collective")))
+        if n_devices is not None and flat:
+            oob = sorted(d for d in set(flat) if not 0 <= d < n_devices)
+            if oob:
+                findings.append(Finding(
+                    kind="collective-incongruence", severity="error",
+                    where=where,
+                    message=(f"device id(s) {oob} out of range for the "
+                             f"{n_devices}-device mesh: {groups}")))
+            missing = sorted(set(range(n_devices)) - set(flat))
+            if missing and not oob and len(set(flat)) == len(flat):
+                findings.append(Finding(
+                    kind="collective-incongruence", severity="error",
+                    where=where,
+                    message=(f"device id(s) {missing} participate in no "
+                             f"replica group of this collective: {groups} "
+                             "— they fall out of sync with every peer "
+                             "that does")))
+    return findings
+
+
+def sync_report(name: str, hlo_text: str,
+                mesh_shape: Optional[Dict[str, int]] = None) -> StepReport:
+    """Layer-1 verdict for one module: congruence findings + the digest."""
+    n_devices: Optional[int] = None
+    if mesh_shape:
+        n_devices = 1
+        for v in mesh_shape.values():
+            n_devices *= v
+    schedule = extract_schedule(hlo_text)
+    report = StepReport(name=name, mesh_shape=dict(mesh_shape or {}),
+                        collectives=hlo_mod.collect_collectives(
+                            hlo_mod.parse_instructions(hlo_text)),
+                        sync_digest=schedule_digest(schedule))
+    for f in verify_congruence(hlo_text, name, n_devices=n_devices):
+        report.add(f)
+    return report
+
+
+def diff_digest(report: StepReport,
+                entry: Optional[Dict[str, Any]]) -> List[Finding]:
+    """Digest-only baseline diff (the synclint CLI's fence; shardlint's
+    full diff in report.diff_against_baseline includes the same check)."""
+    ref = (entry or {}).get("sync_digest")
+    if not ref:
+        return [Finding(
+            kind="sync-digest-drift", severity="warn", where=report.name,
+            message="no collective-schedule digest pinned for this step; "
+                    "run scripts/synclint.py --update-baseline (or "
+                    "shardlint --sync --update-baseline) to pin it")]
+    if report.sync_digest != ref:
+        return [Finding(
+            kind="sync-digest-drift", severity="error",
+            where=f"{report.name}:sync_digest",
+            message=(f"collective-schedule digest drifted: "
+                     f"{report.sync_digest[:12]} vs baseline {ref[:12]} — "
+                     "the ordered collective sequence changed; audit the "
+                     "reorder, then --update-baseline to re-pin"))]
+    return []
+
+
+# ------------------------------------------- layer 2: host desync scopes
+
+# Registered desync-lint scopes: every host function that gates jitted
+# steps or collective-issuing calls, as (path relative to the package
+# root, qualified function names).  Superset of core.HOT_LOOPS — the
+# host-sync lint cares about *blocking* in loops; this pass cares about
+# *branching* anywhere a collective is reachable.
+SYNC_SCOPES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("train/trainer.py", ("Trainer.train_epoch", "Trainer.fit",
+                          "Trainer._fit_epochs", "Trainer._preempt_agreed")),
+    ("train/lm.py", ("LMTrainer.fit", "LMTrainer._preempt_agreed")),
+    ("ft/divergence.py", ("DivergenceGuard.drain", "StateKeeper.update")),
+    ("ft/elastic.py", ("ElasticSim.poll", "ElasticCoordinator.decide")),
+    ("serving/engine.py", ("ServingEngine.step", "ServingEngine.run")),
+)
+
+
+def lint_sync_scopes() -> StepReport:
+    """Run the astlint desync pass over every registered scope."""
+    import pytorch_distributed_tpu as pkg
+
+    base = os.path.dirname(os.path.abspath(pkg.__file__))
+    report = StepReport(name="sync-scopes")
+    for rel, functions in SYNC_SCOPES:
+        path = os.path.join(base, rel)
+        for f in astlint.lint_desync_file(path, hot_functions=functions):
+            report.add(f)
+    return report
+
+
+# --------------------------------------------------- layer 3: protocols
+
+def check_protocols() -> StepReport:
+    """Verify the shipped protocol models (analysis/syncproto.py)."""
+    report = StepReport(name="sync-protocols")
+    for f in syncproto.check_protocols():
+        report.add(f)
+    return report
+
+
+# -------------------------------------------------------- the composition
+
+def annotate_reports(reports: Sequence[StepReport]) -> None:
+    """Fold layer 1 into an existing shardlint sweep in place: for every
+    mesh'd recipe report, attach the schedule digest and any congruence
+    findings off the *already cached* lowering (zero extra compiles —
+    ``core.get_lowering`` memoizes, and the sweep that produced these
+    reports already paid each compile)."""
+    from pytorch_distributed_tpu.analysis import core
+
+    for r in reports:
+        if r.name not in core.RECIPES or not r.mesh_shape:
+            continue
+        low = core.get_lowering(r.name)
+        sub = sync_report(r.name, low.text, low.mesh_shape)
+        r.sync_digest = sub.sync_digest
+        for f in sub.findings:
+            r.add(f)
+
+
+def sweep(names: Optional[Sequence[str]] = None) -> List[StepReport]:
+    """Layer-1 reports for every (or the named subset of) mesh'd recipe,
+    off the shared lowering cache.  Imports jax transitively; the CLI's
+    ``--hlo-cache``/``--selftest`` paths avoid it."""
+    from pytorch_distributed_tpu.analysis import core
+
+    selected = list(core.RECIPES) if names is None else list(names)
+    unknown = [n for n in selected if n not in core.RECIPES]
+    if unknown:
+        raise KeyError(f"unknown steps {unknown}; "
+                       f"known: {list(core.RECIPES)}")
+    reports = []
+    for name in selected:
+        low = core.get_lowering(name)
+        if not low.mesh_shape:
+            continue  # single-device: no cross-rank schedule to verify
+        reports.append(sync_report(name, low.text, low.mesh_shape))
+    return reports
+
+
+def sweep_cached(cache_dir: str,
+                 names: Optional[Sequence[str]] = None) -> List[StepReport]:
+    """Layer-1 reports from persisted lowering artifacts (<name>.hlo +
+    <name>.json under ``cache_dir``) — no jax import, no compile."""
+    from pytorch_distributed_tpu.analysis.lowering import CachedLowering
+
+    if names is None:
+        names = sorted(
+            f[:-len(".hlo")] for f in os.listdir(cache_dir)
+            if f.endswith(".hlo"))
+    reports = []
+    for name in names:
+        cached = CachedLowering.load(cache_dir, name)
+        if not cached.mesh_shape:
+            continue
+        reports.append(sync_report(name, cached.text, cached.mesh_shape))
+    return reports
+
+
+# ------------------------------------------------------ planted fixtures
+
+# The rank-divergent branch fixture: the statically-caught half of
+# `chaoskit drill desync` and the astlint-side selftest.  Line numbers
+# matter to the tests — keep the planted sites stable.
+PLANTED_DESYNC_SRC = '''\
+def fit(self, steps):
+    for i in range(steps):
+        state, metrics = self.step_fn(state, batch)      # agreed path
+        if jax.process_index() == 0:                     # planted desync
+            self.save_checkpoint(state, i)               # rank-gated gather
+        flag = float(metrics["diverged"])                # local read
+        if flag > 0.5:                                   # planted desync
+            state = self.rollback(state)
+    return state
+
+
+def rollback(self, state):
+    return psum(state, "data")                           # collective-issuing
+'''
+
+
+def planted_desync_findings() -> List[Finding]:
+    """The desync pass run over the planted fixture — must flag both the
+    rank-gated checkpoint gather and the locally-gated rollback psum."""
+    return astlint.lint_desync_source(
+        PLANTED_DESYNC_SRC, "planted_desync.py", hot_functions=("fit",))

@@ -1,0 +1,328 @@
+"""Protocol model check: explicit-state exploration of rank state machines.
+
+Synclint layer 3.  Layers 1–2 prove each *compiled module* is congruent
+and each *hot loop* routes its branches through agreement points; this
+layer closes the remaining gap — multi-step **protocols** (divergence
+skip/rollback, elastic shrink/grow, checkpoint prev-fallback) where the
+hazard is an emergent interleaving, not a single branch.  The PR 13
+flight recorder diagnoses exactly these post-mortem: rank 0 decided to
+stop/skip/re-mesh on information rank 1 never saw, and both died blocked
+in different collectives.  Here the same bug class is found *before
+launch* by exhaustive exploration of a tiny abstraction.
+
+The abstraction
+---------------
+Every rank runs the same straight-line program (SPMD) over four opcodes:
+
+- ``("coll", name)`` — issue collective ``name``.  Collectives are the
+  only synchronization points: all ranks must issue the *same* next
+  collective or the job deadlocks (bulk-synchronous semantics — exactly
+  what NCCL/ICI gives you).
+- ``("branch", scope, var, then_pc, else_pc, site)`` — branch on boolean
+  ``var``.  ``scope="agreed"`` means every rank reads the same value (the
+  preemption-agreement all-reduce, a membership epoch); ``scope="local"``
+  means each rank reads its *own* value (a signal flag, a local file
+  probe, a local divergence verdict).  ``site`` labels the source idiom
+  for the counterexample report.
+- ``("goto", pc)`` — unconditional jump.
+- ``("end",)`` — the rank terminates.
+
+Branch predicates are memoized per path: an agreed var takes one global
+boolean per exploration path, a local var one boolean per (rank, path).
+Because everything between collectives is rank-local, two ranks can only
+interact at collective boundaries — so a path is a deadlock iff the
+per-rank *collective traces* diverge: at the first differing index one
+rank is blocked in a collective its peers never issue (or has terminated
+while a peer blocks).  The explorer enumerates every valuation (the
+models are tiny: ≤3 vars, 2 ranks) and simulates each rank to completion,
+which is sound and complete for this abstraction.
+
+The punchline is structural: a program whose only branches are *agreed*
+keeps all ranks in lockstep — verifiably safe.  One *local* branch
+guarding a collective (or an early ``end``) and the explorer hands back
+the exact valuation, the divergence frontier, and the branch to blame.
+Nothing here imports jax; it is pure stdlib, unit-testable anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pytorch_distributed_tpu.analysis.report import Finding
+
+# A rank that executes more than this many opcodes is looping forever on
+# a constant predicate — a modelling bug, reported as such.
+STEP_CAP = 10_000
+
+
+@dataclasses.dataclass(frozen=True)
+class Program:
+    """One SPMD protocol model: every rank runs ``instrs`` from pc 0."""
+
+    name: str
+    instrs: Tuple[tuple, ...]
+    n_ranks: int = 2
+
+
+@dataclasses.dataclass
+class Counterexample:
+    """A reachable valuation under which ranks desync."""
+
+    model: str
+    valuation: Dict[str, object]          # var -> bool | per-rank tuple
+    traces: List[List[str]]               # per-rank collective traces
+    frontier: int                         # first differing trace index
+    next_colls: List[str]                 # per-rank blocked collective/END
+    blame_site: str                       # the local branch that diverged
+    blame_var: str
+
+    def __str__(self) -> str:
+        ranks = ", ".join(f"rank{r} -> {c}"
+                          for r, c in enumerate(self.next_colls))
+        return (f"{self.model}: at collective #{self.frontier} "
+                f"{ranks}; diverged on local predicate "
+                f"'{self.blame_var}' at {self.blame_site}")
+
+
+def _variables(program: Program) -> Tuple[List[str], List[str]]:
+    """(agreed vars, local vars) in first-appearance order."""
+    agreed: List[str] = []
+    local: List[str] = []
+    for ins in program.instrs:
+        if ins[0] != "branch":
+            continue
+        _, scope, var, _, _, _ = ins
+        bucket = agreed if scope == "agreed" else local
+        if var not in bucket:
+            bucket.append(var)
+    return agreed, local
+
+
+def _run_rank(program: Program, rank: int,
+              agreed_vals: Dict[str, bool],
+              local_vals: Dict[Tuple[str, int], bool]) -> List[str]:
+    """Simulate one rank to termination; returns its collective trace."""
+    trace: List[str] = []
+    pc, steps = 0, 0
+    while True:
+        steps += 1
+        if steps > STEP_CAP:
+            raise RuntimeError(
+                f"{program.name}: rank {rank} exceeded {STEP_CAP} opcodes "
+                "— the model loops on a constant predicate")
+        ins = program.instrs[pc]
+        op = ins[0]
+        if op == "end":
+            return trace
+        if op == "coll":
+            trace.append(ins[1])
+            pc += 1
+        elif op == "goto":
+            pc = ins[1]
+        elif op == "branch":
+            _, scope, var, then_pc, else_pc, _site = ins
+            val = (agreed_vals[var] if scope == "agreed"
+                   else local_vals[(var, rank)])
+            pc = then_pc if val else else_pc
+        else:
+            raise ValueError(f"{program.name}: unknown opcode {op!r}")
+
+
+def _blame(program: Program, local_vals: Dict[Tuple[str, int], bool],
+           n_ranks: int) -> Tuple[str, str]:
+    """The first local predicate whose per-rank values differ."""
+    for ins in program.instrs:
+        if ins[0] != "branch" or ins[1] != "local":
+            continue
+        _, _, var, _, _, site = ins
+        vals = {local_vals[(var, r)] for r in range(n_ranks)}
+        if len(vals) > 1:
+            return site, var
+    return ("<unknown>", "<unknown>")
+
+
+def explore(program: Program) -> Optional[Counterexample]:
+    """Exhaustively check every branch valuation; None means verified.
+
+    Returns the *first* counterexample found (deterministic order: agreed
+    valuations outer, local valuations inner, False before True)."""
+    agreed_vars, local_vars = _variables(program)
+    n = program.n_ranks
+    local_slots = [(v, r) for v in local_vars for r in range(n)]
+    for agreed_bits in itertools.product(
+            (False, True), repeat=len(agreed_vars)):
+        agreed_vals = dict(zip(agreed_vars, agreed_bits))
+        for local_bits in itertools.product(
+                (False, True), repeat=len(local_slots)):
+            local_vals = dict(zip(local_slots, local_bits))
+            traces = [_run_rank(program, r, agreed_vals, local_vals)
+                      for r in range(n)]
+            frontier = _divergence_frontier(traces)
+            if frontier is None:
+                continue
+            site, var = _blame(program, local_vals, n)
+            valuation: Dict[str, object] = dict(agreed_vals)
+            for v in local_vars:
+                valuation[v] = tuple(local_vals[(v, r)] for r in range(n))
+            return Counterexample(
+                model=program.name, valuation=valuation, traces=traces,
+                frontier=frontier,
+                next_colls=[t[frontier] if frontier < len(t) else "END"
+                            for t in traces],
+                blame_site=site, blame_var=var)
+    return None
+
+
+def _divergence_frontier(traces: Sequence[Sequence[str]]) -> Optional[int]:
+    """First index where the per-rank collective traces disagree, or
+    None when every rank issues the identical sequence."""
+    longest = max(len(t) for t in traces)
+    for i in range(longest):
+        slots = [t[i] if i < len(t) else "END" for t in traces]
+        if len(set(slots)) > 1:
+            return i
+    return None
+
+
+# ------------------------------------------------------------ the models
+#
+# Each builder returns a Program abstracting one repo protocol.  The
+# ``agreed`` flag selects the shipped idiom (decision routed through an
+# agreement collective / membership epoch — verifiably safe) or the buggy
+# local variant synclint exists to catch (each rank trusts its own view).
+
+def divergence_model(agreed: bool = True) -> Program:
+    """ft/divergence.py skip/rollback: after each step's grad all-reduce,
+    the guard may roll state back via StateKeeper.restore (a gather).  The
+    shipped flag is all-reduced *inside* the step, so every rank reads the
+    same verdict; the buggy variant branches on a per-rank loss check."""
+    scope = "agreed" if agreed else "local"
+    site = ("ft/divergence.py:DivergenceGuard.drain" if agreed
+            else "ft/divergence.py:<local loss check>")
+    return Program(
+        name=f"divergence-{'agreed' if agreed else 'local'}",
+        instrs=(
+            ("coll", "grad_allreduce"),          # 0: step 1
+            ("branch", scope, "diverged", 2, 3, site),   # 1
+            ("coll", "rollback_gather"),         # 2: StateKeeper.restore
+            ("coll", "grad_allreduce"),          # 3: step 2
+            ("end",),                            # 4
+        ))
+
+
+def elastic_model(agreed: bool = True) -> Program:
+    """ft/elastic.py shrink/grow: the coordinator bumps a membership
+    epoch, every rank re-meshes at the *same* step, and the post-shrink
+    collective is a different op (smaller replica groups — spelled here
+    as ``allreduce_w4`` vs ``allreduce_w8``).  The buggy variant lets each
+    rank act on its own liveness probe: one re-meshes to world=4 while the
+    other all-reduces at world=8 — the PR 13 two-rank hang, statically."""
+    scope = "agreed" if agreed else "local"
+    site = ("ft/elastic.py:ElasticCoordinator.decide" if agreed
+            else "ft/elastic.py:<local liveness probe>")
+    return Program(
+        name=f"elastic-shrink-{'agreed' if agreed else 'local'}",
+        instrs=(
+            ("coll", "allreduce_w8"),            # 0: full-world step
+            ("branch", scope, "shrink", 2, 4, site),     # 1
+            ("coll", "remesh_gather"),           # 2: re-grid state
+            ("coll", "allreduce_w4"),            # 3: shrunk-world step
+            ("goto", 5),                         # 4 -> skip to join
+            ("end",),                            # 5
+        ))
+
+
+def checkpoint_model(agreed: bool = True) -> Program:
+    """checkpoint prev-fallback: when the newest checkpoint fails
+    verification, restore falls back to the previous one — both restores
+    gather sharded leaves, but they are *different* gathers (different
+    step's layouts).  Shipped: the fallback verdict is agreed before any
+    rank touches storage.  Buggy: each rank probes its own local copy."""
+    scope = "agreed" if agreed else "local"
+    site = ("utils/checkpoint.py:<agreed fallback verdict>" if agreed
+            else "utils/checkpoint.py:<local os.path.exists probe>")
+    return Program(
+        name=f"checkpoint-fallback-{'agreed' if agreed else 'local'}",
+        instrs=(
+            ("branch", scope, "corrupt", 1, 3, site),    # 0
+            ("coll", "restore_prev_gather"),     # 1: previous save's gather
+            ("goto", 4),                         # 2
+            ("coll", "restore_gather"),          # 3: newest save's gather
+            ("coll", "step_allreduce"),          # 4: first step after
+            ("end",),                            # 5
+        ))
+
+
+def preempt_model(agreed: bool = True) -> Program:
+    """utils/preempt.py stop decision: a SIGTERM lands on *one* rank; if
+    it exits on its local flag the survivors block forever in the next
+    grad all-reduce — the exact two-rank hang `chaoskit drill hang`
+    reproduces live and the PR 13 watchdog diagnoses post-mortem.  The
+    shipped PreemptionAgreement all-reduces the flag so every rank stops
+    at the same step boundary."""
+    scope = "agreed" if agreed else "local"
+    site = ("utils/preempt.py:PreemptionAgreement.should_stop" if agreed
+            else "utils/preempt.py:<local guard.triggered flag>")
+    return Program(
+        name=f"preempt-{'agreed' if agreed else 'local'}",
+        instrs=(
+            ("coll", "grad_allreduce"),          # 0: step 1
+            ("branch", scope, "stop", 3, 2, site),       # 1
+            ("coll", "grad_allreduce"),          # 2: step 2
+            ("end",),                            # 3: drain + exit
+        ))
+
+
+# name -> (builder(agreed) , description).  ``check_protocols`` verifies
+# the agreed variants; the local variants are the planted half of the
+# selftest (each MUST yield a counterexample or the explorer is broken).
+MODELS: Dict[str, tuple] = {
+    "divergence-skip-rollback": (
+        divergence_model, "DivergenceGuard skip/rollback vs StateKeeper"),
+    "elastic-shrink-grow": (
+        elastic_model, "elastic re-mesh epoch vs the active world's step"),
+    "checkpoint-prev-fallback": (
+        checkpoint_model, "restore-time fallback to the previous save"),
+    "preempt-stop": (
+        preempt_model, "SIGTERM stop decision vs in-flight collectives"),
+}
+
+
+def check_protocols() -> List[Finding]:
+    """Verify every shipped (agreed) protocol model; a counterexample in
+    one of these is an error — the repo's own idiom would deadlock."""
+    findings: List[Finding] = []
+    for key, (builder, desc) in sorted(MODELS.items()):
+        cex = explore(builder(agreed=True))
+        if cex is not None:
+            findings.append(Finding(
+                kind="protocol-desync", severity="error",
+                where=f"proto:{key}",
+                message=f"{desc}: {cex}"))
+        else:
+            findings.append(Finding(
+                kind="protocol-desync", severity="info",
+                where=f"proto:{key}",
+                message=f"{desc}: verified desync-free "
+                        "(all branch valuations explored)"))
+    return findings
+
+
+def planted_counterexamples() -> List[Finding]:
+    """Run the buggy (local-predicate) variants: every one must desync.
+    These are the planted fixtures — the selftest and ``chaoskit drill
+    desync`` assert the explorer still finds each hang."""
+    findings: List[Finding] = []
+    for key, (builder, desc) in sorted(MODELS.items()):
+        cex = explore(builder(agreed=False))
+        if cex is None:
+            raise AssertionError(
+                f"protocol explorer missed the planted desync in the "
+                f"local variant of {key} — the model checker is broken")
+        findings.append(Finding(
+            kind="protocol-desync", severity="error",
+            where=f"proto:{key}:local-variant",
+            message=f"{desc}: {cex}"))
+    return findings

@@ -1426,7 +1426,11 @@ class LMTrainer:
                 if self.chaos is not None:
                     self.chaos.on_step(self, i)
                 if self.elastic is not None:
-                    chg = self.elastic.poll(i)
+                    # Membership epochs are coordinator-committed and read
+                    # by every rank at the same step — an agreed value,
+                    # not a local liveness probe (synclint would otherwise
+                    # flag the re-mesh below as a divergent collective).
+                    chg = self.elastic.poll(i)  # synclint: agreement
                     if chg is not None:
                         # Membership changed: rebuild against the survivor
                         # set and restart the token stream at the resume
@@ -1503,7 +1507,10 @@ class LMTrainer:
                     rollback = self.ft_guard.observe(
                         i, metrics.get("nonfinite"))
                     if at_save:
-                        rollback = self.ft_guard.drain() or rollback
+                        # Agreed: the drained flag is the in-step
+                        # all-reduced nonfinite count — every rank reads
+                        # the identical verdict at the same boundary.
+                        rollback = self.ft_guard.drain() or rollback  # synclint: agreement
                     if rollback:
                         self._rollback(i)
                     # A flagged streak means the current state is suspect —
@@ -1526,10 +1533,11 @@ class LMTrainer:
                 else:
                     final_ppl = None
                 i += 1
-            if self.ft_guard is not None and self.ft_guard.drain():
+            if self.ft_guard is not None and self.ft_guard.drain():  # synclint: agreement
                 # Trailing flags buffered past the last cadence point must
                 # resolve before the end-of-fit checkpoint can capture a
-                # diverged state.
+                # diverged state.  Agreed: the flag drains an in-step
+                # all-reduced scalar.
                 self._rollback(completed)
         except BaseException as e:
             if self.flight is not None:

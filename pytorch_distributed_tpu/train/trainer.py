@@ -853,7 +853,11 @@ class Trainer:
             if self.chaos is not None:
                 self.chaos.on_step(self, i)
             if self.elastic is not None:
-                chg = self.elastic.poll(self._global_step)
+                # Membership epochs are committed by the coordinator and
+                # read by every rank at the same step — an agreed value,
+                # not a local probe (synclint would otherwise flag the
+                # re-mesh below as a rank-divergent collective path).
+                chg = self.elastic.poll(self._global_step)  # synclint: agreement
                 if chg is not None:
                     # Membership changed: rebuild against the survivor set
                     # and rewind to the snapshot step (the sampler's
@@ -931,7 +935,10 @@ class Trainer:
                 rollback = self.ft_guard.observe(
                     self._global_step - 1, metrics.get("nonfinite"))
                 if at_save:
-                    rollback = self.ft_guard.drain() or rollback
+                    # The drained flag is the in-step all-reduced nonfinite
+                    # count: every rank drains the identical value, so the
+                    # rollback decision below is bulk-synchronous.
+                    rollback = self.ft_guard.drain() or rollback  # synclint: agreement
                 if rollback:
                     lr_arr = jnp.float32(lr * self._rollback(epoch, i)
                                          * self._elastic_lr_scale)
@@ -942,9 +949,10 @@ class Trainer:
                 self._save_step_checkpoint(epoch, completed)
                 meters.restart_clock()  # exclude checkpoint I/O from meter
             i += 1
-        if self.ft_guard is not None and self.ft_guard.drain():
+        if self.ft_guard is not None and self.ft_guard.drain():  # synclint: agreement
             # Trailing flags (buffered past the last cadence point) must be
             # resolved before the epoch-end checkpoint can capture them.
+            # Agreed: the flag drains an in-step all-reduced scalar.
             self._rollback(epoch, completed)
         return completed, False
 

@@ -28,8 +28,15 @@ Commands:
   mid-soak so first-token latency blows through a ``ttft_p99`` rule's
   ceiling, and passes iff the alert is booked live as an ``alert``
   ft_event in the serving JSONL and ``obs_report`` folds the serving
-  section.  The only commands that build a mesh (jax imported lazily
-  inside them);
+  section; ``desync`` (ISSUE 18) plants one rank-divergent branch and
+  demands BOTH detectors catch it: synclint's host desync pass + protocol
+  model check statically (pre-launch), and — because a rank that diverges
+  away from a collective looks exactly like a stalled rank to its peers —
+  the hang watchdog / flight recorder / postmortem live.  The only
+  commands that build a mesh (jax imported lazily inside them).
+  Every drill kind shares the ``--seed`` contract: the injection step
+  comes from ``drill_plan(seed, steps)``, so the same seed reproduces
+  the same schedule across kinds and runs;
 - ``--selftest``     the fast no-mesh CI path (tier-1, like
   ``shardlint.py --selftest`` / ``obs_report.py --selftest``): sidecar
   round-trip, flip/truncate detection, corruption determinism, retry
@@ -122,6 +129,8 @@ def cmd_drill(args) -> int:
 
     if args.kind == "hang":
         return _drill_hang(args)
+    if args.kind == "desync":
+        return _drill_desync(args)
     if args.kind == "alert":
         return _drill_alert(args)
     if args.kind == "serve":
@@ -237,6 +246,51 @@ def _drill_hang(args) -> int:
     print(f"final loss {loss:.4f}; hang flagged at step {hang_step}, "
           f"{len(dumps)} rank dump(s)")
     print("drill hang: OK")
+    return 0
+
+
+def _drill_desync(args) -> int:
+    """Desync drill (ISSUE 18): one planted rank-divergent branch, two
+    detectors.  Statically, synclint's host desync pass must flag the
+    branch pre-launch (the collective guarded by a rank-/data-dependent
+    predicate with no agreement point), and the protocol model check
+    must produce the matching counterexample.  Live, the divergence is
+    executed at the same seed-chosen step (``drill_plan`` — the shared
+    ``--seed`` contract): the divergent rank never enters the collective
+    its peers are blocked in, which to those peers is indistinguishable
+    from a stall — so the live verdict is exactly the hang drill's
+    watchdog + flight-recorder + postmortem signature."""
+    from pytorch_distributed_tpu.analysis import synclint, syncproto
+
+    findings = synclint.planted_desync_findings()
+    errs = [f for f in findings if f.severity == "error"]
+    print(f"desync static: synclint flags {len(errs)} planted branch(es)")
+    for f in errs:
+        print(f"  {f}")
+    if len(errs) != 2:
+        print("FAIL: synclint must flag both planted divergent branches")
+        return 1
+    if not any("rank-dependent" in f.message for f in errs) or \
+            not any("locally-data-dependent" in f.message for f in errs):
+        print("FAIL: expected one rank-dependent and one "
+              "locally-data-dependent finding")
+        return 1
+    planted = syncproto.planted_counterexamples()
+    cex = [f for f in planted if "preempt" in f.where]
+    print(f"desync static: protocol explorer reproduces the hang: "
+          f"{cex[0].message if cex else 'MISSING'}")
+    if not cex:
+        print("FAIL: protocol model check lost the preempt counterexample")
+        return 1
+
+    print("desync live: executing the divergence — the divergent rank "
+          "skips the collective its peers are blocked in; the watchdog "
+          "+ flight recorder must name it")
+    rc = _drill_hang(args)
+    if rc != 0:
+        return rc
+    print("drill desync: OK (static synclint + live flight recorder "
+          "both caught the divergent branch)")
     return 0
 
 
@@ -880,7 +934,7 @@ def main(argv=None) -> int:
                        help="run an end-to-end elastic membership drill")
     d.add_argument("kind",
                    choices=("shrink", "grow", "hang", "alert", "serve",
-                            "trace"),
+                            "trace", "desync"),
                    help="shrink: lose a rank and continue; grow: lose "
                         "then re-admit it; hang: stall a rank inside a "
                         "collective and let the watchdog catch it; "
@@ -890,12 +944,20 @@ def main(argv=None) -> int:
                         "the ttft_p99 SLO alert live; trace: a "
                         "preemption storm whose request-trace tail "
                         "attribution must name preempt_redo and fire "
-                        "the preempt_redo alert live")
+                        "the preempt_redo alert live; desync: a planted "
+                        "rank-divergent branch must be caught statically "
+                        "by synclint AND live by the hang watchdog + "
+                        "flight recorder")
     d.add_argument("--world", type=int, default=4,
                    help="starting data-parallel world size")
     d.add_argument("--steps", type=int, default=12)
     d.add_argument("--seed", type=int, default=0,
-                   help="drives the injection schedule (deterministic)")
+                   help="drives the injection schedule for EVERY drill "
+                        "kind (the shared chaoskit contract): the same "
+                        "seed yields the same drill_plan() step — the "
+                        "lose/re-admit steps for shrink/grow, and the "
+                        "stall/divergence step for hang/desync — so any "
+                        "drill reproduces byte-for-byte from its seed")
     d.add_argument("--hang-timeout", type=float, default=1.0,
                    help="hang-drill watchdog timeout in seconds (the "
                         "injected stall is 4x this)")

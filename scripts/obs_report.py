@@ -11,7 +11,11 @@ Inputs (any subset):
   ``utils.telemetry.TelemetrySampler`` (``--telemetry-csv``);
 - ``--flight-dir``     flight-recorder ring dumps (``--flight-rec`` on
   either trainer), folded in as the ``== postmortem ==`` cross-rank
-  root-cause section (scripts/postmortem.py).
+  root-cause section (scripts/postmortem.py);
+- ``--synclint-json``  a synclint/shardlint ``--json`` capture, folded
+  in as the ``== synclint ==`` cross-rank congruence section — the
+  pre-launch twin of the postmortem fold.  With ``--strict``, any
+  error-severity sync finding fails the report.
 
 Output: step-time percentiles + throughput + MFU + loss/grad-norm
 trajectory, the goodput/badput ledger (ft_event + recompile records),
@@ -698,6 +702,68 @@ def summarize_traces(records: List[dict]) -> List[str]:
     return lines
 
 
+_SYNC_KINDS = ("collective-incongruence", "sync-digest-drift",
+               "collective-desync", "protocol-desync")
+
+
+def synclint_stats(path: str) -> Dict:
+    """Roll up a synclint/shardlint ``--json`` report list: digest-pinned
+    schedules, protocol verdicts, and every surviving sync finding."""
+    try:
+        with open(path) as f:
+            reports = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"error": str(e)}
+    digests = 0
+    protocols_verified = 0
+    by_kind: Dict[str, int] = {}
+    findings: List[dict] = []
+    for r in reports:
+        if r.get("sync_digest"):
+            digests += 1
+        for f in r.get("findings", []):
+            if f.get("kind") not in _SYNC_KINDS:
+                continue
+            if (f["kind"] == "protocol-desync"
+                    and f.get("severity") == "info"):
+                protocols_verified += 1
+                continue
+            by_kind[f["kind"]] = by_kind.get(f["kind"], 0) + 1
+            findings.append(f)
+    return {
+        "schedules_pinned": digests,
+        "protocols_verified": protocols_verified,
+        "errors": sum(1 for f in findings if f.get("severity") == "error"),
+        "warnings": sum(1 for f in findings if f.get("severity") == "warn"),
+        "by_kind": by_kind,
+        "findings": findings,
+    }
+
+
+def summarize_synclint(path: str) -> List[str]:
+    """The ``== synclint ==`` fold: cross-rank congruence verdicts from a
+    synclint/shardlint --json capture.  Errors here are the pre-launch
+    twin of the postmortem section's hang diagnosis."""
+    s = synclint_stats(path)
+    lines = ["== synclint =="]
+    if "error" in s:
+        lines.append(f"  (unreadable: {s['error']})")
+        return lines
+    lines.append(f"  {s['schedules_pinned']} collective schedule(s) "
+                 f"digest-verified; {s['protocols_verified']} protocol(s) "
+                 "model-checked desync-free")
+    if not s["findings"]:
+        lines.append("  congruence clean: no desync findings")
+    else:
+        lines.append(f"  {s['errors']} error(s), {s['warnings']} warn(s): "
+                     + ", ".join(f"{k}×{v}"
+                                 for k, v in sorted(s["by_kind"].items())))
+        for f in s["findings"]:
+            lines.append(f"  [{f.get('severity')}] {f.get('kind')} @ "
+                         f"{f.get('where')}: {f.get('message')}")
+    return lines
+
+
 def report(args) -> str:
     sections = []
     records: List[dict] = []
@@ -735,6 +801,8 @@ def report(args) -> str:
         sections.append("== heartbeats ==")
         sections += summarize_heartbeats(args.hb_dir, args.now,
                                          args.max_step_lag, args.max_beat_age)
+    if getattr(args, "synclint_json", None):
+        sections += summarize_synclint(args.synclint_json)
     if getattr(args, "flight_dir", None):
         sections += postmortem_section(args.flight_dir,
                                        getattr(args, "hb_dir", None))
@@ -829,6 +897,8 @@ def report_json(args) -> Dict:
         member = read_membership(args.hb_dir)
         if member is not None:
             out["membership"] = member
+    if getattr(args, "synclint_json", None):
+        out["synclint"] = synclint_stats(args.synclint_json)
     if getattr(args, "flight_dir", None):
         try:
             out["postmortem"] = _postmortem_mod().postmortem(
@@ -1442,6 +1512,66 @@ def _selftest() -> int:
         assert rc4 == 1, "selftest: strict report must fail on stale LKG"
         assert rc5 == 0, "selftest: non-strict report must stay exit 0"
 
+        # ---- synclint fold: section, json twin, strict fence ----
+        sync_ok = os.path.join(d, "synclint_ok.json")
+        sync_bad = os.path.join(d, "synclint_bad.json")
+        clean_step = {
+            "name": "lm_train_dp", "mesh_shape": {"data": 4},
+            "findings": [], "collectives": {}, "memory": {},
+            "donation": {}, "sync_digest": "a" * 64}
+        proto_step = {
+            "name": "sync-protocols", "mesh_shape": {}, "collectives": {},
+            "memory": {}, "donation": {}, "sync_digest": "",
+            "findings": [{"kind": "protocol-desync", "severity": "info",
+                          "where": "proto:preempt-stop",
+                          "message": "verified desync-free"}]}
+        with open(sync_ok, "w") as f:
+            json.dump([clean_step, proto_step], f)
+        desync_step = {
+            "name": "sync-scopes", "mesh_shape": {}, "collectives": {},
+            "memory": {}, "donation": {}, "sync_digest": "",
+            "findings": [{"kind": "collective-desync", "severity": "error",
+                          "where": "train/lm.py:1500",
+                          "message": "collective call step_fn() is "
+                                     "reachable under a rank-dependent "
+                                     "branch"}]}
+        with open(sync_bad, "w") as f:
+            json.dump([clean_step, proto_step, desync_step], f)
+        ns_sync = argparse.Namespace(
+            metrics_jsonl=None, hb_dir=None, telemetry_csv=None, now=now,
+            max_step_lag=3, max_beat_age=60.0, bench_lkg=None,
+            bench_events=None, bench_max_stale_days=14.0, plan=None,
+            flight_dir=None, synclint_json=sync_ok)
+        sync_out = report(ns_sync)
+        for needle in ("== synclint ==",
+                       "1 collective schedule(s) digest-verified",
+                       "1 protocol(s) model-checked desync-free",
+                       "congruence clean: no desync findings"):
+            assert needle in sync_out, (
+                f"selftest: {needle!r} missing from:\n{sync_out}")
+        js_sync = report_json(ns_sync)
+        assert js_sync["synclint"]["errors"] == 0, js_sync["synclint"]
+        assert js_sync["synclint"]["schedules_pinned"] == 1, (
+            js_sync["synclint"])
+        ns_sync.synclint_json = sync_bad
+        bad_out = report(ns_sync)
+        assert "[error] collective-desync @ train/lm.py:1500" in bad_out, (
+            bad_out)
+        js_bad = report_json(ns_sync)
+        assert js_bad["synclint"]["errors"] == 1, js_bad["synclint"]
+        assert js_bad["synclint"]["by_kind"] == {
+            "collective-desync": 1}, js_bad["synclint"]
+        buf_sync = io.StringIO()
+        with contextlib.redirect_stdout(buf_sync):
+            rc_s_ok = main(["--synclint-json", sync_ok, "--strict"])
+            rc_s_note = main(["--synclint-json", sync_bad])
+            rc_s_bad = main(["--synclint-json", sync_bad, "--strict"])
+        assert rc_s_ok == 0, "selftest: strict clean synclint must pass"
+        assert rc_s_note == 0, (
+            "selftest: non-strict synclint errors stay exit 0 (a note)")
+        assert rc_s_bad == 1, (
+            "selftest: --strict must fail on synclint error findings")
+
         # ---- serving plane: section, json twin, planted TTFT fence ----
         # a training-shaped run must not grow a serving section
         assert "== serving ==" not in out, out
@@ -1672,6 +1802,13 @@ def main(argv=None) -> int:
                     help="promote the bench-staleness WARN to a failure: "
                     "exit 1 from the report and from --diff when the last "
                     "good benchmark is older than --bench-max-stale-days")
+    ap.add_argument("--synclint-json", type=str, default=None,
+                    dest="synclint_json", metavar="PATH",
+                    help="synclint/shardlint --json capture to fold in as "
+                    "the '== synclint ==' cross-rank congruence section; "
+                    "with --strict, any error-severity sync finding "
+                    "(incongruent schedule, digest drift, host desync, "
+                    "protocol counterexample) fails the report")
     ap.add_argument("--flight-dir", type=str, default=None,
                     dest="flight_dir", metavar="DIR",
                     help="directory with flight-recorder dumps "
@@ -1714,6 +1851,7 @@ def main(argv=None) -> int:
         print(json.dumps(report_json(args), indent=2))
     else:
         print(report(args))
+    rc = 0
     staleness = bench_staleness_info(args)
     if (getattr(args, "strict", False) and staleness is not None
             and staleness.get("warn")):
@@ -1721,8 +1859,18 @@ def main(argv=None) -> int:
               f"{staleness['days_stale']:.1f} days "
               f"(> {staleness['max_stale_days']:g}) — failing",
               file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if getattr(args, "strict", False) and getattr(
+            args, "synclint_json", None):
+        sstats = synclint_stats(args.synclint_json)
+        n_sync_err = sstats.get("errors", 0)
+        if "error" in sstats or n_sync_err:
+            what = (sstats.get("error")
+                    or f"{n_sync_err} error-severity sync finding(s)")
+            print(f"STRICT: synclint fold failing — {what}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

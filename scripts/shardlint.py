@@ -19,6 +19,11 @@ fused-CE mode, all three pipeline schedules, greedy decode) on a simulated
 - host-sync                blocking float()/np.asarray/.block_until_ready()
                            inside registered training hot loops (AST pass)
 
+With --sync, the synclint layers fold in (scripts/synclint.py has the
+standalone CLI): collective-incongruence / sync-digest-drift per mesh'd
+step, plus the collective-desync host pass and protocol-desync model
+check — all riding this sweep's lowering cache, zero extra compiles.
+
 Exit status 1 when any error-severity finding survives.
 
 Usage:
@@ -100,6 +105,12 @@ def main() -> int:
                          "(<name>.hlo + <name>.json) under DIR via the "
                          "shared lowering service (analysis/lowering.py) "
                          "so later text-only consumers skip the compile")
+    ap.add_argument("--sync", action="store_true",
+                    help="fold in the synclint layers: annotate each "
+                         "mesh'd step with its collective-schedule digest "
+                         "+ congruence findings (zero extra compiles — "
+                         "rides this sweep's lowering cache) and append "
+                         "the host-desync and protocol-model reports")
     ap.add_argument("--min-replicated-bytes", type=int,
                     default=core.DEFAULT_MIN_REPLICATED_BYTES)
     ap.add_argument("--min-promotion-bytes", type=int,
@@ -125,6 +136,16 @@ def main() -> int:
         min_replicated_bytes=args.min_replicated_bytes,
         min_promotion_bytes=args.min_promotion_bytes,
     )
+
+    if args.sync:
+        # Digest + congruence ride the lowering memo the sweep above
+        # already filled, so annotation adds zero compiles; it must
+        # precede the baseline branch so --update-baseline pins the
+        # digests and the diff path catches digest drift.
+        from pytorch_distributed_tpu.analysis import synclint  # noqa: E402
+        synclint.annotate_reports(reports)
+        reports.append(synclint.lint_sync_scopes())
+        reports.append(synclint.check_protocols())
 
     if args.update_baseline:
         # The hot-loop lint and single-device decode have no collective

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""synclint: cross-rank collective-congruence verifier.
+
+Three layers, all riding the shared lowering service (zero extra
+compiles beyond the shardlint sweep):
+
+- HLO congruence       every recipe's ordered collective schedule (kind,
+                       channel id, replica groups, shapes) is extracted
+                       from the compiled module text and checked for
+                       replica-group partition validity (disjoint,
+                       in-range, uniform, covering) plus permute
+                       well-formedness; the canonical schedule is pinned
+                       as a sha256 digest in analysis/baseline.json and
+                       drift is an error (a reordered schedule deadlocks
+                       a multi-process mesh even when every count/bytes
+                       budget holds).
+- host desync          inter-procedural AST pass over the registered hot
+                       loops (synclint.SYNC_SCOPES) flagging jitted-step
+                       / collective calls reachable under rank-dependent
+                       or locally-data-dependent branches that are not
+                       routed through a '# synclint: agreement' point.
+                       '# synclint: allow' suppresses a single call.
+- protocol model check explicit-state exploration of the repo's
+                       multi-step protocols (divergence rollback,
+                       elastic shrink/grow, checkpoint fallback,
+                       preemption stop) for reachable states where ranks
+                       disagree on the next collective — the static twin
+                       of the PR 13 flight-recorder hang post-mortem.
+
+Exit status 1 when any error-severity finding survives.
+
+Usage:
+  python scripts/synclint.py                     # all three layers
+  python scripts/synclint.py --steps lm_train_dp # HLO layer subset
+  python scripts/synclint.py --hlo-cache hlo/    # jax-free: congruence
+                                                 # off persisted lowering
+                                                 # artifacts instead of a
+                                                 # live sweep
+  python scripts/synclint.py --no-hlo --no-proto # AST layer only
+  python scripts/synclint.py --update-baseline   # patch the current
+                                                 # schedule digests into
+                                                 # analysis/baseline.json
+  python scripts/synclint.py --selftest          # jax-free planted
+                                                 # fixture checks
+"""
+
+import argparse
+import json
+import os
+import sys
+
+# Must precede the first jax import: the live sweep needs >= 4 simulated
+# devices (mirrors tests/conftest.py so schedule digests match the test
+# sweep).  Pure env-var setup — the jax import itself stays in main().
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_DEFAULT_BASELINE = os.path.join(
+    _REPO, "pytorch_distributed_tpu", "analysis", "baseline.json")
+_FIXTURE_DIR = os.path.join(_REPO, "tests", "data", "synclint")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """Argparse-only parser factory (lint-checked by test_recipe_flags)."""
+    ap = argparse.ArgumentParser(
+        prog="synclint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--steps", default=None,
+                    help="comma-separated subset of recipes for the HLO "
+                         "layer (see --list)")
+    ap.add_argument("--list", action="store_true",
+                    help="list known recipe names and exit")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full reports as JSON")
+    ap.add_argument("--baseline", default=_DEFAULT_BASELINE,
+                    help="baseline with pinned sync digests (default: the "
+                         "checked-in analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the schedule-digest diff")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="patch the current collective-schedule digests "
+                         "into --baseline (preserving the collective/"
+                         "memory budgets shardlint pinned) instead of "
+                         "diffing")
+    ap.add_argument("--hlo-cache", default=None, metavar="DIR",
+                    help="run the HLO congruence layer jax-free off "
+                         "persisted lowering artifacts (<name>.hlo + "
+                         "<name>.json, written by shardlint --hlo-cache) "
+                         "instead of a live sweep")
+    ap.add_argument("--no-hlo", action="store_true",
+                    help="skip the HLO congruence layer")
+    ap.add_argument("--no-ast", action="store_true",
+                    help="skip the host control-flow desync layer")
+    ap.add_argument("--no-proto", action="store_true",
+                    help="skip the protocol model check layer")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the jax-free planted-fixture checks and exit")
+    return ap
+
+
+def _selftest() -> int:
+    """Jax-free detector checks on the checked-in fixtures.
+
+    Every layer must both fire on its planted hazard and stay quiet on
+    the clean twin — a lint that can't find its own plant is noise."""
+    from pytorch_distributed_tpu.analysis import astlint, synclint, syncproto
+
+    def _read(fname):
+        with open(os.path.join(_FIXTURE_DIR, fname)) as f:
+            return f.read()
+
+    # layer 1: congruent fixture is clean and digest-stable
+    good = _read("good.hlo")
+    assert synclint.verify_congruence(good, "good", n_devices=4) == [], \
+        "good.hlo must verify congruent"
+    sched = synclint.extract_schedule(good)
+    assert len(sched) == 4, f"good.hlo schedule has {len(sched)} entries"
+    d1 = synclint.schedule_digest(sched)
+    d2 = synclint.schedule_digest(synclint.extract_schedule(good))
+    assert d1 == d2 and len(d1) == 64, "schedule digest must be stable"
+
+    # layer 1: every planted incongruence fires with the right diagnosis
+    planted_hlo = {
+        "bad_dup.hlo": "more than one replica group",
+        "bad_oob.hlo": "out of range",
+        "bad_sizes.hlo": "mismatched sizes",
+        "bad_missing.hlo": "participate in no replica group",
+        "bad_permute.hlo": "not a permutation",
+    }
+    for fname, needle in planted_hlo.items():
+        fs = synclint.verify_congruence(_read(fname), fname, n_devices=4)
+        assert fs and all(f.kind == "collective-incongruence" for f in fs), \
+            f"{fname}: expected collective-incongruence, got {fs}"
+        assert any(needle in f.message for f in fs), \
+            f"{fname}: no finding mentions {needle!r}: {fs}"
+
+    # layer 2: planted desync fires at the documented lines, anchored
+    # twin is clean, and the in-module plant agrees
+    fs = astlint.lint_desync_source(
+        _read("desync_planted.py"),
+        path="desync_planted.py", hot_functions=("T.fit",))
+    got = sorted(f.where for f in fs)
+    assert got == ["desync_planted.py:16", "desync_planted.py:19"], \
+        f"planted desync fired at {got}"
+    assert any("rank-dependent" in f.message for f in fs)
+    assert any("locally-data-dependent" in f.message for f in fs)
+    fs = astlint.lint_desync_source(
+        _read("agreement_ok.py"),
+        path="agreement_ok.py", hot_functions=("T.fit",))
+    assert fs == [], f"agreement_ok.py must lint clean, got {fs}"
+    assert len(synclint.planted_desync_findings()) == 2
+
+    # layer 3: shipped protocols verify; buggy local variants desync
+    proto = syncproto.check_protocols()
+    assert proto and all(f.severity == "info" for f in proto), \
+        f"shipped protocols must verify desync-free, got {proto}"
+    planted = syncproto.planted_counterexamples()
+    assert len(planted) == len(syncproto.MODELS) and \
+        all(f.severity == "error" for f in planted), \
+        f"planted protocol variants must desync, got {planted}"
+
+    print(f"synclint selftest OK: {len(planted_hlo)} planted HLO "
+          f"incongruences, 2 planted desync sites, "
+          f"{len(planted)} planted protocol counterexamples all caught; "
+          "clean twins quiet")
+    return 0
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+
+    if args.selftest:
+        return _selftest()
+
+    from pytorch_distributed_tpu.analysis import synclint
+    from pytorch_distributed_tpu.analysis import (
+        load_baseline,
+        render_table,
+    )
+
+    if args.list:
+        import jax  # noqa: F401
+        jax.config.update("jax_platforms", "cpu")
+        from pytorch_distributed_tpu.analysis import core
+        for name in core.RECIPES:
+            print(name)
+        print("sync-scopes")
+        print("sync-protocols")
+        return 0
+
+    names = args.steps.split(",") if args.steps else None
+    reports = []
+
+    if not args.no_hlo:
+        if args.hlo_cache:
+            reports.extend(synclint.sweep_cached(args.hlo_cache, names))
+        else:
+            import jax  # noqa: F401
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_threefry_partitionable", True)
+            reports.extend(synclint.sweep(names))
+
+    if not args.no_ast:
+        reports.append(synclint.lint_sync_scopes())
+    if not args.no_proto:
+        reports.append(synclint.check_protocols())
+
+    hlo_reports = [r for r in reports if r.sync_digest]
+
+    if args.update_baseline:
+        # JSON-level patch: only the sync_digest keys change, so the
+        # collective/memory budgets shardlint pinned stay byte-identical.
+        baseline = (load_baseline(args.baseline)
+                    if os.path.exists(args.baseline) else {})
+        patched = 0
+        for r in hlo_reports:
+            baseline.setdefault(r.name, {})["sync_digest"] = r.sync_digest
+            patched += 1
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"patched {patched} schedule digest(s) into {args.baseline}")
+    elif not args.no_baseline and hlo_reports:
+        baseline = (load_baseline(args.baseline)
+                    if os.path.exists(args.baseline) else {})
+        if not baseline:
+            print(f"note: no baseline at {args.baseline}; run "
+                  "--update-baseline to pin schedule digests")
+        for r in hlo_reports:
+            entry = baseline.get(r.name)
+            for f in synclint.diff_digest(r, entry):
+                r.add(f)
+
+    print(render_table(reports))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump([r.to_dict() for r in reports], f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.json}")
+
+    n_err = sum(len(r.errors()) for r in reports)
+    if n_err:
+        print(f"synclint: {n_err} error finding(s)", file=sys.stderr)
+        return 1
+    print("synclint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

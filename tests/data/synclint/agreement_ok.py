@@ -1,0 +1,17 @@
+"""Anchored twin of desync_planted.py: every divergent branch routes through an
+agreement point or carries an explicit suppression. Must lint clean."""
+
+
+class T:
+    def fit(self, state, metrics):
+        for i in range(8):
+            if jax.process_index() == 0:
+                self.save_checkpoint(state, i)  # synclint: allow
+            flag = self.agree(float(metrics["diverged"]))  # synclint: agreement
+            if flag > 0.5:
+                state = rollback(state)
+        return state
+
+
+def rollback(state):
+    return psum(state, "data")

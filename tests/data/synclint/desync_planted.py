@@ -1,0 +1,24 @@
+"""Planted desync fixture: rank- and data-dependent branches guarding collectives.
+
+Consumed by ``scripts/synclint.py --selftest`` and tests/test_synclint.py.
+Expected findings (lines matter -- keep this file stable):
+
+  * line 15 branch on jax.process_index() guards save_checkpoint (line 16)
+  * line 18 branch on float(metrics[...]) guards rollback (line 19), which is
+    collective-issuing inter-procedurally via psum.
+"""
+
+
+class T:
+    def fit(self, state, metrics):
+        for i in range(8):
+            if jax.process_index() == 0:
+                self.save_checkpoint(state, i)
+            flag = float(metrics["diverged"])
+            if flag > 0.5:
+                state = rollback(state)
+        return state
+
+
+def rollback(state):
+    return psum(state, "data")

@@ -388,6 +388,22 @@ def test_maybe_enable_force_disable(monkeypatch):
         verdict["reason"])
 
 
+def test_gate_verdict_logged_once_per_session(monkeypatch, capsys):
+    from pytorch_distributed_tpu.analysis import lowering
+
+    monkeypatch.setenv("PTD_PERSISTENT_CACHE", "0")
+    monkeypatch.setattr(lowering, "_GATE_VERDICT_LOGGED", False)
+    lowering.maybe_enable_persistent_cache()
+    err = capsys.readouterr().err
+    ver = ".".join(map(str, lowering.jaxlib_version_tuple()))
+    assert "[lowering] persistent compilation cache disabled" in err
+    assert f"jaxlib {ver}" in err
+    assert "PTD_PERSISTENT_CACHE=0" in err
+    # second call in the same session: the verdict line must not repeat
+    lowering.maybe_enable_persistent_cache()
+    assert capsys.readouterr().err == ""
+
+
 class _FakeRun:
     def __init__(self, returncode, stdout):
         self.returncode = returncode

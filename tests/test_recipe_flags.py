@@ -27,15 +27,23 @@ from pytorch_distributed_tpu.recipes import lm_generate, lm_pretrain
 from pytorch_distributed_tpu.train import config as config_mod
 
 
-def _load_serve_lm():
-    """scripts/ is not a package; load the serving front end by path
-    (its heavy imports live inside main(), so this is argparse-only)."""
+def _load_script(fname, modname):
+    """scripts/ is not a package; load a script by path (heavy imports
+    live inside main(), so module load is argparse-only)."""
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts", "serve_lm.py")
-    spec = importlib.util.spec_from_file_location("serve_lm_flags", path)
+        os.path.abspath(__file__))), "scripts", fname)
+    spec = importlib.util.spec_from_file_location(modname, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_serve_lm():
+    return _load_script("serve_lm.py", "serve_lm_flags")
+
+
+def _load_synclint():
+    return _load_script("synclint.py", "synclint_flags")
 
 
 PARSERS = {
@@ -45,6 +53,7 @@ PARSERS = {
     "recipes.lm_pretrain": lambda: lm_pretrain.build_parser(),
     "recipes.lm_generate": lambda: lm_generate.build_parser(),
     "scripts.serve_lm": lambda: _load_serve_lm().build_parser(),
+    "scripts.synclint": lambda: _load_synclint().build_parser(),
 }
 
 
@@ -202,6 +211,57 @@ def test_overlap_flags_parse_to_their_own_dests():
     assert args.precision == "bf16"  # the PR-9 symptom, pinned
     args = lm_pretrain.build_parser().parse_args([])
     assert (args.overlap, args.bucket_mb) == ("none", 4.0)
+
+
+def test_synclint_flags_parse_to_their_own_dests():
+    """ISSUE-18 flags: the synclint CLI's layer toggles, baseline pair,
+    and jax-free paths land in their own dests, default to everything-on
+    with the checked-in baseline, and collide with nothing (the
+    parametrized _lint tests above cover the collision half)."""
+    ap = _load_synclint().build_parser()
+    args = ap.parse_args(
+        ["--steps", "lm_train_dp", "--hlo-cache", "/tmp/hlo",
+         "--no-ast", "--no-proto", "--json", "/tmp/out.json"])
+    assert (args.steps, args.hlo_cache) == ("lm_train_dp", "/tmp/hlo")
+    assert (args.no_hlo, args.no_ast, args.no_proto) == (False, True, True)
+    assert args.json == "/tmp/out.json"
+    args = ap.parse_args([])
+    assert (args.no_hlo, args.no_ast, args.no_proto) == (
+        False, False, False)
+    assert (args.selftest, args.update_baseline, args.no_baseline) == (
+        False, False, False)
+    assert args.baseline.endswith(os.path.join("analysis", "baseline.json"))
+    assert args.hlo_cache is None and args.steps is None
+
+
+def test_chaoskit_drill_gains_the_desync_kind():
+    """ISSUE-18 satellite: ``chaoskit drill desync`` is a real choice and
+    the shared ``--seed`` contract flags still parse to their own dests."""
+    ck = _load_script("chaoskit.py", "chaoskit_flags")
+    import argparse as _ap
+
+    rc_holder = {}
+
+    class _Exit(Exception):
+        pass
+
+    def fake_drill(args):
+        rc_holder["args"] = args
+        raise _Exit()
+
+    orig = ck.cmd_drill
+    ck.cmd_drill = fake_drill
+    try:
+        with pytest.raises(_Exit):
+            ck.main(["drill", "desync", "--seed", "3", "--steps", "16"])
+    finally:
+        ck.cmd_drill = orig
+    parsed = rc_holder["args"]
+    assert isinstance(parsed, _ap.Namespace)
+    assert (parsed.kind, parsed.seed, parsed.steps) == ("desync", 3, 16)
+    # the shared contract: the same seed yields the same plan, across
+    # every drill kind that derives its step from drill_plan
+    assert ck.drill_plan(3, 16) == ck.drill_plan(3, 16)
 
 
 def test_trace_and_checkpoint_flags_parse_to_their_own_dests():

@@ -1,0 +1,453 @@
+"""synclint (ISSUE 18): the cross-rank collective-congruence verifier.
+
+Layers under test — everything except the live-sweep fence is jax-free:
+
+- HLO schedule extraction + replica-group congruence (analysis/hlo.py
+  parser extensions + analysis/synclint.py) on checked-in fixtures under
+  tests/data/synclint/ — one congruent module and five planted
+  incongruences, each of which must fire with the right diagnosis;
+- the canonical schedule digest: stable across parses, insensitive to
+  instruction renames, pinned via analysis/baseline.json, drift = error;
+- the host control-flow desync pass (analysis/astlint.py): rank- and
+  data-taint classification, inter-procedural collective propagation,
+  '# synclint: agreement' / '# synclint: allow' scoping at statement and
+  function scope with asserted line numbers, and the real hot loops
+  (synclint.SYNC_SCOPES) currently clean;
+- the protocol model check (analysis/syncproto.py): every shipped
+  protocol verifies desync-free, every planted local-decision variant
+  yields a counterexample naming the divergent collective — statically
+  reproducing the PR 13 two-rank hang;
+- the live fence: with the recipe sweep warm, annotating every mesh'd
+  report with its digest adds ZERO compiles and every digest matches the
+  checked-in baseline pin.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_tpu.analysis import astlint, syncproto
+from pytorch_distributed_tpu.analysis import hlo as hlo_mod
+from pytorch_distributed_tpu.analysis import synclint
+from pytorch_distributed_tpu.analysis.report import (
+    StepReport,
+    baseline_entry,
+    diff_against_baseline,
+    load_baseline,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "data", "synclint")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture(name: str) -> str:
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+# ------------------------------------------------------ parser extensions
+
+def test_parse_channel_id():
+    line = ("  %ar = f32[64]{0} all-reduce(f32[64]{0} %x), channel_id=7, "
+            "replica_groups={{0,1}}, to_apply=%add")
+    assert hlo_mod.parse_channel_id(line) == 7
+    assert hlo_mod.parse_channel_id("%y = f32[] add(%a, %b)") == -1
+
+
+def test_parse_replica_group_members_iota():
+    line = "... replica_groups=[2,4]<=[8], to_apply=%add"
+    assert hlo_mod.parse_replica_group_members(line) == [
+        [0, 1, 2, 3], [4, 5, 6, 7]]
+    line1 = "... replica_groups=[1,4]<=[4], to_apply=%add"
+    assert hlo_mod.parse_replica_group_members(line1) == [[0, 1, 2, 3]]
+
+
+def test_parse_replica_group_members_explicit_and_pairs():
+    assert hlo_mod.parse_replica_group_members(
+        "... replica_groups={{0,2},{1,3}}, dims={0}") == [[0, 2], [1, 3]]
+    assert hlo_mod.parse_replica_group_members(
+        "... replica_groups={}") == [[]]
+    assert hlo_mod.parse_replica_group_members(
+        "... source_target_pairs={{0,1},{1,0}}") == [[0, 1], [1, 0]]
+    assert hlo_mod.parse_replica_group_members(
+        "%y = f32[] add(%a, %b)") is None
+
+
+# ------------------------------------------- schedule + digest (layer 1)
+
+def test_schedule_extraction_order_and_start_folding():
+    text = _fixture("good.hlo")
+    sched = synclint.extract_schedule(text)
+    assert [e.kind for e in sched] == [
+        "all-reduce", "reduce-scatter", "collective-permute", "all-gather"]
+    assert [e.channel_id for e in sched] == [1, 2, 3, 4]
+    assert sched[0].groups == [[0, 1, 2, 3]]          # iota synthesized
+    assert sched[1].groups == [[0, 1], [2, 3]]        # explicit braces
+    assert sched[2].groups == [[0, 1], [1, 2], [2, 3], [3, 0]]  # pairs
+
+
+def test_async_pairs_counted_once():
+    text = """\
+HloModule async
+ENTRY %main (p0: f32[64]) -> f32[64] {
+  %p0 = f32[64]{0} parameter(0)
+  %ar-start = f32[64]{0} all-reduce-start(f32[64]{0} %p0), channel_id=1, replica_groups=[1,4]<=[4], to_apply=%add
+  ROOT %ar-done = f32[64]{0} all-reduce-done(f32[64]{0} %ar-start)
+}
+"""
+    sched = synclint.extract_schedule(text)
+    assert len(sched) == 1 and sched[0].kind == "all-reduce"
+
+
+def test_digest_stable_and_rename_insensitive():
+    text = _fixture("good.hlo")
+    d1 = synclint.schedule_digest(synclint.extract_schedule(text))
+    d2 = synclint.schedule_digest(synclint.extract_schedule(text))
+    assert d1 == d2 and len(d1) == 64
+    # instruction names are compiler-churn, not schedule identity
+    renamed = text.replace("%ar ", "%ar.42 ").replace("%ag ", "%ag.7 ")
+    d3 = synclint.schedule_digest(synclint.extract_schedule(renamed))
+    assert d3 == d1
+    # but a changed replica grouping IS schedule identity
+    regrouped = text.replace("replica_groups={{0,1},{2,3}}",
+                             "replica_groups={{0,2},{1,3}}")
+    d4 = synclint.schedule_digest(synclint.extract_schedule(regrouped))
+    assert d4 != d1
+
+
+def test_good_fixture_congruent():
+    assert synclint.verify_congruence(
+        _fixture("good.hlo"), "good", n_devices=4) == []
+
+
+@pytest.mark.parametrize("fname,needle", [
+    ("bad_dup.hlo", "more than one replica group"),
+    ("bad_oob.hlo", "out of range"),
+    ("bad_sizes.hlo", "mismatched sizes"),
+    ("bad_missing.hlo", "participate in no replica group"),
+    ("bad_permute.hlo", "not a permutation"),
+])
+def test_planted_incongruence_fires(fname, needle):
+    findings = synclint.verify_congruence(_fixture(fname), fname,
+                                          n_devices=4)
+    assert findings, f"{fname} must fire"
+    assert all(f.kind == "collective-incongruence" and f.severity == "error"
+               for f in findings)
+    assert any(needle in f.message for f in findings), findings
+
+
+def test_unknown_mesh_size_skips_range_and_coverage_checks():
+    # without n_devices, out-of-range/coverage can't be judged — but
+    # duplicates still can
+    assert synclint.verify_congruence(_fixture("bad_oob.hlo"), "x") == []
+    assert synclint.verify_congruence(_fixture("bad_dup.hlo"), "x") != []
+
+
+def test_sync_report_and_digest_diff():
+    rep = synclint.sync_report("s", _fixture("good.hlo"), {"data": 4})
+    assert rep.sync_digest and not rep.findings
+    # unpinned -> warn; matching pin -> clean; drifted pin -> error
+    warn = synclint.diff_digest(rep, None)
+    assert [f.severity for f in warn] == ["warn"]
+    assert synclint.diff_digest(rep, {"sync_digest": rep.sync_digest}) == []
+    drift = synclint.diff_digest(rep, {"sync_digest": "f" * 64})
+    assert [f.kind for f in drift] == ["sync-digest-drift"]
+    assert drift[0].severity == "error"
+    assert "audit the reorder" in drift[0].message
+
+
+def test_digest_rides_baseline_entry_and_full_diff():
+    rep = synclint.sync_report("s", _fixture("good.hlo"), {"data": 4})
+    entry = baseline_entry(rep)
+    assert entry["sync_digest"] == rep.sync_digest
+    assert diff_against_baseline(rep, entry) == []
+    entry["sync_digest"] = "f" * 64
+    drifted = [f for f in diff_against_baseline(rep, entry)
+               if f.kind == "sync-digest-drift"]
+    assert len(drifted) == 1 and drifted[0].severity == "error"
+    # a report without a digest (pre-synclint sweep) never emits the key
+    bare = StepReport(name="bare", mesh_shape={"data": 4})
+    assert "sync_digest" not in baseline_entry(bare)
+
+
+# --------------------------------------------- host desync pass (layer 2)
+
+def test_planted_fixture_fires_at_documented_lines():
+    findings = astlint.lint_desync_source(
+        _fixture("desync_planted.py"), path="p.py", hot_functions=("T.fit",))
+    assert sorted(f.where for f in findings) == ["p.py:16", "p.py:19"]
+    assert all(f.kind == "collective-desync" and f.severity == "error"
+               for f in findings)
+    by_line = {f.where: f.message for f in findings}
+    assert "rank-dependent branch at p.py:15" in by_line["p.py:16"]
+    assert "save_checkpoint()" in by_line["p.py:16"]
+    assert "locally-data-dependent branch at p.py:18" in by_line["p.py:19"]
+    assert "rollback()" in by_line["p.py:19"]  # inter-procedural via psum
+
+
+def test_agreement_and_allow_markers_statement_scope():
+    assert astlint.lint_desync_source(
+        _fixture("agreement_ok.py"), path="a.py",
+        hot_functions=("T.fit",)) == []
+
+
+def test_in_module_planted_fixture():
+    findings = synclint.planted_desync_findings()
+    assert len(findings) == 2
+    msgs = " ".join(f.message for f in findings)
+    assert "rank-dependent" in msgs and "locally-data-dependent" in msgs
+
+
+def test_agreement_marker_on_branch_line():
+    src = (
+        "def fit(self):\n"
+        "    if jax.process_index() == 0:  # synclint: agreement\n"
+        "        self.save_checkpoint()\n")
+    assert astlint.lint_desync_source(src, "m.py",
+                                      hot_functions=("fit",)) == []
+    # without the marker the same branch fires
+    bare = src.replace("  # synclint: agreement", "")
+    fired = astlint.lint_desync_source(bare, "m.py", hot_functions=("fit",))
+    assert [f.where for f in fired] == ["m.py:3"]
+
+
+def test_agreement_marker_as_assignment_taint_sink():
+    src = (
+        "def fit(self):\n"
+        "    flag = self.guard.drain()  # synclint: agreement\n"
+        "    if flag:\n"
+        "        self.save_checkpoint()\n")
+    assert astlint.lint_desync_source(src, "m.py",
+                                      hot_functions=("fit",)) == []
+    bare = src.replace("  # synclint: agreement", "")
+    fired = astlint.lint_desync_source(bare, "m.py", hot_functions=("fit",))
+    assert [f.where for f in fired] == ["m.py:4"]
+    assert "locally-data-dependent" in fired[0].message
+
+
+def test_allow_marker_suppresses_single_call():
+    src = (
+        "def fit(self):\n"
+        "    if jax.process_index() == 0:\n"
+        "        self.save_checkpoint()  # synclint: allow\n"
+        "        self.step_fn()\n")
+    fired = astlint.lint_desync_source(src, "m.py", hot_functions=("fit",))
+    # only the unsuppressed sibling call fires
+    assert [f.where for f in fired] == ["m.py:4"]
+
+
+def test_function_scope_blessing():
+    src = (
+        "def fit(self):  # synclint: agreement\n"
+        "    if jax.process_index() == 0:\n"
+        "        self.save_checkpoint()\n")
+    assert astlint.lint_desync_source(src, "m.py",
+                                      hot_functions=("fit",)) == []
+
+
+def test_rank_vs_local_taint_classification():
+    src = (
+        "def fit(self):\n"
+        "    r = jax.process_index()\n"
+        "    t = time.monotonic()\n"
+        "    if r == 0:\n"
+        "        self.step_fn()\n"
+        "    if t > 5.0:\n"
+        "        self.step_fn()\n")
+    fired = astlint.lint_desync_source(src, "m.py", hot_functions=("fit",))
+    assert len(fired) == 2
+    by_line = {f.where: f.message for f in fired}
+    assert "rank-dependent" in by_line["m.py:5"]
+    assert "locally-data-dependent" in by_line["m.py:7"]
+
+
+def test_rank_taint_dominates_local():
+    src = (
+        "def fit(self):\n"
+        "    x = time.monotonic()\n"
+        "    x = jax.process_index()\n"
+        "    if x:\n"
+        "        self.step_fn()\n")
+    fired = astlint.lint_desync_source(src, "m.py", hot_functions=("fit",))
+    assert len(fired) == 1 and "rank-dependent" in fired[0].message
+
+
+def test_interprocedural_collective_propagation():
+    src = (
+        "def helper(state):\n"
+        "    return inner(state)\n"
+        "def inner(state):\n"
+        "    return psum(state, 'data')\n"
+        "def fit(self):\n"
+        "    if os.getenv('RANK') == '0':\n"
+        "        helper(1)\n")
+    issuing = astlint.collective_functions(
+        __import__("ast").parse(src), astlint.COLLECTIVE_CALLS)
+    assert {"helper", "inner"} <= issuing
+    fired = astlint.lint_desync_source(src, "m.py", hot_functions=("fit",))
+    assert [f.where for f in fired] == ["m.py:7"]
+    assert "helper()" in fired[0].message
+
+
+def test_untainted_branches_are_free():
+    src = (
+        "def fit(self, steps):\n"
+        "    for i in range(steps):\n"
+        "        if i % 2 == 0:\n"
+        "            self.step_fn()\n")
+    assert astlint.lint_desync_source(src, "m.py",
+                                      hot_functions=("fit",)) == []
+
+
+def test_missing_hot_function_raises():
+    with pytest.raises(ValueError, match="SYNC_SCOPES"):
+        astlint.lint_desync_source("def g():\n    pass\n", "m.py",
+                                   hot_functions=("fit",))
+
+
+def test_real_hot_scopes_currently_clean():
+    """The repo's own agreement idioms (preemption agreement, in-step
+    all-reduced divergence drain, coordinator-committed membership
+    epochs) are anchored; the registered scopes must lint clean."""
+    report = synclint.lint_sync_scopes()
+    assert report.findings == [], report.findings
+
+
+def test_sync_scope_registry_names_resolve():
+    """Renaming a registered function must fail loudly, not silently
+    skip the scope (ValueError carries the registry pointer)."""
+    import pytorch_distributed_tpu as pkg
+
+    base = os.path.dirname(os.path.abspath(pkg.__file__))
+    for rel, _functions in synclint.SYNC_SCOPES:
+        assert os.path.exists(os.path.join(base, rel)), rel
+
+
+# ------------------------------------------ protocol explorer (layer 3)
+
+def test_shipped_protocols_verify_desync_free():
+    findings = syncproto.check_protocols()
+    assert len(findings) == len(syncproto.MODELS)
+    assert all(f.severity == "info" and f.kind == "protocol-desync"
+               for f in findings)
+    assert all("verified desync-free" in f.message for f in findings)
+
+
+def test_planted_variants_all_desync():
+    findings = syncproto.planted_counterexamples()
+    assert len(findings) == len(syncproto.MODELS)
+    assert all(f.severity == "error" for f in findings)
+    assert all("local-variant" in f.where for f in findings)
+
+
+def test_elastic_shrink_counterexample_names_the_collective():
+    """The acceptance-criterion story: a locally-decided shrink leaves
+    one rank entering the re-mesh gather while its peer has already
+    moved on — the explorer must name both sides."""
+    cex = syncproto.explore(syncproto.elastic_model(agreed=False))
+    assert cex is not None
+    msg = str(cex)
+    assert "remesh_gather" in msg
+    assert "rank0" in msg and "rank1" in msg
+    assert cex.blame_var == "shrink"
+
+
+def test_preempt_counterexample_is_the_pr13_hang():
+    cex = syncproto.explore(syncproto.preempt_model(agreed=False))
+    assert cex is not None
+    # one rank stops (END), the other waits in grad_allreduce forever
+    assert "END" in str(cex) and "grad_allreduce" in str(cex)
+
+
+def test_agreed_models_have_no_counterexample():
+    for key, (builder, _desc) in syncproto.MODELS.items():
+        assert syncproto.explore(builder(agreed=True)) is None, key
+
+
+def test_explorer_is_deterministic():
+    a = syncproto.explore(syncproto.elastic_model(agreed=False))
+    b = syncproto.explore(syncproto.elastic_model(agreed=False))
+    assert str(a) == str(b)
+
+
+# ------------------------------------------------------ CLI + composition
+
+def test_sweep_cached_jax_free(tmp_path):
+    """The --hlo-cache path: congruence off persisted artifacts, no jax."""
+    cache = tmp_path / "hlo"
+    cache.mkdir()
+    (cache / "step_a.hlo").write_text(_fixture("good.hlo"))
+    (cache / "step_a.json").write_text(json.dumps(
+        {"mesh_shape": {"data": 4}, "measured_peak_bytes": 0,
+         "arg_classes": {}}))
+    (cache / "step_bad.hlo").write_text(_fixture("bad_dup.hlo"))
+    (cache / "step_bad.json").write_text(json.dumps(
+        {"mesh_shape": {"data": 4}, "measured_peak_bytes": 0,
+         "arg_classes": {}}))
+    reports = synclint.sweep_cached(str(cache))
+    by_name = {r.name: r for r in reports}
+    assert set(by_name) == {"step_a", "step_bad"}
+    assert by_name["step_a"].findings == []
+    assert by_name["step_a"].sync_digest
+    assert [f.kind for f in by_name["step_bad"].findings] == [
+        "collective-incongruence"]
+
+
+def test_checked_in_baseline_has_digests_for_all_mesh_steps():
+    """Every mesh'd recipe's baseline entry carries a pinned digest (the
+    live sweep fence below verifies the values)."""
+    baseline = load_baseline(os.path.join(
+        REPO, "pytorch_distributed_tpu", "analysis", "baseline.json"))
+    missing = [name for name, entry in baseline.items()
+               if not entry.get("sync_digest")]
+    assert missing == [], f"steps without a pinned digest: {missing}"
+    assert len(baseline) >= 18
+
+
+@pytest.mark.slow
+def test_cli_selftest_subprocess():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "synclint.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "synclint selftest OK" in r.stdout
+
+
+def test_annotation_adds_zero_compiles_and_digests_match_baseline(
+        get_lowering):
+    """The tentpole fence: with the recipe sweep warm, annotating every
+    mesh'd report with its collective-schedule digest + congruence
+    verdict adds ZERO compiles, every schedule verifies congruent, and
+    every digest matches the checked-in pin."""
+    from pytorch_distributed_tpu.analysis import core
+
+    for name in core.RECIPES:
+        get_lowering(name)
+    before = get_lowering.compile_count()
+
+    reports = synclint.sweep()
+    assert get_lowering.compile_count() == before, (
+        "synclint.sweep must ride the shared lowering cache")
+    assert len(reports) >= 18
+    baseline = load_baseline(os.path.join(
+        REPO, "pytorch_distributed_tpu", "analysis", "baseline.json"))
+    for r in reports:
+        assert r.findings == [], (r.name, r.findings)
+        assert r.sync_digest, r.name
+        entry = baseline.get(r.name)
+        assert entry is not None, f"{r.name} missing from baseline"
+        assert entry.get("sync_digest") == r.sync_digest, (
+            f"{r.name}: digest drifted vs baseline — audit the schedule "
+            "change, then scripts/synclint.py --update-baseline")
+
+    # the shardlint composition path: annotate in place, still 0 compiles
+    sweep_reports = core.analyze_all()
+    synclint.annotate_reports(sweep_reports)
+    assert get_lowering.compile_count() == before
+    annotated = [r for r in sweep_reports
+                 if r.name in core.RECIPES and r.mesh_shape]
+    assert annotated and all(r.sync_digest for r in annotated)
