@@ -23,6 +23,9 @@ Rule kinds (anchors in parentheses):
   liveness policy, not two;
 - ``hang``            the collective-hang watchdog's ``hang`` ft_event
   (obs/flightrec.py);
+- ``replica_down``    the fleet router's ``replica_down`` ft_event — a
+  serving replica failed its health probe and was quarantined
+  (serving/router.py ``ReplicaRegistry``); fires once per replica;
 - ``recompile``       post-warmup recompile ft_events beyond
   ``max_events`` (obs/watchdog.py);
 - ``bench_stale``     days since the last good benchmark capture beyond
@@ -80,6 +83,7 @@ _RULE_SPECS: Dict[str, tuple] = {
     "dead_rank": (set(), {"max_age_s"}),
     "slow_rank": (set(), {"max_step_lag", "slow_ema_factor", "max_age_s"}),
     "hang": (set(), set()),
+    "replica_down": (set(), set()),
     "recompile": (set(), {"max_events"}),
     "bench_stale": ({"max_days"}, {"lkg_path", "events_path"}),
     "ttft_p99": ({"max_ms"}, set()),
@@ -397,6 +401,16 @@ class AlertEngine:
                 fired += self._fire(rule, key=rule.name, detail=detail,
                                     step=rec.get("step"),
                                     value=rec.get("elapsed_s"))
+        elif kind == "replica_down":
+            for rule in self._by_kind.get("replica_down", ()):
+                rid = rec.get("replica")
+                reason = rec.get("reason")
+                detail = (f"serving replica {rid} quarantined"
+                          + (f" ({reason})" if reason else ""))
+                fired += self._fire(rule, key=(rule.name, rid),
+                                    detail=detail,
+                                    rank=rid if isinstance(rid, int)
+                                    else None)
         elif kind == "recompile":
             n = self._event_counts[kind]
             for rule in self._by_kind.get("recompile", ()):

@@ -72,6 +72,34 @@ _SERVING_FIELDS = {
 _SKIP_FIELDS = ({"step", "t", "process", "epoch"} | set(_STAT_FIELDS)
                 | set(_SERVING_FIELDS))
 
+# fleet-router gauge names (serving/router.py render_fleet_metrics /
+# scripts/obs_live.py fleet block).  The router renders these itself —
+# this tuple pins the contract so scrapers and the exposition can't
+# drift apart silently (asserted in the export selftest family).
+FLEET_GAUGES = (
+    "ptd_fleet_up",
+    "ptd_fleet_inflight",
+    "ptd_fleet_requests_total",
+    "ptd_fleet_completed_total",
+    "ptd_fleet_failed_total",
+    "ptd_fleet_retries_total",
+    "ptd_fleet_hedges_total",
+    "ptd_fleet_hedges_won_total",
+    "ptd_fleet_hedges_lost_total",
+    "ptd_fleet_duplicates_suppressed_total",
+    "ptd_fleet_replica_down_total",
+    "ptd_fleet_last_scale",
+    "ptd_fleet_replicas",
+    "ptd_fleet_quarantined",
+    "ptd_fleet_replica_state",
+    "ptd_fleet_replica_queue_depth",
+    "ptd_fleet_replica_kv_occupancy_pct",
+    "ptd_fleet_replica_ttft_p99_ms",
+    "ptd_fleet_replica_beat_age_seconds",
+    "ptd_fleet_replica_dispatched_total",
+    "ptd_fleet_replica_completed_total",
+)
+
 
 def _heartbeat_mod():
     """The sibling heartbeat module, without importing the top-level

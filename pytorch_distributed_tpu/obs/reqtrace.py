@@ -443,6 +443,70 @@ def attribution_summary(trs: Sequence[Dict[str, Any]]
     return out
 
 
+def fleet_trace_records(records: Sequence[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Filter a parsed metrics-JSONL stream down to the fleet router's
+    per-request ``fleettrace`` events (serving/router.py)."""
+    return [r for r in records if r.get("ft_event") == "fleettrace"]
+
+
+def fleet_reconciliation(fleet_trs: Sequence[Dict[str, Any]],
+                         engine_trs: Sequence[Dict[str, Any]] = ()
+                         ) -> Optional[Dict[str, Any]]:
+    """Reconcile the router's latency attribution (ISSUE 19).
+
+    Two exactness contracts, both checked per request:
+
+    1. Decomposition: ``router_ttft_ms == router_wait_ms +
+       redispatch_ms + hedge_wait_ms + engine_ttft_ms`` — the router
+       books these so the identity holds by construction; any drift
+       means double-counted or lost wall clock.
+    2. Engine echo: when the same JSONL also holds the replicas'
+       ``reqtrace`` events, the ``engine_ttft_ms`` the router echoed
+       must match the engine's own ``ttft_ms`` for that rid — the
+       router is reporting the engine's truth, not its own estimate.
+
+    Returns None when there are no fleet traces (routerless runs)."""
+    fleet_trs = list(fleet_trs)
+    if not fleet_trs:
+        return None
+    decomp = []
+    for t in fleet_trs:
+        lhs = float(t.get("router_ttft_ms", 0.0))
+        rhs = (float(t.get("router_wait_ms", 0.0))
+               + float(t.get("redispatch_ms", 0.0))
+               + float(t.get("hedge_wait_ms", 0.0))
+               + float(t.get("engine_ttft_ms", 0.0)))
+        decomp.append(abs(lhs - rhs))
+    by_rid: Dict[Any, List[Dict[str, Any]]] = {}
+    for r in engine_trs:
+        by_rid.setdefault(r.get("rid"), []).append(r)
+    matched = 0
+    echo = []
+    for t in fleet_trs:
+        cands = by_rid.get(t.get("rid"))
+        if not cands:
+            continue
+        matched += 1
+        echo.append(min(abs(float(t.get("engine_ttft_ms", 0.0))
+                            - float(c.get("ttft_ms", 0.0)))
+                        for c in cands))
+    waits = sorted(float(t.get("router_wait_ms", 0.0)) for t in fleet_trs)
+    return {
+        "requests": len(fleet_trs),
+        "retried": sum(1 for t in fleet_trs
+                       if int(t.get("attempts", 1)) > 1),
+        "hedged": sum(1 for t in fleet_trs if t.get("hedged")),
+        "decomp_err_ms_max": max(decomp),
+        "engine_matched": matched,
+        "engine_echo_err_ms_max": max(echo) if echo else None,
+        "router_wait_p99_ms": _percentile(waits, 0.99),
+        "router_ttft_p99_ms": _percentile(
+            sorted(float(t.get("router_ttft_ms", 0.0))
+                   for t in fleet_trs), 0.99),
+    }
+
+
 def format_tail_line(tail: Dict[str, Any]) -> str:
     """'p99 TTFT 812.4ms = 61% queue_wait, 24% preempt_redo, …'"""
     shares = tail["shares_pct"]

@@ -1,9 +1,13 @@
-"""Continuous-batching LM serving (ISSUE 15).
+"""Continuous-batching LM serving (ISSUE 15) and the fleet (ISSUE 19).
 
 The serving twin of the training stack: a paged KV cache (kvpool),
 admission/preemption scheduling (scheduler), the jitted step loop
 (engine), and a seeded synthetic load harness (loadgen), fronted by
-``scripts/serve_lm.py``.  Import submodules directly — this package
-stays import-time light so host-side pieces (scheduler, loadgen) load
-without jax.
+``scripts/serve_lm.py``.  On top of one engine sits the fleet plane
+(``scripts/serve_fleet.py``): per-replica HTTP servers with rid-replay
+caches (replica), and the health-checked request router with
+retry/hedging/backoff, graceful drain, an exactly-once completion
+ledger, and the elastic scale arbiter (router).  Import submodules
+directly — this package stays import-time light so host-side pieces
+(scheduler, loadgen, router, replica) load without jax.
 """

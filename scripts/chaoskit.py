@@ -32,8 +32,16 @@ Commands:
   demands BOTH detectors catch it: synclint's host desync pass + protocol
   model check statically (pre-launch), and — because a rank that diverges
   away from a collective looks exactly like a stalled rank to its peers —
-  the hang watchdog / flight recorder / postmortem live.  The only
-  commands that build a mesh (jax imported lazily inside them).
+  the hang watchdog / flight recorder / postmortem live; ``replica-kill``
+  (ISSUE 19) SIGKILLs a serving replica mid-decode behind the fleet
+  router and passes iff every admitted request completes exactly once
+  with tokens bit-exact vs an unkilled baseline, ttft_p99 holds, and the
+  ``replica_down`` ft_event + alert land in the router JSONL;
+  ``router-restart`` (ISSUE 19) SIGKILLs the router itself mid-run,
+  restarts it, and passes iff client replays complete exactly once —
+  the replicas' idempotent rid caches (or deterministic recompute)
+  absorb the lost ledger.  Mesh drills import jax lazily inside them;
+  the fleet drills never touch jax at all (subprocess sim replicas).
   Every drill kind shares the ``--seed`` contract: the injection step
   comes from ``drill_plan(seed, steps)``, so the same seed reproduces
   the same schedule across kinds and runs;
@@ -112,6 +120,12 @@ def drill_plan(seed: int, steps: int):
 def cmd_drill(args) -> int:
     """End-to-end elastic drill on the tiny synthetic LM (the only
     chaoskit command that touches devices; jax imported here, lazily)."""
+    # fleet drills run on subprocess sim replicas — no mesh, no devices;
+    # dispatch before the jax/trainer imports below.
+    if args.kind == "replica-kill":
+        return _drill_replica_kill(args)
+    if args.kind == "router-restart":
+        return _drill_router_restart(args)
     import jax
 
     from pytorch_distributed_tpu.ft import (
@@ -790,6 +804,382 @@ def _drill_trace(args) -> int:
     return 0
 
 
+def _fleet_boot_replica(out: str, tag: str, rid: int, seed: int,
+                        itl_ms: float = 6.0):
+    """Boot one jax-free sim replica subprocess; returns (proc, url)."""
+    import subprocess
+    import time as _time
+
+    scripts = os.path.dirname(os.path.abspath(__file__))
+    pf = os.path.join(out, f"{tag}-replica{rid}.port")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(scripts, "serve_fleet.py"), "replica",
+         "--replica-id", str(rid), "--port-file", pf, "--seed", str(seed),
+         "--sim-itl-ms", str(itl_ms), "--sim-prefill-ms", "0.5",
+         "--max-batch", "2", "--hb-dir", os.path.join(out, f"hb-{tag}")],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    t_end = _time.monotonic() + 20.0
+    while _time.monotonic() < t_end and not os.path.exists(pf):
+        _time.sleep(0.02)
+    if not os.path.exists(pf):
+        proc.kill()
+        raise RuntimeError(f"replica {rid} never wrote its port file")
+    with open(pf) as f:
+        return proc, f"http://127.0.0.1:{int(f.read().strip())}"
+
+
+def _fleet_report_needles(jsonl: str, needles) -> bool:
+    """Run obs_report over the router JSONL and check the fold landed."""
+    import subprocess
+
+    rep = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "obs_report.py"), "--metrics-jsonl", jsonl],
+        capture_output=True, text=True)
+    ok = True
+    for needle in needles:
+        if needle not in rep.stdout:
+            print(f"FAIL: obs_report did not fold {needle!r} "
+                  f"(rc {rep.returncode})")
+            ok = False
+    return ok
+
+
+def _drill_replica_kill(args) -> int:
+    """ISSUE 19: SIGKILL a replica mid-decode under live load.
+
+    Two subprocess sim replicas behind an in-process fleet router; the
+    seeded plan picks the completion count after which replica 1 dies
+    (while it provably has requests in flight).  Passes iff every
+    admitted request completes exactly once with tokens bit-exact vs an
+    unkilled baseline run, ttft_p99 holds inside a 3x+250 ms ceiling,
+    the router books the ``replica_down`` ft_event + alert, and
+    ``obs_report`` folds the ``== fleet ==`` section from the JSONL.
+    """
+    import json as _json
+    import random as _random
+    import signal as _sig
+    import tempfile
+    import threading
+    import time as _time
+
+    from pytorch_distributed_tpu.obs import alerts as _alerts
+    from pytorch_distributed_tpu.obs.metrics import (
+        MetricsLogger,
+        read_metrics,
+    )
+    from pytorch_distributed_tpu.serving import router as _router
+
+    out = args.out or tempfile.mkdtemp(prefix="ptd-drill-fleet-")
+    os.makedirs(out, exist_ok=True)
+    n_req = max(args.steps, 8)
+    kill_after, _ = drill_plan(args.seed, n_req)
+    rng = _random.Random(args.seed)
+    prompts = [[rng.randrange(64) for _ in range(8)] for _ in range(n_req)]
+
+    def run(tag: str, kill_victim: bool):
+        procs, urls = {}, {}
+        for rid in (0, 1):
+            procs[rid], urls[rid] = _fleet_boot_replica(
+                out, tag, rid, args.seed)
+        jsonl = os.path.join(out, f"router-{tag}.jsonl")
+        obs = MetricsLogger(jsonl, process_index=-2, flush_every=1)
+        engine = _alerts.AlertEngine(
+            [_alerts.Rule(kind="replica_down", name="replica_down",
+                          severity="page", params={})],
+            emit=lambda **f: obs.log_event("alert", **f), process_index=-2)
+        registry = _router.ReplicaRegistry(
+            urls, hb_dir=os.path.join(out, f"hb-{tag}"),
+            backoff_initial_s=0.05, probe_timeout=1.0)
+        rt = _router.FleetRouter(
+            registry,
+            _router.RouterPolicy(deadline_s=30.0, max_retries=3,
+                                 retry_backoff_s=0.01, seed=args.seed),
+            obs=obs, alert_engine=engine)
+        registry.probe()
+        results = [None] * n_req
+        lock = threading.Lock()
+
+        def fire(i: int):
+            _time.sleep(i * 0.004)
+            code, res = rt.submit({"rid": i, "prompt": prompts[i],
+                                   "max_new_tokens": 8})
+            with lock:
+                results[i] = (code, res)
+
+        killed = {"t": None}
+
+        def killer():
+            victim = registry.replicas[1]
+            t_end = _time.monotonic() + 20.0
+            while _time.monotonic() < t_end:
+                done = rt.stats.as_dict()["requests_completed"]
+                if done >= n_req:
+                    return  # run finished before the plan's kill point
+                if done >= kill_after and victim.inflight > 0:
+                    break
+                _time.sleep(0.002)
+            procs[1].send_signal(_sig.SIGKILL)
+            killed["t"] = _time.monotonic()
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(n_req)]
+        kt = threading.Thread(target=killer) if kill_victim else None
+        t0 = _time.monotonic()
+        for t in threads:
+            t.start()
+        if kt is not None:
+            kt.start()
+        for t in threads:
+            t.join(timeout=60.0)
+        if kt is not None:
+            kt.join(timeout=30.0)
+        wall = _time.monotonic() - t0
+        rt.log_cycle(wall)
+        obs.close()
+        for p in procs.values():
+            p.kill()
+            p.wait(timeout=5.0)
+        ttfts = sorted(r[1]["router_ttft_ms"] for r in results
+                       if r and r[0] == 200 and r[1].get("ok"))
+        p99 = (ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))]
+               if ttfts else None)
+        return {"results": results, "stats": rt.stats.as_dict(),
+                "ledger": len(rt.ledger), "ttft_p99_ms": p99,
+                "jsonl": jsonl, "killed_at": killed["t"]}
+
+    print(f"drill replica-kill: {n_req} requests over 2 replicas, SIGKILL "
+          f"replica 1 after completion #{kill_after} (seed {args.seed})")
+    base = run("base", kill_victim=False)
+    kill = run("kill", kill_victim=True)
+
+    ok = True
+    for tag, r in (("base", base), ("kill", kill)):
+        lost = [i for i, res in enumerate(r["results"])
+                if not (res and res[0] == 200 and res[1].get("ok"))]
+        if lost:
+            print(f"FAIL: {tag} run lost request(s) {lost}")
+            ok = False
+        if r["ledger"] != n_req:
+            print(f"FAIL: {tag} ledger holds {r['ledger']} completions, "
+                  f"want {n_req}")
+            ok = False
+        if r["stats"]["duplicates_suppressed"] != 0:
+            print(f"FAIL: {tag} run double-completed "
+                  f"{r['stats']['duplicates_suppressed']} request(s)")
+            ok = False
+    if kill["killed_at"] is None:
+        print("FAIL: the killer never fired — the fault was not injected")
+        ok = False
+    if kill["stats"]["retries"] < 1:
+        print("FAIL: no redispatch despite a killed replica")
+        ok = False
+    if kill["stats"]["replica_down_events"] < 1:
+        print("FAIL: router never saw the UP -> QUARANTINED transition")
+        ok = False
+    if ok:
+        for i in range(n_req):
+            if base["results"][i][1]["tokens"] != kill["results"][i][1]["tokens"]:
+                print(f"FAIL: rid {i} tokens diverge after redispatch")
+                ok = False
+                break
+    if base["ttft_p99_ms"] and kill["ttft_p99_ms"]:
+        ceiling = base["ttft_p99_ms"] * 3.0 + 250.0
+        if kill["ttft_p99_ms"] > ceiling:
+            print(f"FAIL: ttft_p99 {kill['ttft_p99_ms']:.1f} ms blew the "
+                  f"ceiling {ceiling:.1f} ms (baseline "
+                  f"{base['ttft_p99_ms']:.1f} ms)")
+            ok = False
+    recs = read_metrics(kill["jsonl"])
+    if "replica_down" not in {r.get("ft_event") for r in recs}:
+        print("FAIL: no replica_down ft_event in the router JSONL")
+        ok = False
+    if not [r for r in recs if r.get("ft_event") == "alert"
+            and r.get("rule") == "replica_down"]:
+        print("FAIL: no replica_down alert booked")
+        ok = False
+    if not _fleet_report_needles(kill["jsonl"],
+                                 ("== fleet ==", "replica_down")):
+        ok = False
+    if not ok:
+        return 1
+    print(_json.dumps(
+        {"requests": n_req, "kill_after": kill_after,
+         "base_ttft_p99_ms": round(base["ttft_p99_ms"], 2),
+         "kill_ttft_p99_ms": round(kill["ttft_p99_ms"], 2),
+         "retries": kill["stats"]["retries"],
+         "replica_down_events": kill["stats"]["replica_down_events"],
+         "lost": 0, "double_completed": 0}, sort_keys=True))
+    print("drill replica-kill: zero lost, zero double-completed, tokens "
+          "bit-exact across the redispatch")
+    print("drill replica-kill: OK")
+    return 0
+
+
+def _drill_router_restart(args) -> int:
+    """ISSUE 19 variant: SIGKILL the *router* mid-run.
+
+    Clients retry against a restarted router process; the restarted
+    router has an empty ledger, so re-dispatched rids hit the replicas'
+    idempotent rid caches (or recompute deterministically).  Passes iff
+    every client receives exactly one successful completion with the
+    expected tokens bit-exact, and ``obs_report`` folds the fleet
+    section from the shared (append-mode) router JSONL.
+    """
+    import itertools
+    import json as _json
+    import random as _random
+    import signal as _sig
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+
+    from pytorch_distributed_tpu.serving import replica as _replica
+    from pytorch_distributed_tpu.serving import router as _router
+
+    out = args.out or tempfile.mkdtemp(prefix="ptd-drill-fleet-")
+    os.makedirs(out, exist_ok=True)
+    n_req = max(args.steps, 8)
+    kill_after, _ = drill_plan(args.seed, n_req)
+    scripts = os.path.dirname(os.path.abspath(__file__))
+    procs, urls = {}, {}
+    for rid in (0, 1):
+        procs[rid], urls[rid] = _fleet_boot_replica(out, "rr", rid,
+                                                    args.seed)
+    jsonl = os.path.join(out, "router-rr.jsonl")
+    counter = itertools.count()
+
+    def boot_router():
+        i = next(counter)
+        pf = os.path.join(out, f"router{i}.port")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(scripts, "serve_fleet.py"),
+             "router", "--replicas", f"0={urls[0]},1={urls[1]}",
+             "--port-file", pf, "--metrics-jsonl", jsonl,
+             "--retry-backoff-ms", "10", "--deadline-s", "30",
+             "--probe-interval", "0.2", "--quarantine-backoff-ms", "50",
+             "--hb-dir", os.path.join(out, "hb-rr")],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        t_end = _time.monotonic() + 20.0
+        while _time.monotonic() < t_end and not os.path.exists(pf):
+            _time.sleep(0.02)
+        if not os.path.exists(pf):
+            proc.kill()
+            raise RuntimeError("router never wrote its port file")
+        with open(pf) as f:
+            return proc, f"http://127.0.0.1:{int(f.read().strip())}"
+
+    rproc, rurl = boot_router()
+    holder = {"url": rurl, "proc": rproc}
+    rng = _random.Random(args.seed)
+    prompts = [[rng.randrange(64) for _ in range(8)] for _ in range(n_req)]
+    expected = [_replica.sim_tokens(p, 8, 64, args.seed) for p in prompts]
+    successes = [0] * n_req
+    tokens_out = [None] * n_req
+    lock = threading.Lock()
+
+    def client(i: int):
+        _time.sleep(i * 0.004)
+        t_end = _time.monotonic() + 45.0
+        while _time.monotonic() < t_end:
+            url = holder["url"]
+            try:
+                res = _router.http_json(
+                    "POST", url + "/generate",
+                    {"rid": i, "prompt": prompts[i], "max_new_tokens": 8},
+                    30.0)
+            except _router.TRANSPORT_ERRORS:
+                _time.sleep(0.05)  # router down: wait for the restart
+                continue
+            if res.get("ok"):
+                with lock:
+                    successes[i] += 1
+                    tokens_out[i] = res["tokens"]
+                return
+            _time.sleep(0.05)
+
+    killed = {"t": None}
+
+    def killer():
+        t_end = _time.monotonic() + 20.0
+        while _time.monotonic() < t_end:
+            try:
+                stats = _router.http_json(
+                    "GET", holder["url"] + "/stats", None, 1.0)
+                done = stats["stats"]["requests_completed"]
+            except _router.TRANSPORT_ERRORS:
+                done = 0
+            if done >= kill_after:
+                break
+            _time.sleep(0.01)
+        holder["proc"].send_signal(_sig.SIGKILL)
+        killed["t"] = _time.monotonic()
+        nproc, nurl = boot_router()
+        holder.update(url=nurl, proc=nproc)
+
+    print(f"drill router-restart: {n_req} requests, SIGKILL the router "
+          f"after completion #{kill_after}, restart, clients replay "
+          f"(seed {args.seed})")
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_req)]
+    kt = threading.Thread(target=killer)
+    for t in threads:
+        t.start()
+    kt.start()
+    for t in threads:
+        t.join(timeout=90.0)
+    kt.join(timeout=60.0)
+
+    ok = True
+    if killed["t"] is None:
+        print("FAIL: the router was never killed")
+        ok = False
+    lost = [i for i in range(n_req) if successes[i] != 1]
+    if lost:
+        print(f"FAIL: request(s) {lost} did not complete exactly once "
+              f"(counts {[successes[i] for i in lost]})")
+        ok = False
+    for i in range(n_req):
+        if tokens_out[i] is not None and tokens_out[i] != expected[i]:
+            print(f"FAIL: rid {i} tokens diverge across the restart")
+            ok = False
+            break
+    computed = cache_hits = 0
+    for rid in (0, 1):
+        try:
+            s = _router.http_json("GET", urls[rid] + "/stats", None, 2.0)
+            computed += int(s["computed"])
+            cache_hits += int(s["cache_hits"])
+        except _router.TRANSPORT_ERRORS:
+            print(f"FAIL: replica {rid} unreachable post-drill")
+            ok = False
+    if computed < n_req:
+        print(f"FAIL: replicas computed {computed} < {n_req} requests")
+        ok = False
+    if not _fleet_report_needles(jsonl, ("== fleet ==",)):
+        ok = False
+    try:
+        holder["proc"].kill()
+    except OSError:
+        pass
+    for p in procs.values():
+        p.kill()
+        p.wait(timeout=5.0)
+    if not ok:
+        return 1
+    print(_json.dumps(
+        {"requests": n_req, "kill_after": kill_after,
+         "computed": computed, "replay_cache_hits": cache_hits,
+         "recompute_duplicates": computed - n_req,
+         "lost": 0, "double_completed": 0}, sort_keys=True))
+    print("drill router-restart: every request completed exactly once "
+          "across the crash, tokens bit-exact")
+    print("drill router-restart: OK")
+    return 0
+
+
 def _selftest() -> int:
     """No-mesh FT fast path: every assertion here runs in well under a
     second with zero jax involvement."""
@@ -934,7 +1324,8 @@ def main(argv=None) -> int:
                        help="run an end-to-end elastic membership drill")
     d.add_argument("kind",
                    choices=("shrink", "grow", "hang", "alert", "serve",
-                            "trace", "desync"),
+                            "trace", "desync", "replica-kill",
+                            "router-restart"),
                    help="shrink: lose a rank and continue; grow: lose "
                         "then re-admit it; hang: stall a rank inside a "
                         "collective and let the watchdog catch it; "
@@ -947,7 +1338,13 @@ def main(argv=None) -> int:
                         "the preempt_redo alert live; desync: a planted "
                         "rank-divergent branch must be caught statically "
                         "by synclint AND live by the hang watchdog + "
-                        "flight recorder")
+                        "flight recorder; replica-kill: SIGKILL a serving "
+                        "replica mid-decode — every in-flight request "
+                        "must complete exactly once via redispatch, "
+                        "bit-exact vs an unkilled run; router-restart: "
+                        "SIGKILL the fleet router itself — client "
+                        "replays against the restarted router must land "
+                        "exactly once via the replicas' rid caches")
     d.add_argument("--world", type=int, default=4,
                    help="starting data-parallel world size")
     d.add_argument("--steps", type=int, default=12)

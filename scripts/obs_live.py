@@ -133,6 +133,32 @@ def pseudo_record(samples, rank: int):
     return rec if "step_time" in rec else None
 
 
+def fleet_from_samples(samples):
+    """Parse a fleet-router exposition (``ptd_fleet_*`` gauges,
+    serving/router.py ``render_fleet_metrics``) into a dashboard dict;
+    None when the endpoint is not a router."""
+    if export.sample_value(samples, "ptd_fleet_up") is None:
+        return None
+    out = {"replicas": {}, "counters": {}, "last_scale": None}
+    for name, lab, v in samples:
+        if name == "ptd_fleet_replica_state":
+            if v == 1.0:
+                out["replicas"].setdefault(
+                    lab.get("replica", "?"), {})["state"] = lab.get(
+                        "state", "?")
+        elif name.startswith("ptd_fleet_replica_") and "replica" in lab:
+            # label-less ptd_fleet_replica_down_total is a fleet counter,
+            # not a per-replica gauge — it falls through to the branch below
+            field = name[len("ptd_fleet_replica_"):]
+            out["replicas"].setdefault(
+                lab["replica"], {})[field] = float(v)
+        elif name == "ptd_fleet_last_scale":
+            out["last_scale"] = lab.get("decision")
+        elif name.startswith("ptd_fleet_"):
+            out["counters"][name[len("ptd_fleet_"):]] = float(v)
+    return out
+
+
 def scraped_rank(samples):
     """The rank an exposition claims via ``ptd_up{rank=...}``."""
     for name, lab, _v in samples:
@@ -163,6 +189,7 @@ class FleetMonitor:
             emit=self._book, process_index=-1)
         self.rows = {}        # rank -> dashboard row dict
         self.remote_firing = []   # scraped ptd_alert_firing samples
+        self.fleet = None     # fleet-router exposition, when scraped
         self.cycles = 0
 
     def _book(self, **fields) -> None:
@@ -182,6 +209,7 @@ class FleetMonitor:
         self.cycles += 1
         fired = []
         self.remote_firing = []
+        self.fleet = None
         seen = set()
         for i, url in enumerate(self.urls):
             try:
@@ -189,6 +217,12 @@ class FleetMonitor:
             except Exception as e:
                 self.rows[f"?{i}"] = {"rank": None, "url": url,
                                       "state": "DOWN", "error": str(e)}
+                continue
+            fl = fleet_from_samples(samples)
+            if fl is not None:
+                # a router endpoint: feed the fleet block, not a rank row
+                self.fleet = fl
+                self.rows.pop(f"?{i}", None)
                 continue
             rank = scraped_rank(samples)
             rank = i if rank is None else rank
@@ -236,8 +270,16 @@ class FleetMonitor:
         self.beats = beats
         return fired
 
+    def quarantined_replicas(self) -> int:
+        """Quarantined count off the router's own gauge — ``--once``
+        exits 1 on any quarantined replica even with zero alert rules."""
+        if self.fleet is None:
+            return 0
+        return int(self.fleet["counters"].get("quarantined", 0.0))
+
     def any_firing(self) -> bool:
-        return bool(self.engine.active() or self.remote_firing)
+        return bool(self.engine.active() or self.remote_firing
+                    or self.quarantined_replicas())
 
     # ----------------------------------------------------------- rendering
     def dashboard(self, now=None) -> str:
@@ -287,6 +329,36 @@ class FleetMonitor:
                     f"preempt-redo p99 "
                     f"{_fmt(r.get('redo_p99_ms'), '.1f')}ms;  "
                     f"traces {_fmt(r.get('traces'), '.0f')}")
+        if self.fleet is not None:
+            c = self.fleet["counters"]
+
+            def ct(name):
+                return f"{c.get(name, 0.0):.0f}"
+
+            lines.append("-- fleet (router) --")
+            lines.append(
+                f"  routed {ct('requests_total')}  completed "
+                f"{ct('completed_total')}  failed {ct('failed_total')}  "
+                f"retries {ct('retries_total')}  hedges "
+                f"{ct('hedges_total')} (won {ct('hedges_won_total')} / "
+                f"lost {ct('hedges_lost_total')})  last scale "
+                f"{self.fleet['last_scale'] or 'none'}")
+            lines.append(f"  {'replica':>7}  {'state':<11}  {'queue':>5}  "
+                         f"{'kv%':>5}  {'ttft_p99':>9}  {'beat-age':>8}  "
+                         f"{'dispatched':>10}  {'completed':>9}")
+            for rid in sorted(self.fleet["replicas"], key=str):
+                r = self.fleet["replicas"][rid]
+                lines.append(
+                    f"  {rid:>7}  {r.get('state', '?'):<11}  "
+                    f"{_fmt(r.get('queue_depth'), '.0f'):>5}  "
+                    f"{_fmt(r.get('kv_occupancy_pct'), '.1f'):>5}  "
+                    f"{_fmt(r.get('ttft_p99_ms'), '.1f'):>7}ms  "
+                    f"{_fmt(r.get('beat_age_seconds'), '.1f'):>7}s  "
+                    f"{_fmt(r.get('dispatched_total'), '.0f'):>10}  "
+                    f"{_fmt(r.get('completed_total'), '.0f'):>9}")
+            nq = self.quarantined_replicas()
+            if nq:
+                lines.append(f"  {nq} replica(s) QUARANTINED")
         active = self.engine.active()
         if active:
             lines.append("-- alerts firing (aggregator) --")
@@ -479,6 +551,83 @@ def _selftest() -> int:
             "http://10.0.0.5:9100/metrics",
             "http://127.0.0.1:9200/metrics",
             "http://127.0.0.1:9201/metrics"]
+
+        # 7. Fleet router block (ISSUE 19): a ptd_fleet_* exposition is
+        #    recognized as a router (not a rank row), the dashboard grows
+        #    the replica table, and one quarantined replica flips --once
+        #    to exit 1 even with zero alert rules firing.
+        import http.server
+        import threading
+
+        fleet_text = "\n".join([
+            "ptd_fleet_up 1", "ptd_fleet_inflight 2",
+            "ptd_fleet_requests_total 30",
+            "ptd_fleet_completed_total 28", "ptd_fleet_failed_total 0",
+            "ptd_fleet_retries_total 3", "ptd_fleet_hedges_total 4",
+            "ptd_fleet_hedges_won_total 3",
+            "ptd_fleet_hedges_lost_total 1",
+            "ptd_fleet_duplicates_suppressed_total 0",
+            "ptd_fleet_replica_down_total 1",
+            'ptd_fleet_last_scale{decision="up:replica2"} 1',
+            "ptd_fleet_replicas 2", "ptd_fleet_quarantined 1",
+            'ptd_fleet_replica_state{replica="0",state="UP"} 1',
+            'ptd_fleet_replica_queue_depth{replica="0"} 2',
+            'ptd_fleet_replica_kv_occupancy_pct{replica="0"} 50',
+            'ptd_fleet_replica_ttft_p99_ms{replica="0"} 88.5',
+            'ptd_fleet_replica_beat_age_seconds{replica="0"} 0.4',
+            'ptd_fleet_replica_dispatched_total{replica="0"} 20',
+            'ptd_fleet_replica_completed_total{replica="0"} 18',
+            'ptd_fleet_replica_state{replica="1",state="QUARANTINED"} 1',
+            'ptd_fleet_replica_dispatched_total{replica="1"} 10',
+            'ptd_fleet_replica_completed_total{replica="1"} 10',
+        ]) + "\n"
+
+        class _FleetHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = fleet_text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                              _FleetHandler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            mon_f = FleetMonitor(
+                [f"http://127.0.0.1:{srv.server_port}/metrics"], rules=[])
+            assert mon_f.cycle() == []
+            assert mon_f.fleet is not None, "router exposition missed"
+            assert not mon_f.rows, \
+                "a router endpoint must not masquerade as a rank row"
+            assert sorted(mon_f.fleet["replicas"]) == ["0", "1"], \
+                "label-less replica_down_total must not fabricate a row"
+            assert mon_f.fleet["counters"]["replica_down_total"] == 1.0
+            assert mon_f.quarantined_replicas() == 1
+            assert mon_f.any_firing(), \
+                "--once must exit 1 on a quarantined replica"
+            dash_f = mon_f.dashboard()
+            for needle in ("-- fleet (router) --",
+                           "routed 30  completed 28",
+                           "retries 3  hedges 4 (won 3 / lost 1)",
+                           "last scale up:replica2",
+                           "QUARANTINED", "88.5ms",
+                           "1 replica(s) QUARANTINED"):
+                assert needle in dash_f, \
+                    f"fleet dashboard missing {needle!r}\n{dash_f}"
+            # healthy fleet: same shape, nothing quarantined -> exit 0
+            fleet_text = fleet_text.replace(
+                "ptd_fleet_quarantined 1", "ptd_fleet_quarantined 0")
+            mon_ok = FleetMonitor(
+                [f"http://127.0.0.1:{srv.server_port}/metrics"], rules=[])
+            mon_ok.cycle()
+            assert not mon_ok.any_firing()
+        finally:
+            srv.shutdown()
 
     assert "jax" not in sys.modules
     print("obs_live selftest: OK")

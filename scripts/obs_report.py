@@ -702,6 +702,133 @@ def summarize_traces(records: List[dict]) -> List[str]:
     return lines
 
 
+_FLEET_COUNTERS = ("requests_routed", "requests_completed",
+                   "requests_failed", "retries", "hedges", "hedges_won",
+                   "hedges_lost", "duplicates_suppressed",
+                   "replica_down_events", "drain_events",
+                   "scale_up_events", "scale_down_events")
+
+_FLEET_EVENT_KINDS = ("replica_down", "replica_evict", "scale_up",
+                      "scale_down", "drain")
+
+
+def fleet_stats(records: List[dict]) -> Optional[Dict]:
+    """Scalar summary of the fleet router plane (serving/router.py,
+    ISSUE 19): the ``fleet``-stamped cycle records carry the cumulative
+    counters, the per-request ``fleettrace`` ft_events carry router-side
+    latency attribution, and the ``replica_down`` / scale / drain
+    ft_events carry the membership churn.  None when the run had no
+    router (single-replica serving and training runs are untouched)."""
+    steps = [r for r in records
+             if r.get("fleet") and "ft_event" not in r
+             and "bench_event" not in r]
+    traces = [r for r in records if r.get("ft_event") == "fleettrace"]
+    churn = [r for r in records
+             if r.get("ft_event") in _FLEET_EVENT_KINDS]
+    if not steps and not traces and not churn:
+        return None
+
+    def last(field):
+        # counters are cumulative over the run — the last cycle record
+        # stamped IS the run summary
+        for r in reversed(steps):
+            v = r.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return float(v)
+        return None
+
+    out: Dict = {"cycles": float(len(steps))}
+    for f in ("replicas_up", "replicas_quarantined", "replicas_total",
+              "retry_rate_pct", "hedge_win_rate_pct"):
+        out[f] = last(f)
+    for f in _FLEET_COUNTERS:
+        out[f] = last("fleet_" + f)
+    out["traced_requests"] = float(len(traces))
+    per: Dict[str, float] = {}
+    for t in traces:
+        k = str(t.get("replica"))
+        per[k] = per.get(k, 0.0) + 1.0
+    out["requests_by_replica"] = per
+    if traces:
+        def q99(field):
+            vals = sorted(float(t.get(field, 0.0)) for t in traces)
+            return _pct(vals, .99)
+
+        out["router_ttft_p50_ms"] = _pct(
+            sorted(float(t.get("router_ttft_ms", 0.0)) for t in traces), .5)
+        out["router_ttft_p99_ms"] = q99("router_ttft_ms")
+        out["router_wait_p99_ms"] = q99("router_wait_ms")
+        out["redispatch_p99_ms"] = q99("redispatch_ms")
+        out["hedge_wait_p99_ms"] = q99("hedge_wait_ms")
+        out["engine_ttft_p99_ms"] = q99("engine_ttft_ms")
+        out["retried_requests"] = float(
+            sum(1 for t in traces if t.get("attempts", 1) > 1))
+        out["hedged_requests"] = float(
+            sum(1 for t in traces if t.get("hedged")))
+    out["events"] = [
+        {"kind": r.get("ft_event"), "replica": r.get("replica"),
+         "reason": r.get("reason") or r.get("scope")}
+        for r in churn]
+    return out
+
+
+def summarize_fleet(records: List[dict]) -> List[str]:
+    """The ``== fleet ==`` fold (ISSUE 19): per-replica request counts,
+    retries, hedges won/lost, drain/scale events, and the router-side
+    tail attribution (router-wait vs redispatch vs engine)."""
+    s = fleet_stats(records)
+    if s is None:
+        return []
+
+    def fmt(v, unit=""):
+        return "--" if v is None else f"{v:.1f}{unit}"
+
+    def cnt(field):
+        v = s.get(field)
+        return "--" if v is None else f"{v:.0f}"
+
+    lines = [
+        "== fleet ==",
+        f"  {s['cycles']:.0f} router cycle(s); replicas "
+        f"{cnt('replicas_up')} up / {cnt('replicas_quarantined')} "
+        f"quarantined / {cnt('replicas_total')} total",
+        f"  routed {cnt('requests_routed')}; completed "
+        f"{cnt('requests_completed')}; failed {cnt('requests_failed')}; "
+        f"duplicates suppressed {cnt('duplicates_suppressed')}",
+        f"  retries {cnt('retries')} (retry_rate "
+        f"{fmt(s['retry_rate_pct'], '%')});  hedges {cnt('hedges')} "
+        f"(won {cnt('hedges_won')} / lost {cnt('hedges_lost')}, win_rate "
+        f"{fmt(s['hedge_win_rate_pct'], '%')})",
+        f"  replica_down {cnt('replica_down_events')};  drain "
+        f"{cnt('drain_events')};  scale up/down "
+        f"{cnt('scale_up_events')}/{cnt('scale_down_events')}",
+    ]
+    if s["requests_by_replica"]:
+        lines.append("  requests by replica: " + ", ".join(
+            f"replica{k}×{v:.0f}"
+            for k, v in sorted(s["requests_by_replica"].items())))
+    if s.get("router_ttft_p99_ms") is not None:
+        lines.append(
+            f"  router TTFT p50/p99  "
+            f"{fmt(s['router_ttft_p50_ms'], 'ms')} / "
+            f"{fmt(s['router_ttft_p99_ms'], 'ms')};  "
+            f"{s['traced_requests']:.0f} fleet trace(s), "
+            f"{cnt('retried_requests')} retried, "
+            f"{cnt('hedged_requests')} hedged")
+        lines.append(
+            f"  tail attribution p99: router_wait "
+            f"{fmt(s['router_wait_p99_ms'], 'ms')}, redispatch "
+            f"{fmt(s['redispatch_p99_ms'], 'ms')}, hedge_wait "
+            f"{fmt(s['hedge_wait_p99_ms'], 'ms')}, engine "
+            f"{fmt(s['engine_ttft_p99_ms'], 'ms')}")
+    for e in s["events"]:
+        what = f"  [{e['kind']}] replica={e['replica']}"
+        if e.get("reason"):
+            what += f" ({e['reason']})"
+        lines.append(what)
+    return lines
+
+
 _SYNC_KINDS = ("collective-incongruence", "sync-digest-drift",
                "collective-desync", "protocol-desync")
 
@@ -786,6 +913,7 @@ def report(args) -> str:
         sections += summarize_bench(records, bench_staleness_info(args))
         sections += summarize_serving(records)
         sections += summarize_traces(records)
+        sections += summarize_fleet(records)
     else:
         if getattr(args, "comm_ledger", None):
             sections += summarize_comms([], args.comm_ledger,
@@ -861,6 +989,9 @@ def report_json(args) -> Dict:
         trc = trace_stats(records)
         if trc is not None:
             out["traces"] = trc
+        flt = fleet_stats(records)
+        if flt is not None:
+            out["fleet"] = flt
     staleness = bench_staleness_info(args)
     if staleness is not None:
         out["bench_staleness"] = staleness
@@ -923,6 +1054,7 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
     cs = comm_stats(records)
     srv = serving_stats(records)
     trc = trace_stats(records)
+    flt = fleet_stats(records)
 
     def attr(field):
         # prefer the step-record stamp (windowed, what the run saw live);
@@ -952,6 +1084,10 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         # per-request attribution fences (--req-trace runs only)
         "queue_wait_share_p99": attr("queue_wait_share_p99"),
         "preempt_redo_ms_p99": attr("preempt_redo_ms_p99"),
+        # fleet router fences (serving/router.py) — None without a
+        # router, so single-replica and training diffs are untouched
+        "retry_rate": flt["retry_rate_pct"] if flt else None,
+        "hedge_win_rate": flt["hedge_win_rate_pct"] if flt else None,
     }
 
 
@@ -990,6 +1126,13 @@ _DIFF_METRICS = (
     # preemption storm behind the zero-baseline guard.
     ("queue_wait_share_p99", True, True),
     ("preempt_redo_ms_p99", True, True),
+    # fleet router fences (serving/router.py): both absolute percentage
+    # points — retry_rate climbing means replicas are flapping under the
+    # candidate; hedge_win_rate falling means the hedge delay stopped
+    # tracking the real p95 (hedges fire but never win).  A clean
+    # baseline books 0% retries, so relative rows would divide by zero.
+    ("retry_rate", True, True),
+    ("hedge_win_rate", False, True),
 )
 
 
@@ -1728,6 +1871,90 @@ def _selftest() -> int:
         assert rc_t == 1, (
             "selftest: planted preemption storm must exit 1")
         assert "preempt_redo_ms_p99" in buf_t.getvalue(), buf_t.getvalue()
+
+        # ---- fleet plane (ISSUE 19): section, json twin, diff rows ----
+        def write_fleet(path, retries, hedges_won):
+            with MetricsLogger(path, flush_every=50) as log:
+                for i in range(12):
+                    rep = i % 2
+                    log.log_event(
+                        "fleettrace", rid=i,
+                        trace_id=f"ptd-fleet-{i:08x}", replica=rep,
+                        attempts=2 if i < retries else 1, hedged=0,
+                        router_wait_ms=1.0,
+                        redispatch_ms=30.0 if i < retries else 0.0,
+                        hedge_wait_ms=0.0, engine_ttft_ms=40.0,
+                        engine_e2e_ms=60.0,
+                        router_ttft_ms=(71.0 if i < retries else 41.0),
+                        router_e2e_ms=91.0 if i < retries else 61.0)
+                log.log_event("replica_down", replica=1,
+                              reason="healthz: connection refused")
+                log.log_event("scale_up", replica=2,
+                              reason="ttft_p99 91.0% of SLO")
+                log.log_event("drain", scope="router", inflight=0)
+                log.log_step(1, step_time=1.0, extra={
+                    "fleet": 1.0, "replicas_up": 2.0,
+                    "replicas_quarantined": 1.0, "replicas_total": 3.0,
+                    "fleet_requests_routed": 12.0,
+                    "fleet_requests_completed": 12.0,
+                    "fleet_requests_failed": 0.0,
+                    "fleet_retries": float(retries),
+                    "fleet_hedges": 4.0,
+                    "fleet_hedges_won": float(hedges_won),
+                    "fleet_hedges_lost": 4.0 - hedges_won,
+                    "fleet_duplicates_suppressed": 0.0,
+                    "fleet_replica_down_events": 1.0,
+                    "fleet_drain_events": 1.0,
+                    "fleet_scale_up_events": 1.0,
+                    "fleet_scale_down_events": 0.0,
+                    "retry_rate_pct": 100.0 * retries / 12.0,
+                    "hedge_win_rate_pct": 100.0 * hedges_won / 4.0})
+
+        fpath = os.path.join(d, "fleet.jsonl")
+        write_fleet(fpath, retries=2, hedges_won=3)
+        ns_fl = argparse.Namespace(
+            metrics_jsonl=fpath, hb_dir=None, telemetry_csv=None, now=now,
+            max_step_lag=3, max_beat_age=60.0, bench_lkg=None,
+            bench_events=None, bench_max_stale_days=14.0, plan=None,
+            flight_dir=None)
+        fl_out = report(ns_fl)
+        for needle in ("== fleet ==", "2 up / 1 quarantined / 3 total",
+                       "routed 12; completed 12",
+                       "retries 2 (retry_rate 16.7%)",
+                       "won 3 / lost 1, win_rate 75.0%",
+                       "replica_down 1;  drain 1;  scale up/down 1/0",
+                       "requests by replica: replica0×6, replica1×6",
+                       "tail attribution p99: router_wait",
+                       "[replica_down] replica=1 (healthz: connection "
+                       "refused)",
+                       "[scale_up] replica=2"):
+            assert needle in fl_out, (
+                f"selftest: {needle!r} missing from:\n{fl_out}")
+        js_fl = report_json(ns_fl)
+        assert js_fl["fleet"]["retries"] == 2.0, js_fl["fleet"]
+        assert js_fl["fleet"]["requests_by_replica"] == {
+            "0": 6.0, "1": 6.0}, js_fl["fleet"]
+        assert js_fl["fleet"]["router_ttft_p99_ms"] == 71.0, js_fl["fleet"]
+        assert js_fl["steps"]["retry_rate"] == 100.0 * 2 / 12, js_fl
+        json.dumps(js_fl)
+        # routerless runs must not grow the section or the diff rows
+        assert "== fleet ==" not in srv_out, srv_out
+        assert by_srv["retry_rate"]["verdict"] == "missing", ds
+        # planted replica flapping: retry_rate climbs 16.7pp and the
+        # hedge win rate collapses -> both new rows (and only they)
+        # REGRESS
+        fbad = os.path.join(d, "fleet_flap.jsonl")
+        write_fleet(fbad, retries=4, hedges_won=0)
+        fa_recs, _ = load_metrics(fpath)
+        fb_recs, _ = load_metrics(fbad)
+        dfl = diff_data(fa_recs, fb_recs)
+        by_fl = {r["metric"]: r for r in dfl["metrics"]}
+        assert by_fl["retry_rate"]["verdict"] == "REGRESS", dfl
+        assert by_fl["hedge_win_rate"]["verdict"] == "REGRESS", dfl
+        by_rfl = {r["metric"]: r
+                  for r in diff_data(fb_recs, fa_recs)["metrics"]}
+        assert by_rfl["retry_rate"]["verdict"] == "PASS", by_rfl
+        assert by_rfl["hedge_win_rate"]["verdict"] == "PASS", by_rfl
 
         # ---- --flight-dir: the postmortem fold (ISSUE 13) ----
         pm = _postmortem_mod()

@@ -75,6 +75,41 @@ def analyze(path: str, slo_ms=None):
     return trs, reqtrace.attribution_summary(trs)
 
 
+def analyze_fleet(path: str):
+    """Fleet-router reconciliation (ISSUE 19): the per-request
+    ``fleettrace`` events checked against the decomposition identity and
+    — when the same JSONL holds the replicas' ``reqtrace`` events — the
+    engine's own TTFT.  None for routerless runs."""
+    records = metrics.read_metrics(path)
+    ftrs = reqtrace.fleet_trace_records(records)
+    return ftrs, reqtrace.fleet_reconciliation(
+        ftrs, reqtrace.trace_records(records))
+
+
+def render_fleet(frec) -> str:
+    lines = ["== fleet routing =="]
+    if frec is None:
+        return ""
+    lines.append(
+        f"requests {frec['requests']}  retried {frec['retried']}  "
+        f"hedged {frec['hedged']}  router ttft p99 "
+        f"{frec['router_ttft_p99_ms']:.1f}ms  router wait p99 "
+        f"{frec['router_wait_p99_ms']:.1f}ms")
+    lines.append(
+        f"decomposition err max {frec['decomp_err_ms_max']:.4f}ms "
+        "(router_ttft == router_wait + redispatch + hedge_wait "
+        "+ engine_ttft)")
+    if frec["engine_matched"]:
+        lines.append(
+            f"engine echo: {frec['engine_matched']} request(s) matched "
+            f"to reqtrace; err max "
+            f"{frec['engine_echo_err_ms_max']:.4f}ms")
+    else:
+        lines.append("engine echo: no matching reqtrace events in this "
+                     "JSONL (replicas log to their own files)")
+    return "\n".join(lines)
+
+
 def render(summ, trs, slo_ms=None) -> str:
     lines = ["== request traces =="]
     if summ is None:
@@ -152,6 +187,53 @@ def _selftest() -> int:
     assert ctx.to_wire() == json.loads(trs[0]["ctx"])
     assert ctx.hops and ctx.hops[0].startswith("engine"), ctx.hops
 
+    # fleet reconciliation (ISSUE 19): one JSONL holding both the
+    # router's fleettrace events and the replica's reqtrace events —
+    # the decomposition identity and the engine-TTFT echo must both
+    # reconcile exactly, and the section must render
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        fpath = os.path.join(d, "fleet.jsonl")
+        with metrics.MetricsLogger(fpath, process_index=-2) as log:
+            for i in range(4):
+                retried = i == 3
+                engine_ttft = 40.0 + i
+                log.log_event(
+                    "reqtrace", rid=i, trace_id=f"ptd-fleet-{i:08x}",
+                    ttft_ms=engine_ttft, e2e_ms=engine_ttft + 20.0,
+                    queue_wait_ms=5.0, prefill_ms=30.0,
+                    redo_wait_ms=0.0, defrag_wait_ms=0.0,
+                    other_wait_ms=engine_ttft - 35.0, tokens=8,
+                    preemptions=0, violated=0, n_spans=4,
+                    spans_dropped=0, sampled=1)
+                log.log_event(
+                    "fleettrace", rid=i, trace_id=f"ptd-fleet-{i:08x}",
+                    replica=i % 2, attempts=2 if retried else 1,
+                    hedged=0, router_wait_ms=1.25,
+                    redispatch_ms=30.0 if retried else 0.0,
+                    hedge_wait_ms=0.0, engine_ttft_ms=engine_ttft,
+                    engine_e2e_ms=engine_ttft + 20.0,
+                    router_ttft_ms=(1.25 + (30.0 if retried else 0.0)
+                                    + engine_ttft),
+                    router_e2e_ms=(1.25 + (30.0 if retried else 0.0)
+                                   + engine_ttft + 20.0))
+        ftrs, frec = analyze_fleet(fpath)
+        assert frec is not None and frec["requests"] == 4, frec
+        assert frec["retried"] == 1 and frec["hedged"] == 0, frec
+        assert frec["decomp_err_ms_max"] < 1e-9, frec
+        assert frec["engine_matched"] == 4, frec
+        assert frec["engine_echo_err_ms_max"] < 1e-9, frec
+        fout = render_fleet(frec)
+        for needle in ("== fleet routing ==", "requests 4  retried 1",
+                       "decomposition err max 0.0000ms",
+                       "engine echo: 4 request(s) matched"):
+            assert needle in fout, f"missing {needle!r} in:\n{fout}"
+        # a routerless JSONL keeps the section (and --json key) out
+        _t, none_rec = analyze_fleet(FIXTURE)
+        assert none_rec is None, none_rec
+        assert render_fleet(None) == ""
+
     assert "jax" not in sys.modules
     print("obs_trace selftest: OK")
     return 0
@@ -179,6 +261,7 @@ def main(argv=None) -> int:
     if not args.metrics_jsonl:
         ap.error("--metrics-jsonl is required (or --selftest)")
     trs, summ = analyze(args.metrics_jsonl, slo_ms=args.slo_ms)
+    _ftrs, frec = analyze_fleet(args.metrics_jsonl)
     if args.perfetto:
         trace = {"traceEvents": reqtrace.chrome_events(trs),
                  "displayTimeUnit": "ms"}
@@ -187,9 +270,14 @@ def main(argv=None) -> int:
         print(f"wrote {args.perfetto} "
               f"({len(trace['traceEvents'])} events)")
     if args.as_json:
-        print(json.dumps(summ, indent=2, sort_keys=True))
+        out = dict(summ) if summ else {}
+        if frec is not None:
+            out["fleet"] = frec
+        print(json.dumps(out, indent=2, sort_keys=True))
     else:
         print(render(summ, trs, slo_ms=args.slo_ms))
+        if frec is not None:
+            print(render_fleet(frec))
     return 0
 
 

@@ -1,0 +1,176 @@
+"""Resilient serving fleet (ISSUE 19): router, replicas, drills.
+
+Three planes:
+
+- subprocess selftests: ``serve_fleet.py --selftest`` is the heavy
+  in-process battery (registry probing, dispatch, retries, hedging,
+  drain, arbiter scale/evict, gauge round-trip) and must run with **no
+  jax in the process** — the fleet is a login-node/sidecar surface;
+- the chaoskit fleet drills: ``drill replica-kill`` (SIGKILL a replica
+  mid-decode; every request completes exactly once, bit-exact vs an
+  unkilled baseline) and ``drill router-restart`` (SIGKILL the router;
+  client replays land exactly once through the replicas' rid caches);
+- jax-free unit checks on the path-loaded router module: the
+  exactly-once ledger, the pure scale decision, deterministic sim
+  tokens — cheap guards that don't need sockets.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+
+
+def _load_serving(name, alias):
+    path = os.path.join(REPO, "pytorch_distributed_tpu", "serving",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(alias, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ selftests
+
+def test_serve_fleet_selftest_runs_clean_and_jax_free():
+    out = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "serve_fleet.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "serve_fleet selftest: OK" in out.stdout
+
+
+def test_router_module_imports_without_jax():
+    """The import-time hygiene fence: loading serving/router.py and
+    serving/replica.py by path must never drag jax into the process
+    (the same ``_sibling_module`` discipline as obs/alerts.py)."""
+    code = (
+        "import importlib.util, sys\n"
+        "for name in ('router', 'replica'):\n"
+        "    p = ('pytorch_distributed_tpu/serving/%s.py' % name)\n"
+        "    spec = importlib.util.spec_from_file_location(\n"
+        "        '_t_' + name, p)\n"
+        "    m = importlib.util.module_from_spec(spec)\n"
+        "    sys.modules['_t_' + name] = m\n"
+        "    spec.loader.exec_module(m)\n"
+        "assert 'jax' not in sys.modules, 'router import pulled in jax'\n"
+        "print('jax-free')\n")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "jax-free" in out.stdout
+
+
+# ---------------------------------------------------------- chaos drills
+
+def test_drill_replica_kill_zero_loss(tmp_path):
+    """The ISSUE-19 fence: SIGKILL a replica mid-decode — zero lost,
+    zero double-completed, tokens bit-exact vs the unkilled baseline,
+    replica_down ft_event + alert booked, obs_report folds the fleet
+    section."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "chaoskit.py"), "drill",
+         "replica-kill", "--steps", "12", "--seed", "3",
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "drill replica-kill: OK" in out.stdout
+    assert "zero lost, zero double-completed" in out.stdout
+
+
+@pytest.mark.slow
+def test_drill_router_restart_zero_loss(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "chaoskit.py"), "drill",
+         "router-restart", "--steps", "12", "--seed", "3",
+         "--out", str(tmp_path)],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "drill router-restart: OK" in out.stdout
+
+
+# ------------------------------------------------- jax-free unit checks
+
+def test_completion_ledger_is_exactly_once():
+    router = _load_serving("router", "_t_fleet_router")
+    led = router.CompletionLedger(max_entries=4)
+    assert led.book(1, {"tokens": [1, 2]})
+    assert not led.book(1, {"tokens": [9, 9]}), "second booking must lose"
+    assert led.get(1) == {"tokens": [1, 2]}, "first completion wins"
+    for rid in range(2, 7):
+        led.book(rid, {"tokens": [rid]})
+    assert led.get(1) is None, "LRU cap must evict the oldest"
+    assert led.get(6) == {"tokens": [6]}
+
+
+def test_decide_scale_directions():
+    router = _load_serving("router", "_t_fleet_router")
+
+    def row(rid, ttft, queue):
+        return {"rid": rid, "state": router.UP, "queue_depth": queue,
+                "kv_occupancy_pct": 10.0, "ttft_p99_ms": ttft,
+                "inflight": 0}
+
+    up, _, why = router.decide_scale(
+        [row(0, 480.0, 0.0)], slo_ttft_ms=500.0)
+    assert up == "up" and "ttft_p99" in why
+    down, victim, _ = router.decide_scale(
+        [row(0, 10.0, 0.0), row(1, 10.0, 0.0)], slo_ttft_ms=500.0)
+    assert down == "down" and victim in (0, 1)
+    hold, _, _ = router.decide_scale(
+        [row(0, 250.0, 1.0)], slo_ttft_ms=500.0)
+    assert hold is None
+    floor, _, _ = router.decide_scale(
+        [row(0, 10.0, 0.0)], slo_ttft_ms=500.0, min_replicas=1)
+    assert floor is None, "never scale below min_replicas"
+
+
+def test_sim_tokens_deterministic_across_replicas():
+    replica = _load_serving("replica", "_t_fleet_replica")
+    a = replica.sim_tokens([1, 2, 3], 8, 64, seed=7)
+    b = replica.sim_tokens([1, 2, 3], 8, 64, seed=7)
+    assert a == b and len(a) == 8
+    assert replica.sim_tokens([1, 2, 3], 8, 64, seed=8) != a
+    assert all(0 <= t < 64 for t in a)
+
+
+def test_fleet_reconciliation_contract():
+    """The obs_trace acceptance identity, checked at the library level:
+    router_ttft == router_wait + redispatch + hedge_wait + engine_ttft,
+    and the echoed engine TTFT matches the engine's own record."""
+    from pytorch_distributed_tpu.obs import reqtrace
+
+    fleet = [{"ft_event": "fleettrace", "rid": 0, "replica": 1,
+              "attempts": 2, "hedged": 0, "router_wait_ms": 1.5,
+              "redispatch_ms": 20.0, "hedge_wait_ms": 0.0,
+              "engine_ttft_ms": 40.0, "router_ttft_ms": 61.5}]
+    engine = [{"ft_event": "reqtrace", "rid": 0, "ttft_ms": 40.0}]
+    rec = reqtrace.fleet_reconciliation(fleet, engine)
+    assert rec["requests"] == 1 and rec["retried"] == 1
+    assert rec["decomp_err_ms_max"] < 1e-9
+    assert rec["engine_matched"] == 1
+    assert rec["engine_echo_err_ms_max"] < 1e-9
+    assert reqtrace.fleet_reconciliation([], engine) is None
+
+
+def test_bench_results_pin_scaling_fence():
+    """RESULTS_fleet.json (the checked-in artifact) pins the zero-loss
+    and ≥0.8x-linear scaling fences this PR claims."""
+    path = os.path.join(REPO, "RESULTS_fleet.json")
+    assert os.path.exists(path), "RESULTS_fleet.json missing"
+    with open(path) as f:
+        res = json.load(f)
+    bench = res["bench"]
+    assert bench["all_completed"] is True
+    assert bench["scaling_vs_linear"] >= 0.8
+    for drill in ("replica_kill", "router_restart"):
+        assert res[drill]["lost"] == 0
+        assert res[drill]["double_completed"] == 0

@@ -46,6 +46,10 @@ def _load_synclint():
     return _load_script("synclint.py", "synclint_flags")
 
 
+def _load_serve_fleet():
+    return _load_script("serve_fleet.py", "serve_fleet_flags")
+
+
 PARSERS = {
     # every image recipe (distributed, apex, horovod, slurm, dataparallel,
     # multiprocessing, tpu_native) shares the one canonical parser
@@ -54,6 +58,7 @@ PARSERS = {
     "recipes.lm_generate": lambda: lm_generate.build_parser(),
     "scripts.serve_lm": lambda: _load_serve_lm().build_parser(),
     "scripts.synclint": lambda: _load_synclint().build_parser(),
+    "scripts.serve_fleet": lambda: _load_serve_fleet().build_parser(),
 }
 
 
@@ -262,6 +267,83 @@ def test_chaoskit_drill_gains_the_desync_kind():
     # the shared contract: the same seed yields the same plan, across
     # every drill kind that derives its step from drill_plan
     assert ck.drill_plan(3, 16) == ck.drill_plan(3, 16)
+
+
+def test_chaoskit_drill_gains_the_fleet_kinds():
+    """ISSUE-19 satellite: ``replica-kill`` and ``router-restart`` are
+    real drill choices sharing the seeded ``drill_plan`` contract."""
+    ck = _load_script("chaoskit.py", "chaoskit_fleet_flags")
+
+    class _Exit(Exception):
+        pass
+
+    got = {}
+
+    def fake_drill(args):
+        got["args"] = args
+        raise _Exit()
+
+    orig = ck.cmd_drill
+    ck.cmd_drill = fake_drill
+    try:
+        for kind in ("replica-kill", "router-restart"):
+            with pytest.raises(_Exit):
+                ck.main(["drill", kind, "--seed", "5", "--steps", "12",
+                         "--out", "/tmp/x"])
+            parsed = got["args"]
+            assert (parsed.kind, parsed.seed, parsed.steps,
+                    parsed.out) == (kind, 5, 12, "/tmp/x")
+    finally:
+        ck.cmd_drill = orig
+    # the kill point comes from the same seeded plan every drill uses
+    assert ck.drill_plan(5, 12) == ck.drill_plan(5, 12)
+
+
+def test_fleet_flags_parse_to_their_own_dests():
+    """ISSUE-19 flags: every serve_fleet subcommand (replica, router,
+    arbiter, bench) lands its flags in their own dests with inert
+    defaults; the parametrized _lint tests above cover the collision
+    half for this parser."""
+    ap = _load_serve_fleet().build_parser()
+    args = ap.parse_args(
+        ["replica", "--replica-id", "3", "--port-file", "/tmp/p",
+         "--hb-dir", "/tmp/hb", "--seed", "7", "--sim-itl-ms", "4",
+         "--max-batch", "2", "--engine"])
+    assert (args.replica_id, args.port_file, args.hb_dir, args.seed,
+            args.sim_itl_ms, args.max_batch, args.engine) == (
+        3, "/tmp/p", "/tmp/hb", 7, 4.0, 2, True)
+    args = ap.parse_args(
+        ["router", "--replicas", "0=http://h:1,1=http://h:2",
+         "--deadline-s", "9", "--max-retries", "3",
+         "--retry-backoff-ms", "10", "--hedge",
+         "--hedge-quantile", "0.9", "--hedge-min-ms", "5",
+         "--quarantine-backoff-ms", "100",
+         "--quarantine-backoff-max-s", "8", "--max-beat-age", "30"])
+    assert (args.replicas, args.deadline_s, args.max_retries,
+            args.retry_backoff_ms, args.hedge, args.hedge_quantile,
+            args.hedge_min_ms, args.quarantine_backoff_ms,
+            args.quarantine_backoff_max_s, args.max_beat_age) == (
+        "0=http://h:1,1=http://h:2", 9.0, 3, 10.0, True, 0.9, 5.0,
+        100.0, 8.0, 30.0)
+    args = ap.parse_args(
+        ["arbiter", "--hb-dir", "/tmp/hb", "--slo-ttft-ms", "250",
+         "--min-replicas", "2", "--max-replicas", "4",
+         "--scale-up-pct", "80", "--scale-down-pct", "20", "--once",
+         "--spawn-cmd", "x {rid} {port_file}"])
+    assert (args.hb_dir, args.slo_ttft_ms, args.min_replicas,
+            args.max_replicas, args.scale_up_pct, args.scale_down_pct,
+            args.once, args.spawn_cmd) == (
+        "/tmp/hb", 250.0, 2, 4, 80.0, 20.0, True, "x {rid} {port_file}")
+    args = ap.parse_args(
+        ["bench", "--fleet-sizes", "1,2,4", "--requests", "32",
+         "--rate-rps", "200", "--min-scaling", "0.75",
+         "--out", "/tmp/r.json"])
+    assert (args.fleet_sizes, args.requests, args.rate_rps,
+            args.min_scaling, args.out) == (
+        "1,2,4", 32, 200.0, 0.75, "/tmp/r.json")
+    # defaults stay inert
+    args = ap.parse_args(["router"])
+    assert (args.hedge, args.max_retries, args.seed) == (False, 2, 0)
 
 
 def test_trace_and_checkpoint_flags_parse_to_their_own_dests():
