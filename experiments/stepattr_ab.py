@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Step-attribution acceptance sweep (ISSUE 20): identity + overhead.
+
+Three fences, all measured here and pinned in ``RESULTS_stepattr.json``:
+
+1. **Identity reconciliation** — on every swept recipe (LM data-parallel,
+   image GSPMD, image explicit-collectives), a ``--step-attr`` run's
+   per-step decomposition ``compute + exposed_comm + host_sync +
+   data_wait + other`` must reconcile to the measured ``step_time``
+   within **0.5% of the p50 step time** (``recon_err_pct_p50`` from
+   ``obs.stepattr.summarize`` — the recorder clamps the residual into
+   ``other >= 0`` and reports only the overshoot, so this is a real
+   closure check, not a tautology).
+2. **Hot-path overhead** — two identical LM runs, ``step_attr`` off vs
+   on, compared on the warm-steady step-time p50 (first 10 steps
+   dropped) AND through ``scripts/obs_report.py --diff`` at
+   ``--threshold-pct 2`` — the flight-recorder A/B methodology
+   (RESULTS_flightrec.json / RESULTS_obs_export.json).  The recorder is
+   four ``perf_counter`` windows and one dict build per step — the
+   delta must sit inside run-to-run noise (< 2%), and final losses must
+   be bit-identical (attribution is semantics-neutral).
+3. **Slow-loader drill** — ``scripts/chaoskit.py drill slow-loader``
+   must pass end to end: injected loader stall named ``data_wait``
+   dominant, ``data_wait_share`` alert live on /metrics, identity still
+   reconciling under chaos.
+
+CPU-safe:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    PYTHONPATH=/root/repo python experiments/stepattr_ab.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+from pytorch_distributed_tpu.obs import stepattr  # noqa: E402
+from pytorch_distributed_tpu.obs.metrics import read_metrics  # noqa: E402
+
+STEPS_AB = int(os.environ.get("SAB_STEPS", "200"))
+WARMUP = 10
+RECON_FENCE_PCT = 0.5
+OVERHEAD_FENCE_PCT = 2.0
+
+
+def _lm_run(path: str, steps: int, step_attr: bool,
+            hb_dir: str = None, big: bool = False) -> float:
+    """One LM fit; returns the final loss scalar.  ``big`` is the
+    RESULTS_obs_export.json A/B model (~180ms steps) — large enough that
+    a 2% p50 threshold measures overhead, not timer noise."""
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.lm import (
+        LMTrainer,
+        SyntheticTokenDataset,
+    )
+
+    mesh = build_mesh(MeshSpec(("data",), (2,)), jax.devices()[:2])
+    if big:
+        model = TransformerLM(vocab_size=256, d_model=128, n_heads=4,
+                              n_layers=2)
+        ds = SyntheticTokenDataset(4096, 128, 256, seed=0)
+        batch = 8
+    else:
+        model = TransformerLM(vocab_size=64, d_model=32, n_heads=2,
+                              n_layers=1)
+        ds = SyntheticTokenDataset(512, 16, 64, seed=0)
+        batch = 4
+    with mesh:
+        t = LMTrainer(model, mesh, ds, batch_size=batch, lr=0.05, seed=0,
+                      eval_dataset=None, metrics_jsonl=path,
+                      hb_dir=hb_dir, hb_interval_s=0.0,
+                      step_attr=step_attr)
+        t.fit(steps, print_freq=max(1, steps // 4))
+    losses = [r["loss"] for r in read_metrics(path)
+              if r.get("kind", "step") == "step" and "loss" in r]
+    return float(losses[-1])
+
+
+def _image_run(path: str, tmp: str, explicit: bool) -> None:
+    from pytorch_distributed_tpu.train.config import Config
+    from pytorch_distributed_tpu.train.trainer import Trainer
+
+    cfg = Config(arch="resnet18", batch_size=8, epochs=1, lr=0.1,
+                 print_freq=4, synthetic=True, synthetic_length=64,
+                 image_size=32, num_classes=4, seed=0,
+                 checkpoint_dir=tmp, workers=0, metrics_jsonl=path,
+                 step_attr=True)
+    Trainer(cfg, explicit_collectives=explicit).fit()
+
+
+def _identity(path: str) -> dict:
+    summ = stepattr.summarize(read_metrics(path))
+    assert summ is not None, path
+    return {
+        "steps": summ["steps"],
+        "step_ms_p50": round(summ["step_ms_p50"], 3),
+        "recon_err_pct_p50": round(summ["recon_err_pct_p50"], 4),
+        "recon_err_ms_max": round(summ["recon_err_ms_max"], 4),
+        "dominant": summ["dominant"],
+        "shares_pct": {k: round(v, 2)
+                       for k, v in summ["shares_pct"].items()},
+    }
+
+
+def _p50(path: str, warmup: int) -> float:
+    ts = [float(r["step_time"]) for r in read_metrics(path)
+          if r.get("kind", "step") == "step" and "step_time" in r]
+    ts = sorted(ts[warmup:])
+    return 1e3 * ts[len(ts) // 2]
+
+
+def main() -> int:
+    import tempfile
+
+    out = {"fence": {"recon_err_pct_p50_max": RECON_FENCE_PCT,
+                     "step_time_p50_delta_pct_max": OVERHEAD_FENCE_PCT}}
+    with tempfile.TemporaryDirectory(prefix="stepattr-ab-") as tmp:
+        # -- 1. identity closure per recipe ---------------------------
+        recipes = {}
+        lm_path = os.path.join(tmp, "lm_id.jsonl")
+        _lm_run(lm_path, 30, step_attr=True,
+                hb_dir=os.path.join(tmp, "hb"))
+        recipes["lm_dp2"] = _identity(lm_path)
+        for name, explicit in (("image_gspmd", False),
+                               ("image_explicit", True)):
+            p = os.path.join(tmp, f"{name}.jsonl")
+            _image_run(p, os.path.join(tmp, name + "_ck"), explicit)
+            recipes[name] = _identity(p)
+        out["identity"] = recipes
+        worst = max(r["recon_err_pct_p50"] for r in recipes.values())
+        out["identity"]["worst_recon_err_pct_p50"] = worst
+        print(f"=> identity: worst recon err {worst:.4f}% of step p50 "
+              f"(fence {RECON_FENCE_PCT}%)", flush=True)
+        assert worst <= RECON_FENCE_PCT, recipes
+
+        # -- 2. overhead A/B ------------------------------------------
+        off_p = os.path.join(tmp, "off.jsonl")
+        on_p = os.path.join(tmp, "on.jsonl")
+        loss_off = _lm_run(off_p, STEPS_AB, step_attr=False, big=True)
+        loss_on = _lm_run(on_p, STEPS_AB, step_attr=True, big=True)
+        p50_off, p50_on = _p50(off_p, WARMUP), _p50(on_p, WARMUP)
+        delta = 100.0 * (p50_on - p50_off) / p50_off
+        diff = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts/obs_report.py"),
+             "--diff", off_p, on_p, "--threshold-pct", "2"],
+            capture_output=True, text=True)
+        out["overhead"] = {
+            "steps": STEPS_AB,
+            "step_time_p50_off_ms": round(p50_off, 3),
+            "step_time_p50_on_ms": round(p50_on, 3),
+            "step_time_p50_delta_pct": round(delta, 2),
+            "final_loss_off": loss_off,
+            "final_loss_on": loss_on,
+            "loss_bit_identical": loss_off == loss_on,
+            "diff_verdict": ("PASS (exit 0)" if diff.returncode == 0
+                             else f"REGRESS (exit {diff.returncode})"),
+        }
+        print(f"=> overhead: p50 {p50_off:.2f} -> {p50_on:.2f}ms "
+              f"({delta:+.2f}%), loss identical: "
+              f"{loss_off == loss_on}", flush=True)
+        assert delta < OVERHEAD_FENCE_PCT, out["overhead"]
+        assert loss_off == loss_on, out["overhead"]
+        assert diff.returncode == 0, diff.stdout + diff.stderr
+
+    # -- 3. the drill (own subprocess: fresh backend, own mesh) -------
+    drill = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/chaoskit.py"),
+         "drill", "slow-loader", "--world", "2", "--steps", "12"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    tail = [ln for ln in drill.stdout.splitlines() if ln.strip()][-4:]
+    m = re.search(r"data-wait share p95 ([0-9.]+)%.*?"
+                  r"recon err ([0-9.]+)%", drill.stdout, re.S)
+    out["drill"] = {
+        "ok": drill.returncode == 0,
+        "data_wait_share_p95_pct": float(m.group(1)) if m else None,
+        "recon_err_pct_p50": float(m.group(2)) if m else None,
+        "tail": tail,
+    }
+    print(f"=> drill slow-loader: rc {drill.returncode}", flush=True)
+    assert drill.returncode == 0, drill.stdout + drill.stderr
+
+    res = os.path.join(REPO, "RESULTS_stepattr.json")
+    doc = {
+        "meta": {
+            "what": ("Step-time attribution acceptance (obs/stepattr.py, "
+                     "ISSUE 20): (1) the per-step identity step_time == "
+                     "compute + exposed_comm + host_sync + data_wait + "
+                     "other reconciles to <= 0.5% of the p50 step time "
+                     "on every swept recipe (LM dp=2, image GSPMD, image "
+                     "explicit-collectives) — the recorder clamps the "
+                     "residual into other >= 0 and reports overshoot as "
+                     "attr_recon_err_ms, so closure is measured, not "
+                     "assumed; (2) hot-path overhead of --step-attr "
+                     "(four perf_counter windows + one dict per step, "
+                     "zero extra compiles, zero host syncs) fenced < 2% "
+                     "step-time p50 via the flightrec A/B methodology "
+                     "with bit-identical final losses; (3) the "
+                     "chaoskit slow-loader drill passes live: injected "
+                     "stall named data_wait dominant, data_wait_share "
+                     "alert scraped firing on /metrics, identity still "
+                     "closed under chaos."),
+            "harness": "experiments/stepattr_ab.py",
+            "ab_model": ("TransformerLM vocab=256 d_model=128 heads=4 "
+                         "layers=2, seq 128, batch 8, dp=2 (the "
+                         "RESULTS_obs_export.json A/B model)"),
+            "platform": "cpu (8-device host simulation)",
+        },
+    }
+    doc.update(out)
+    with open(res, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"=> wrote {res}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

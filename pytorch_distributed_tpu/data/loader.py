@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, Optional
 
@@ -390,11 +391,30 @@ class AsyncFeeder:
     token pipeline (train/lm.py) are both instances — the machinery that
     replaces the apex CUDA-stream ``data_prefetcher``
     (reference apex_distributed.py:115-169).
+
+    Wait accounting (obs/stepattr.py's data_wait component, ISSUE 20):
+    the feeder times how long the *consumer* sat blocked on an empty
+    queue — ``wait_ms_last`` / ``wait_ms_ema`` read as "the producer
+    couldn't keep up by this much".  Zero when prefetch hides the host
+    work entirely; the number an input-starved rank shows in its
+    heartbeats.
     """
+
+    _EMA_ALPHA = 0.1
 
     def __init__(self, put, prefetch: int = 2):
         self.put = put
         self.prefetch = max(1, prefetch)
+        self.wait_ms_last = 0.0
+        self.wait_ms_ema: Optional[float] = None
+
+    def _note_wait(self, waited_s: float) -> None:
+        self.wait_ms_last = waited_s * 1e3
+        if self.wait_ms_ema is None:
+            self.wait_ms_ema = self.wait_ms_last
+        else:
+            self.wait_ms_ema += self._EMA_ALPHA * (
+                self.wait_ms_last - self.wait_ms_ema)
 
     def __call__(self, host_iter) -> Iterator:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -429,7 +449,9 @@ class AsyncFeeder:
         t.start()
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                self._note_wait(time.perf_counter() - t0)
                 if item is stop:
                     break
                 if isinstance(item, BaseException):

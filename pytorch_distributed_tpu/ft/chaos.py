@@ -151,6 +151,36 @@ class DelayRank(ChaosInjector):
         time.sleep(self.seconds)
 
 
+class SlowLoader(ChaosInjector):
+    """Sleep ``seconds`` in the batch path for the given ranks (None =
+    all) — the deterministic input-starved loader.  The delay lands in
+    ``on_batch``, which both trainers call *inside* the step-attribution
+    ``data_wait`` window (obs/stepattr.py), so a ``--step-attr`` run
+    measures the injected stall as data_wait, not compute: the
+    attribution plane must name ``data_wait`` dominant and the
+    ``data_wait_share`` alert must fire — that contract is what
+    ``chaoskit drill slow-loader`` verifies end to end."""
+
+    def __init__(self, seconds: float, every: int = 1,
+                 ranks: Optional[Sequence[int]] = None):
+        self.seconds = float(seconds)
+        self.every = max(1, int(every))
+        self.ranks = frozenset(ranks) if ranks is not None else None
+        self.injected = 0
+
+    def on_batch(self, step: int, batch):
+        if step % self.every:
+            return batch
+        if self.ranks is not None:
+            import jax
+
+            if jax.process_index() not in self.ranks:
+                return batch
+        self.injected += 1
+        time.sleep(self.seconds)
+        return batch
+
+
 class HangAt(ChaosInjector):
     """Stall ``rank`` for ``seconds`` when the loop reaches ``at_step`` —
     inside the collective region (after the flight recorder's

@@ -41,7 +41,11 @@ Rule kinds (anchors in parentheses):
   breaching: admission backlog, not compute);
 - ``preempt_redo``    rolling p99 preempt-redo cost per request above
   ``max_ms`` (obs/reqtrace.py — recompute-storm attribution: the KV
-  pool is thrashing, grow it or cap admission).
+  pool is thrashing, grow it or cap admission);
+- ``data_wait_share``  per-step share of wall time spent waiting on the
+  input pipeline above ``max_pct`` (obs/stepattr.py ``--step-attr``
+  attribution — the step is input-starved: fix the loader, not the
+  device).
 
 Firing alerts are **booked as ``alert`` ft_events** into the same JSONL
 through the engine's ``emit`` callback (the trainers wire it to
@@ -90,12 +94,14 @@ _RULE_SPECS: Dict[str, tuple] = {
     "kv_occupancy": ({"max_pct"}, set()),
     "queue_wait_share": ({"max_pct"}, set()),
     "preempt_redo": ({"max_ms"}, set()),
+    "data_wait_share": ({"max_pct"}, {"warmup_steps"}),
 }
 RULE_KINDS = tuple(sorted(_RULE_SPECS))
 
 _STEP_RULE_KINDS = ("step_time_p95", "goodput_floor", "exposed_comm",
                     "mem_peak", "ttft_p99", "kv_occupancy",
-                    "queue_wait_share", "preempt_redo")
+                    "queue_wait_share", "preempt_redo",
+                    "data_wait_share")
 
 
 class AlertRuleError(ValueError):
@@ -522,6 +528,23 @@ class AlertEngine:
                     rank=proc,
                     detail=f"preempt-redo p99 {float(v):.1f}ms/request "
                            f"> {cap:g}ms")
+            else:
+                self._clear(key)
+
+        for rule in self._by_kind.get("data_wait_share", ()):
+            v = rec.get("data_wait_share")
+            warmup = int(rule.params.get("warmup_steps", 5))
+            if v is None or step < warmup:
+                continue
+            cap = float(rule.params["max_pct"])
+            key = (rule.name, proc)
+            if float(v) > cap:
+                fired += self._fire(
+                    rule, key=key, step=step, value=float(v), threshold=cap,
+                    rank=proc,
+                    detail=f"data-wait share {float(v):.1f}% of step time "
+                           f"> {cap:g}% — input-starved (loader, not "
+                           f"device)")
             else:
                 self._clear(key)
 

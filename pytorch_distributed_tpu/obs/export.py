@@ -69,8 +69,23 @@ _SERVING_FIELDS = {
     "trace_completed": ("ptd_serving_attr_traces_total", {}),
     "trace_spans_dropped": ("ptd_serving_attr_spans_dropped_total", {}),
 }
+# training step-time attribution gauges (obs/stepattr.py, --step-attr):
+# the exact "where did my step go" split on /metrics, one gauge family
+# labelled by component so dashboards can stack them, plus the
+# data-wait share the alert rule watches.
+_ATTR_FIELDS = {
+    "attr_compute_ms": ("ptd_attr_ms", {"component": "compute"}),
+    "attr_exposed_comm_ms": ("ptd_attr_ms", {"component": "exposed_comm"}),
+    "attr_host_sync_ms": ("ptd_attr_ms", {"component": "host_sync"}),
+    "attr_data_wait_ms": ("ptd_attr_ms", {"component": "data_wait"}),
+    "attr_other_ms": ("ptd_attr_ms", {"component": "other"}),
+    "attr_device_ms": ("ptd_attr_device_ms", {}),
+    "attr_comm_ms": ("ptd_attr_comm_ms", {}),
+    "attr_recon_err_ms": ("ptd_attr_recon_err_ms", {}),
+    "data_wait_share": ("ptd_attr_data_wait_share_pct", {}),
+}
 _SKIP_FIELDS = ({"step", "t", "process", "epoch"} | set(_STAT_FIELDS)
-                | set(_SERVING_FIELDS))
+                | set(_SERVING_FIELDS) | set(_ATTR_FIELDS))
 
 # fleet-router gauge names (serving/router.py render_fleet_metrics /
 # scripts/obs_live.py fleet block).  The router renders these itself —
@@ -271,7 +286,8 @@ class MetricsExporter:
                     lines.append(_line("ptd_step_time_seconds",
                                        dict(rank, stat=stat), float(v)))
             for field, (name, extra_labels) in sorted(
-                    _SERVING_FIELDS.items()):
+                    list(_SERVING_FIELDS.items())
+                    + list(_ATTR_FIELDS.items())):
                 v = rec.get(field)
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     lines.append(_line(name, dict(rank, **extra_labels),

@@ -84,6 +84,22 @@ LINK_BYTES_PER_CHIP: Dict[str, float] = {
 }
 CPU_FALLBACK_LINK = 10e9
 
+# Per-chip HBM *bandwidth*, bytes/s — the memory-roofline denominator
+# (obs/stepattr.py): a phase whose achieved bytes/s approaches this while
+# its FLOP/s sit far under the matmul peak is HBM-bound, not compute-bound.
+# Published per-chip figures; same device_kind-prefix keying as above.
+HBM_BW_PER_CHIP: Dict[str, float] = {
+    "tpu v2": 700e9,
+    "tpu v3": 900e9,
+    "tpu v4": 1228e9,
+    "tpu v5 lite": 819e9,
+    "tpu v5e": 819e9,
+    "tpu v5p": 2765e9,
+    "tpu v6e": 1640e9,
+    "tpu v6 lite": 1640e9,
+}
+CPU_FALLBACK_HBM_BW = 20e9
+
 
 def device_peak_flops(device=None) -> float:
     """Peak FLOP/s for one chip.  ``PTD_TPU_PEAK_FLOPS`` overrides (chips
@@ -131,6 +147,14 @@ def chip_link_bytes(kind: Optional[str] = None) -> float:
     overrides)."""
     return _chip_table_lookup(LINK_BYTES_PER_CHIP, kind, CPU_FALLBACK_LINK,
                               "PTD_TPU_LINK_BYTES")
+
+
+def chip_hbm_bw(kind: Optional[str] = None) -> float:
+    """Per-chip HBM bandwidth, bytes/s (``PTD_TPU_HBM_BW`` overrides);
+    unknown/absent kinds get the CPU placeholder — roofline labels on the
+    simulated mesh assert plumbing, never real intensity."""
+    return _chip_table_lookup(HBM_BW_PER_CHIP, kind, CPU_FALLBACK_HBM_BW,
+                              "PTD_TPU_HBM_BW")
 
 
 def chip_peak_flops(kind: Optional[str] = None) -> float:

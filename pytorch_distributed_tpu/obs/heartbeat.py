@@ -114,7 +114,8 @@ class HeartbeatWriter:
     def beat(self, step: int, force: bool = False,
              step_time_ema: Optional[float] = None,
              last_ft: Optional[str] = None,
-             mem_bytes: Optional[int] = None) -> bool:
+             mem_bytes: Optional[int] = None,
+             data_wait_ms: Optional[float] = None) -> bool:
         """Record a beat at ``step``; returns True when a line was written.
 
         ``step_time_ema`` (seconds) and ``last_ft`` (the most recent
@@ -122,7 +123,10 @@ class HeartbeatWriter:
         *slow* rank (fresh beats, fat EMA) from a *dead* one (stale beats)
         and see whether the rank already said why it is behind.
         ``mem_bytes`` (``sample_process_memory``) rides along the same
-        way: a rank creeping toward OOM announces it beats ahead."""
+        way: a rank creeping toward OOM announces it beats ahead.
+        ``data_wait_ms`` (the --step-attr data-wait EMA) lets
+        ``find_stragglers`` name an *input-starved* rank — slow because
+        its loader is, not because its device is."""
         now = time.time()
         if not force and now - self._last < self.interval_s:
             return False
@@ -137,6 +141,8 @@ class HeartbeatWriter:
             rec["last_ft"] = str(last_ft)
         if mem_bytes is not None:
             rec["mem"] = int(mem_bytes)
+        if data_wait_ms is not None:
+            rec["data_wait"] = round(float(data_wait_ms), 3)
         self._lines.append(json.dumps(rec))
         del self._lines[:-self.MAX_LINES]
         # Atomic rewrite: liveness decisions (elastic eviction) must never
@@ -151,10 +157,12 @@ class HeartbeatWriter:
     def close(self, step: Optional[int] = None,
               step_time_ema: Optional[float] = None,
               last_ft: Optional[str] = None,
-              mem_bytes: Optional[int] = None) -> None:
+              mem_bytes: Optional[int] = None,
+              data_wait_ms: Optional[float] = None) -> None:
         if step is not None:
             self.beat(step, force=True, step_time_ema=step_time_ema,
-                      last_ft=last_ft, mem_bytes=mem_bytes)
+                      last_ft=last_ft, mem_bytes=mem_bytes,
+                      data_wait_ms=data_wait_ms)
 
 
 def read_heartbeats(hb_dir: str,
@@ -248,7 +256,11 @@ def find_stragglers(
       rollback) reads differently from a silent one;
     - a beat's per-process memory sample (``mem``, bytes) is appended
       the same way — a flagged rank whose memory sits far above the
-      fleet's reads as "about to OOM", not merely slow.
+      fleet's reads as "about to OOM", not merely slow;
+    - a beat's ``data_wait`` EMA (milliseconds, from ``--step-attr``)
+      reclassifies a slow rank as **input-starved** when the wait is the
+      majority of its step time — "fix the loader", not "replace the
+      host".
     """
     if not beats:
         return {}
@@ -271,8 +283,15 @@ def find_stragglers(
             ema = b.get("ema")
             if (age <= max_age_s and ema is not None and med_ema
                     and ema > slow_ema_factor * med_ema):
-                reason += (f"; slow rank: step-time ema {ema:.3f}s vs "
-                           f"fleet median {med_ema:.3f}s")
+                dw = b.get("data_wait")
+                if dw is not None and dw > 0.5 * float(ema) * 1e3:
+                    reason += (f"; input-starved rank: data_wait ema "
+                               f"{dw:.1f}ms of step-time ema "
+                               f"{float(ema) * 1e3:.1f}ms — loader, "
+                               f"not device")
+                else:
+                    reason += (f"; slow rank: step-time ema {ema:.3f}s vs "
+                               f"fleet median {med_ema:.3f}s")
             reasons.append(reason)
         if age > max_age_s:
             reasons.append(

@@ -84,6 +84,7 @@ def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
              validate_k: int = 3, hbm_budget: Optional[float] = None,
              overlap: Optional[float] = None,
              overlap_source: Optional[str] = None,
+             attr_profile: Optional[Dict[str, Any]] = None,
              spec: Optional[ModelSpec] = None) -> Dict[str, Any]:
     """The full pipeline for one (model, world size).  Returns the
     ``plan.json`` payload; never imports jax unless ``validate=True``.
@@ -93,7 +94,11 @@ def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
     ``overlap_source`` overrides that provenance label — the autoplan
     CLI passes ``"schedule"`` when the value came from the bucketed
     overlap model (``cost.bucketed_overlap``) rather than a profiler
-    measurement."""
+    measurement, and ``"measured-attr"`` when it came from a step-
+    attribution profile (``--attr-from``).  ``attr_profile`` is that
+    profile (obs/stepattr.py ``load_attr``); the payload records its
+    ``attr_source`` and measured bottleneck so a plan ranked with
+    measured constants says where they came from."""
     if spec is None:
         if model not in MODELS:
             raise KeyError(f"unknown model {model!r}; known: "
@@ -119,6 +124,15 @@ def autoplan(model: str, chips: int, *, chip: Optional[str] = None,
         "pruned": pruned,
         "ranked": [plan_entry(p, s) for p, s in ranked[:top_k]],
     }
+    if attr_profile is not None:
+        payload["attr_source"] = attr_profile.get("attr_source")
+        payload["measured"] = {
+            "bottleneck": attr_profile.get("bottleneck"),
+            "shares_pct": attr_profile.get("shares_pct"),
+            "data_wait_share_p95": attr_profile.get("data_wait_share_p95"),
+            "host_sync_ms_p95": attr_profile.get("host_sync_ms_p95"),
+            "step_ms_p50": attr_profile.get("step_ms_p50"),
+        }
     if elastic:
         worlds: Dict[str, Any] = {}
         for w in elastic_worlds(chips):

@@ -285,6 +285,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "rules file or 'default' for the built-in set; "
                         "firing alerts are booked as `alert` ft_events "
                         "in the metrics JSONL and exported to /metrics")
+    p.add_argument("--step-attr", action="store_true", dest="step_attr",
+                   help="exact per-step wall-time attribution "
+                        "(obs/stepattr.py): stamp attr_* fields — compute "
+                        "/ exposed_comm / host_sync / data_wait / other, "
+                        "summing to step_time exactly — into every "
+                        "metrics record; analyze with "
+                        "scripts/obs_roofline.py")
     p.add_argument("--eval-every", type=int, default=0,
                    help="run held-out eval (loss/ppl) every N steps; "
                         "0 = end-of-run only")
@@ -559,6 +566,7 @@ def main(argv=None) -> float:
             hang_timeout=args.hang_timeout,
             metrics_port=args.metrics_port,
             alerts=args.alerts,
+            step_attr=args.step_attr,
         )
         try:
             final_loss = trainer.fit(args.steps, print_freq=args.print_freq)

@@ -170,6 +170,11 @@ class Config:
     # literal "default" for the built-in anchor-free set.  Firing alerts
     # are booked as `alert` ft_events in the metrics JSONL.
     alerts: Optional[str] = None
+    # Exact per-step wall-time attribution (obs/stepattr.py): stamp
+    # attr_* component fields into every metrics record and carry a
+    # data_wait EMA in heartbeats.  Costs one explicit block per step
+    # (<2% step p50) — the price of the identity closing exactly.
+    step_attr: bool = False
     # derived at runtime (reference args.nprocs, distributed.py:114)
     nprocs: int = 1
 
@@ -421,6 +426,13 @@ def build_parser(description: str = "TPU ImageNet Training") -> argparse.Argumen
                    "'default' for the built-in set (obs/alerts.py); "
                    "firing alerts are booked as `alert` ft_events in the "
                    "metrics JSONL and exported to /metrics")
+    p.add_argument("--step-attr", action="store_true",
+                   default=d.step_attr, dest="step_attr",
+                   help="exact per-step wall-time attribution "
+                   "(obs/stepattr.py): stamp attr_* fields — compute / "
+                   "exposed_comm / host_sync / data_wait / other, summing "
+                   "to step_time exactly — into every metrics record; "
+                   "analyze with scripts/obs_roofline.py")
     p.add_argument("--telemetry-csv", default=d.telemetry_csv, type=str,
                    help="sample device memory stats to this CSV every 500ms "
                    "during training (statistics.sh-in-process)")

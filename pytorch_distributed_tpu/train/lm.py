@@ -16,6 +16,7 @@ meters, msgpack checkpoints) and adds:
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -757,6 +758,7 @@ class LMTrainer:
         hang_timeout: float = 30.0,
         metrics_port: int = 0,
         alerts: Optional[str] = None,
+        step_attr: bool = False,
     ):
         """``lr_schedule``: optional ``step -> lr`` callable (e.g.
         ``warmup_cosine_lr``) overriding the fixed ``lr``;
@@ -922,6 +924,17 @@ class LMTrainer:
             )
 
             self.watchdog = RecompileWatchdog(obs=self.obs).install()
+        # Exact step attribution (obs/stepattr.py, --step-attr): see the
+        # image Trainer's twin block — three wall windows + one explicit
+        # block per step, identity closed against the meters' seconds.
+        self.stepattr = None
+        self._stepattr_phases_booked = False
+        if step_attr:
+            from pytorch_distributed_tpu.obs.flops import chip_link_bytes
+            from pytorch_distributed_tpu.obs.stepattr import StepAttr
+
+            kind = getattr(mesh.devices.flat[0], "device_kind", "")
+            self.stepattr = StepAttr(link_bytes_per_s=chip_link_bytes(kind))
         # Communication + memory ledgers (obs/comms.py, obs/memory.py):
         # emitted lazily on the first fit() batch; opt-in — the AOT
         # lowering does not share the jit call cache in jax 0.4.x, so the
@@ -1339,6 +1352,36 @@ class LMTrainer:
                       f"instr {mled.peak_index}/{mled.n_instructions}) to "
                       f"{self._mem_ledger_path}", flush=True)
 
+    def _book_stepattr_phases(self) -> None:
+        """Image-Trainer twin: hand the attribution recorder the comm
+        ledger's wire bytes (when one ran) and book the static per-phase
+        roofline ledger once as a ``stepattr_phases`` ft_event."""
+        if self.stepattr is None or self._stepattr_phases_booked:
+            return
+        self._stepattr_phases_booked = True
+        from pytorch_distributed_tpu.obs import flops, stepattr
+
+        wire = float((self._comm_fields or {}).get("comm_wire_bytes", 0.0))
+        if wire > 0:
+            self.stepattr.set_comm_bytes(wire)
+        try:
+            cost = flops.lm_step_cost_for(
+                self.model, self.batch_size, self.dataset.seq_len,
+                fused_ce_chunks=self._step_kwargs["fused_ce_chunks"])
+        except (AttributeError, KeyError, ValueError):
+            return  # exotic model: attribution still runs, no roofline
+        kind = getattr(self.mesh.devices.flat[0], "device_kind", "")
+        prof = stepattr.phase_profile(
+            cost.breakdown,
+            stepattr.split_step_bytes(cost.bytes, cost.params),
+            comm_bytes=wire,
+            peak_flops=flops.chip_peak_flops(kind),
+            hbm_bw=flops.chip_hbm_bw(kind),
+            link_bw=flops.chip_link_bytes(kind),
+            n_devices=self.mesh.devices.size)
+        self.obs.log_event("stepattr_phases",
+                           **stepattr.phase_event_fields(prof))
+
     def _token_iter(self, start: int, steps: int):
         """Token stream for logical steps ``[start, steps)`` — prefetched
         via AsyncFeeder or synchronous.  Factored out so an elastic
@@ -1445,9 +1488,16 @@ class LMTrainer:
                         lr_val = None  # re-push the LR to the new mesh
                         meters.restart_clock()
                         continue
-                tokens = next(token_iter)
+                # Attribution windows (--step-attr): data_wait wraps
+                # batch acquisition *and* the chaos on_batch hook, so
+                # injected loader delay lands in the measured component.
+                sa = self.stepattr
+                _dw = sa.data_wait if sa is not None else nullcontext
+                with _dw():
+                    tokens = next(token_iter)
                 if self.chaos is not None:
-                    tokens = self.chaos.on_batch(i, tokens)
+                    with _dw():
+                        tokens = self.chaos.on_batch(i, tokens)
                 val = (self.lr_schedule(i)
                        if self.lr_schedule is not None else self.lr)
                 if self.ft_guard is not None:
@@ -1470,29 +1520,50 @@ class LMTrainer:
                                            name=fc.get("name"))
                 if self.chaos is not None:
                     self.chaos.on_collective(self, i)
-                with scope("lm_step"), self._wd_watch("lm_step", i):
+                _dev = sa.device if sa is not None else nullcontext
+                _hs = sa.host_sync if sa is not None else nullcontext
+                with scope("lm_step"), self._wd_watch("lm_step", i), _dev():
                     self.state, metrics = self.step_fn(self.state, tokens, lr)
+                    if sa is not None:
+                        # The step's blocking transfer: without it, async
+                        # dispatch smears step N's device time into N+1's
+                        # windows.  Only when --step-attr opted in;
+                        # overhead fenced <2% p50 in RESULTS_stepattr.json.
+                        jax.block_until_ready(metrics)  # shardlint: allow-sync
                 if self.flight is not None:
                     self.flight.coll_exit(i)
                     self.flight.step_end(i)
                 completed = i + 1
-                dt = meters.update(metrics, self.batch_size)
+                with _hs():
+                    dt = meters.update(metrics, self.batch_size)
                 extra = (dict(self._mfu.fields(dt))
                          if self._mfu is not None else {})
                 if self._comm_fields:
                     extra.update(self._comm_fields)
-                self.obs.log_step(
-                    i, step_time=dt, n_items=tokens_per_step, lr=lr,
-                    scalars=dict(metrics),  # incl. norms when log_norms on
-                    extra=extra or None,
-                )
+                if sa is not None:
+                    extra.update(sa.fields(dt))
+                # log_step's lazy-flush scalar drain accrues to the *next*
+                # step's host_sync window (its dt covers this wall time).
+                with _hs():
+                    self.obs.log_step(
+                        i, step_time=dt, n_items=tokens_per_step, lr=lr,
+                        scalars=dict(metrics),  # incl. norms when log_norms on
+                        extra=extra or None,
+                    )
+                # booked after the first step's record so the event's
+                # timestamp cannot widen the post-hoc goodput wall span
+                # back across the step-0 compile
+                if sa is not None and not self._stepattr_phases_booked:
+                    self._book_stepattr_phases()
                 if self.hb is not None:
                     from pytorch_distributed_tpu.obs import (
                         sample_process_memory,
                     )
                     self.hb.beat(i, step_time_ema=self.obs.ema,
                                  last_ft=self.obs.last_event_kind,
-                                 mem_bytes=sample_process_memory())
+                                 mem_bytes=sample_process_memory(),
+                                 data_wait_ms=(sa.data_wait_ema_ms
+                                               if sa is not None else None))
                     if self.flight is not None:
                         self.flight.heartbeat(
                             {"step": i,
@@ -1564,7 +1635,10 @@ class LMTrainer:
                 self.hb.close(int(self.state.step) - 1,
                               step_time_ema=self.obs.ema,
                               last_ft=self.obs.last_event_kind,
-                              mem_bytes=sample_process_memory())
+                              mem_bytes=sample_process_memory(),
+                              data_wait_ms=(self.stepattr.data_wait_ema_ms
+                                            if self.stepattr is not None
+                                            else None))
             self.obs.flush()
             if self._goodput is not None:
                 print(f"=> {self._goodput.format_summary()}", flush=True)

@@ -10,6 +10,7 @@ diversity (SURVEY.md §7.1).
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -363,6 +364,22 @@ class Trainer:
             self.obs.register(self.alerts)
             if self._exporter is not None:
                 self._exporter.engine = self.alerts  # ptd_alert_firing
+        # Exact step attribution (obs/stepattr.py, --step-attr): three
+        # perf_counter wall windows per step + one explicit block on the
+        # step outputs, closing step_time == compute + exposed_comm +
+        # host_sync + data_wait + other exactly.  The device-window split
+        # starts as a ledger estimate and upgrades to the comm ledger's
+        # wire bytes when --comm-ledger runs (same lowering, no extra
+        # compile); the static phase roofline books once as a
+        # `stepattr_phases` ft_event.
+        self.stepattr = None
+        self._stepattr_phases_booked = False
+        if getattr(cfg, "step_attr", False):
+            from pytorch_distributed_tpu.obs.flops import chip_link_bytes
+            from pytorch_distributed_tpu.obs.stepattr import StepAttr
+
+            kind = getattr(self.mesh.devices.flat[0], "device_kind", "")
+            self.stepattr = StepAttr(link_bytes_per_s=chip_link_bytes(kind))
         # Communication + memory ledgers (obs/comms.py, obs/memory.py):
         # emitted lazily on the first train batch (real shardings in
         # hand), opt-in because the AOT lowering does not share the jit
@@ -802,6 +819,39 @@ class Trainer:
                       f"instr {mled.peak_index}/{mled.n_instructions}) to "
                       f"{cfg.mem_ledger}", flush=True)
 
+    def _book_stepattr_phases(self) -> None:
+        """Feed the attribution recorder the comm ledger's measured wire
+        bytes (when one ran — the estimate upgrade costs no compile) and
+        book the static per-phase roofline ledger as a one-time
+        ``stepattr_phases`` ft_event: per named_scope phase FLOPs/HBM
+        bytes from the analytic StepCost plus the chip peaks, so the
+        jax-free CLI never touches hardware tables."""
+        if self.stepattr is None or self._stepattr_phases_booked:
+            return
+        self._stepattr_phases_booked = True
+        from pytorch_distributed_tpu.obs import flops, stepattr
+
+        cfg = self.cfg
+        wire = float((self._comm_fields or {}).get("comm_wire_bytes", 0.0))
+        if wire > 0:
+            self.stepattr.set_comm_bytes(wire)
+        try:
+            cost = flops.image_step_cost(cfg.arch, cfg.batch_size,
+                                         cfg.image_size, cfg.num_classes)
+        except (KeyError, ValueError):
+            return  # unregistered arch: attribution still runs, no roofline
+        kind = getattr(self.mesh.devices.flat[0], "device_kind", "")
+        prof = stepattr.phase_profile(
+            cost.breakdown,
+            stepattr.split_step_bytes(cost.bytes, cost.params),
+            comm_bytes=wire,
+            peak_flops=flops.chip_peak_flops(kind),
+            hbm_bw=flops.chip_hbm_bw(kind),
+            link_bw=flops.chip_link_bytes(kind),
+            n_devices=self.mesh.devices.size)
+        self.obs.log_event("stepattr_phases",
+                           **stepattr.phase_event_fields(prof))
+
     def train_epoch(
         self, epoch: int, profiler: Optional[ProfileWindow] = None,
         start_step: int = 0,
@@ -874,11 +924,19 @@ class Trainer:
                         lr * scale * self._elastic_lr_scale)
                     meters.restart_clock()
                     continue
-            batch = next(batch_iter, None)
+            # Attribution windows (--step-attr): data_wait wraps batch
+            # acquisition *and* the chaos on_batch hook, so an injected
+            # loader delay (chaoskit drill slow-loader) lands in the
+            # measured component by design.
+            sa = self.stepattr
+            _dw = sa.data_wait if sa is not None else nullcontext
+            with _dw():
+                batch = next(batch_iter, None)
             if batch is None:
                 break
             if self.chaos is not None:
-                batch = self.chaos.on_batch(i, batch)
+                with _dw():
+                    batch = self.chaos.on_batch(i, batch)
             n = self.cfg.batch_size
             if ((getattr(cfg, "comm_ledger", None)
                     or getattr(cfg, "mem_ledger", None))
@@ -896,30 +954,54 @@ class Trainer:
                                        name=fc.get("name"))
             if self.chaos is not None:
                 self.chaos.on_collective(self, self._global_step)
+            _dev = sa.device if sa is not None else nullcontext
+            _hs = sa.host_sync if sa is not None else nullcontext
             with scope("train_step"), self._wd_watch("train_step",
-                                                     self._global_step):
+                                                     self._global_step), \
+                    _dev():
                 self.state, metrics = self.train_step(self.state, batch, lr_arr)
+                if sa is not None:
+                    # The step's blocking transfer: without it, async
+                    # dispatch smears step N's device time into N+1's
+                    # windows and the identity stops meaning anything.
+                    # Only when --step-attr opted in; overhead fenced
+                    # <2% p50 in RESULTS_stepattr.json.
+                    jax.block_until_ready(metrics)  # shardlint: allow-sync
             if self.flight is not None:
                 self.flight.coll_exit(self._global_step)
                 self.flight.step_end(self._global_step)
             completed = i + 1
             # Unready device scalars: meters and the metrics logger convert
             # lazily, so no per-step host sync (SURVEY.md §7.4 item 1).
-            dt = meters.update(metrics, n)
+            with _hs():
+                dt = meters.update(metrics, n)
             extra = {"epoch": epoch}
             if self._mfu is not None:
                 extra.update(self._mfu.fields(dt))
             if self._comm_fields:
                 extra.update(self._comm_fields)
-            self.obs.log_step(
-                self._global_step, step_time=dt, n_items=n, lr=lr,
-                scalars=dict(metrics),  # incl. norms when --metrics-jsonl
-                extra=extra,
-            )
+            if sa is not None:
+                extra.update(sa.fields(dt))
+            # The lazy-flush scalar drain inside log_step accrues to the
+            # *next* step's host_sync window (its dt covers this wall
+            # time), keeping the identity aligned.
+            with _hs():
+                self.obs.log_step(
+                    self._global_step, step_time=dt, n_items=n, lr=lr,
+                    scalars=dict(metrics),  # incl. norms when --metrics-jsonl
+                    extra=extra,
+                )
+            # booked after the first step's record so the event's
+            # timestamp cannot widen the post-hoc goodput wall span back
+            # across the step-0 compile
+            if sa is not None and not self._stepattr_phases_booked:
+                self._book_stepattr_phases()
             if self.hb is not None:
                 self.hb.beat(self._global_step, step_time_ema=self.obs.ema,
                              last_ft=self.obs.last_event_kind,
-                             mem_bytes=sample_process_memory())
+                             mem_bytes=sample_process_memory(),
+                             data_wait_ms=(sa.data_wait_ema_ms
+                                           if sa is not None else None))
                 if self.flight is not None:
                     self.flight.heartbeat(
                         {"step": self._global_step,
@@ -1074,7 +1156,10 @@ class Trainer:
                 self.hb.close(max(0, self._global_step - 1),
                               step_time_ema=self.obs.ema,
                               last_ft=self.obs.last_event_kind,
-                              mem_bytes=sample_process_memory())
+                              mem_bytes=sample_process_memory(),
+                              data_wait_ms=(self.stepattr.data_wait_ema_ms
+                                            if self.stepattr is not None
+                                            else None))
             self.obs.flush()
             if self._goodput is not None:
                 print(f"=> {self._goodput.format_summary()}", flush=True)

@@ -23,10 +23,18 @@ PTD_PLAN_OVERLAP) is replaced by the overlap the profiler actually
 measured on this deployment (obs_timeline.py report), so re-planning
 after a calibration run scores comm-bound plans with real numbers.
 
+``--attr-from attr.json`` closes the same loop from the step-attribution
+plane (ISSUE 20): a ``--step-attr`` run's measured profile
+(``obs_roofline.py --attr-out``) supplies the overlap AND the measured
+bottleneck — the payload records ``attr_source``, and when the dominant
+class is data_wait/host_sync the report says so, because no layout
+re-plan fixes an input-starved step.
+
 Usage:
   python scripts/autoplan.py lm --chips 32 --chip v5p
   python scripts/autoplan.py resnet50 --chips 4,8,32 --out plan.json
   python scripts/autoplan.py lm --chips 32 --overlap-from timeline.json
+  python scripts/autoplan.py lm --chips 32 --attr-from attr.json
   python scripts/autoplan.py lm-tiny --chips 4 --validate
   python scripts/autoplan.py --selftest       # resnet50 + LM at 4/8/32
 """
@@ -49,6 +57,28 @@ def _setup_mesh_backend() -> None:
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_threefry_partitionable", True)
+
+
+def _load_stepattr():
+    """obs/stepattr.py by file path under the shared ``_ptd_obs_*`` alias
+    (the obs package ``__init__`` imports jax; the analytic planner path
+    must stay jax-free)."""
+    import importlib.util
+
+    full = "pytorch_distributed_tpu.obs.stepattr"
+    if full in sys.modules:
+        return sys.modules[full]
+    alias = "_ptd_obs_stepattr"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(
+        alias, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "pytorch_distributed_tpu", "obs", "stepattr.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def overlap_from_timeline(path: str) -> float:
@@ -79,6 +109,20 @@ def _render(payload) -> str:
     elif payload.get("overlap_source") == "schedule":
         lines.append(f"   overlap: {100.0 * payload['overlap']:.1f}% "
                      "(bucketed-schedule model)")
+    elif payload.get("overlap_source") == "measured-attr":
+        lines.append(f"   overlap: {100.0 * payload['overlap']:.1f}% "
+                     f"(measured from step attribution: "
+                     f"{payload.get('attr_source')})")
+    meas = payload.get("measured")
+    if meas:
+        lines.append(f"   measured bottleneck: {meas['bottleneck']} "
+                     f"(data-wait p95 {meas['data_wait_share_p95']:.1f}% "
+                     f"of step, host-sync p95 "
+                     f"{meas['host_sync_ms_p95']:.2f}ms)")
+        if meas["bottleneck"] in ("data_wait", "host_sync", "other"):
+            lines.append("   NOTE: the measured bottleneck is host-side "
+                         "— no layout re-plan fixes it; fix the input "
+                         "pipeline / host sync first")
     for reason, n in sorted(payload["pruned"].items()):
         lines.append(f"   pruned {n:4d}  {reason}")
     lines.append(f"   {'#':>2} {'plan':<34} {'MFU%':>6} {'step_ms':>10} "
@@ -130,6 +174,52 @@ def selftest() -> int:
     # contract the validation fences depend on)
     out = autoplan("lm-tiny", 4, top_k=1)
     assert out["ranked"][0]["plan"]["key"] == "c4/dp4", out["ranked"][0]
+
+    # --attr-from: a measured step-attribution profile swaps in its
+    # overlap, the payload records attr_source + the measured bottleneck,
+    # and the host-side caution renders when data_wait dominates
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ap_path = os.path.join(d, "attr.json")
+        with open(ap_path, "w") as f:
+            json.dump({"kind": "stepattr_profile", "attr_source": ap_path,
+                       "steps": 40, "step_ms_p50": 100.0, "overlap": 0.8,
+                       "bottleneck": "data_wait",
+                       "shares_pct": {"compute": 40.0, "data_wait": 45.0},
+                       "data_wait_share_p95": 46.0,
+                       "host_sync_ms_p95": 3.0,
+                       "recon_err_pct_p50": 0.1}, f)
+        prof = _load_stepattr().load_attr(ap_path)
+        assert prof["bottleneck"] == "data_wait", prof
+        out = autoplan("lm-tiny", 4, top_k=1, overlap=prof["overlap"],
+                       overlap_source="measured-attr", attr_profile=prof)
+        assert out["overlap"] == 0.8 and \
+            out["overlap_source"] == "measured-attr", out
+        assert out["attr_source"] == ap_path, out
+        assert out["measured"]["bottleneck"] == "data_wait", out
+        rendered = _render(out)
+        for needle in ("measured from step attribution",
+                       "measured bottleneck: data_wait",
+                       "data-wait p95 46.0% of step",
+                       "no layout re-plan fixes it"):
+            assert needle in rendered, f"missing {needle!r}\n{rendered}"
+        # non-host bottleneck: no caution line
+        prof2 = dict(prof, bottleneck="exposed_comm")
+        out2 = autoplan("lm-tiny", 4, top_k=1, overlap=0.8,
+                        overlap_source="measured-attr", attr_profile=prof2)
+        assert "no layout re-plan" not in _render(out2)
+        # a non-profile JSON is rejected loudly
+        bogus = os.path.join(d, "bogus.json")
+        with open(bogus, "w") as f:
+            json.dump({"overlap": 0.5}, f)
+        try:
+            _load_stepattr().load_attr(bogus)
+            raise AssertionError("load_attr accepted a non-profile JSON")
+        except ValueError:
+            pass
+    print("  [selftest] --attr-from: overlap 0.8 swapped in, "
+          "attr_source recorded, host-side caution rendered")
     print("autoplan selftest OK")
     return 0
 
@@ -152,6 +242,12 @@ def main(argv=None) -> int:
                     help="replace the assumed backward-overlap fraction "
                          "with the measured overlap_pct_mean from an "
                          "obs_timeline.py report")
+    ap.add_argument("--attr-from", default=None, dest="attr_from",
+                    metavar="ATTR_JSON",
+                    help="replace the assumed overlap/bottleneck "
+                         "constants with a measured step-attribution "
+                         "profile (obs_roofline.py --attr-out); the "
+                         "payload records attr_source")
     ap.add_argument("--overlap-schedule", nargs="?", const=4.0, type=float,
                     default=None, metavar="BUCKET_MB",
                     help="replace the assumed backward-overlap fraction "
@@ -188,13 +284,25 @@ def main(argv=None) -> int:
 
     overlap = None
     overlap_source = None
-    if args.overlap_from and args.overlap_schedule is not None:
-        ap.error("--overlap-from and --overlap-schedule are exclusive "
-                 "(measured vs schedule-derived provenance)")
+    attr_profile = None
+    if sum(bool(x) for x in (args.overlap_from, args.attr_from,
+                             args.overlap_schedule is not None)) > 1:
+        ap.error("--overlap-from, --attr-from and --overlap-schedule are "
+                 "exclusive (one overlap provenance per plan)")
     if args.overlap_from:
         overlap = overlap_from_timeline(args.overlap_from)
         print(f"measured overlap {100.0 * overlap:.1f}% from "
               f"'{args.overlap_from}' (assumed default was 60%)")
+    elif args.attr_from:
+        attr_profile = _load_stepattr().load_attr(args.attr_from)
+        ov = attr_profile.get("overlap")
+        if ov is not None:
+            overlap = min(1.0, max(0.0, float(ov)))
+            overlap_source = "measured-attr"
+        print(f"measured attribution from '{args.attr_from}': bottleneck "
+              f"{attr_profile.get('bottleneck')}"
+              + (f", overlap {100.0 * overlap:.1f}%"
+                 if overlap is not None else ", overlap n/a"))
     elif args.overlap_schedule is not None:
         from pytorch_distributed_tpu.plan import cost as cost_mod
 
@@ -211,7 +319,8 @@ def main(argv=None) -> int:
             args.model, chips, chip=args.chip, top_k=args.top_k,
             elastic=not args.no_elastic, validate=args.validate,
             validate_k=args.validate_k, hbm_budget=args.hbm_budget,
-            overlap=overlap, overlap_source=overlap_source)
+            overlap=overlap, overlap_source=overlap_source,
+            attr_profile=attr_profile)
         sweeps.append(payload)
         if args.format == "table":
             print(_render(payload))

@@ -40,7 +40,13 @@ Commands:
   ``router-restart`` (ISSUE 19) SIGKILLs the router itself mid-run,
   restarts it, and passes iff client replays complete exactly once —
   the replicas' idempotent rid caches (or deterministic recompute)
-  absorb the lost ledger.  Mesh drills import jax lazily inside them;
+  absorb the lost ledger; ``slow-loader`` (ISSUE 20) injects a loader
+  stall under ``--step-attr`` and passes iff the attribution plane
+  names ``data_wait`` dominant (the stall must not be blamed on the
+  device), the ``data_wait_share`` alert fires live on ``/metrics``
+  and books as an ``alert`` ft_event, and the jax-free
+  ``obs_roofline.py`` + ``obs_report.py`` fold the same verdict from
+  the JSONL alone.  Mesh drills import jax lazily inside them;
   the fleet drills never touch jax at all (subprocess sim replicas).
   Every drill kind shares the ``--seed`` contract: the injection step
   comes from ``drill_plan(seed, steps)``, so the same seed reproduces
@@ -151,6 +157,8 @@ def cmd_drill(args) -> int:
         return _drill_serve(args)
     if args.kind == "trace":
         return _drill_trace(args)
+    if args.kind == "slow-loader":
+        return _drill_slow_loader(args)
     world = args.world
     if world < 2 or world > len(jax.devices()):
         print(f"need 2 <= --world <= {len(jax.devices())} devices, "
@@ -489,6 +497,163 @@ def _drill_alert(args) -> int:
           f"{sorted(seen['firing'])}, booked {sorted(booked | {'dead_rank'})}, "
           f"goodput folded {gp.alerts}")
     print("drill alert: OK")
+    return 0
+
+
+def _drill_slow_loader(args) -> int:
+    """Input-starvation drill (ISSUE 20): a ``SlowLoader`` injector
+    sleeps in the batch path — inside the step-attribution ``data_wait``
+    window — so a ``--step-attr`` run must *measure* the stall as data
+    wait, not blame the device.  Passes iff:
+
+    - the attribution plane names ``data_wait`` the dominant component
+      and the identity still reconciles (recon err <= 0.5% of step p50);
+    - the ``data_wait_share`` alert fires live on the rank's ``/metrics``
+      exporter (``ptd_alert_firing``) and lands as an ``alert`` ft_event
+      in the JSONL;
+    - the jax-free ``obs_roofline.py`` names the same bottleneck from
+      the JSONL alone, and ``obs_report`` folds the attribution section.
+    """
+    import json as _json
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+
+    from pytorch_distributed_tpu.ft import ChaosSchedule
+    from pytorch_distributed_tpu.ft.chaos import SlowLoader
+    from pytorch_distributed_tpu.models.transformer import TransformerLM
+    from pytorch_distributed_tpu.obs import stepattr as stepattr_mod
+    from pytorch_distributed_tpu.obs.export import parse_prometheus
+    from pytorch_distributed_tpu.obs.metrics import read_metrics
+    from pytorch_distributed_tpu.parallel import MeshSpec, build_mesh
+    from pytorch_distributed_tpu.train.lm import (
+        LMTrainer,
+        SyntheticTokenDataset,
+    )
+
+    world = min(args.world, len(jax.devices()))
+    out = args.out or tempfile.mkdtemp(prefix="slow-loader-drill-")
+    os.makedirs(out, exist_ok=True)
+
+    delay = 0.05  # injected per-step loader stall, dwarfs the tiny LM step
+    rules_path = os.path.join(out, "rules.json")
+    with open(rules_path, "w") as f:
+        _json.dump({"rules": [
+            {"kind": "data_wait_share", "name": "data_wait_share",
+             "severity": "warn", "max_pct": 30.0, "warmup_steps": 2},
+        ]}, f, indent=2)
+    with socket.socket() as s:  # free localhost port for the exporter
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    mpath = os.path.join(out, "metrics.jsonl")
+    print(f"drill slow-loader: world {world}, SlowLoader({delay:.2f}s) vs "
+          f"30% data-wait ceiling, exporter on :{port}, artifacts in "
+          f"'{out}'")
+
+    mesh = build_mesh(MeshSpec(("data",), (world,)),
+                      devices=jax.devices()[:world])
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=2, n_layers=1)
+    ds = SyntheticTokenDataset(length=256, seq_len=16, vocab=64,
+                               seed=args.seed)
+    t = LMTrainer(model, mesh, ds, batch_size=world, lr=1e-2,
+                  seed=args.seed, prefetch=0, hb_dir=out,
+                  metrics_jsonl=mpath, metrics_port=port,
+                  alerts=rules_path, step_attr=True,
+                  chaos=ChaosSchedule(SlowLoader(delay)))
+    t.obs.flush_every = 1  # short run: sinks must see every step live
+
+    # scrape the exporter concurrently with fit(): the alert AND the
+    # ptd_attr_* gauges must be visible on /metrics while the run lives
+    seen = {"firing": set(), "share": None, "scrapes": 0}
+    stop = threading.Event()
+
+    def _scrape():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(url, timeout=1.0) as r:
+                    samples = parse_prometheus(
+                        r.read().decode("utf-8", "replace"))
+                seen["scrapes"] += 1
+                for name, lab, v in samples:
+                    if name == "ptd_alert_firing" and v:
+                        seen["firing"].add(lab.get("rule"))
+                    elif name == "ptd_attr_data_wait_share_pct":
+                        seen["share"] = max(seen["share"] or 0.0, v)
+            except Exception:
+                pass
+            stop.wait(0.2)
+
+    th = threading.Thread(target=_scrape, daemon=True)
+    th.start()
+    loss = t.fit(args.steps, print_freq=max(1, args.steps // 4))
+    stop.set()
+    th.join(timeout=2.0)
+
+    ok = True
+    if "data_wait_share" not in seen["firing"]:
+        print(f"FAIL: live scrape never saw ptd_alert_firing{{rule="
+              f"\"data_wait_share\"}} ({seen['scrapes']} scrape(s), saw "
+              f"{sorted(seen['firing'])})")
+        ok = False
+    if not seen["share"] or seen["share"] <= 30.0:
+        print(f"FAIL: ptd_attr_data_wait_share_pct never exceeded the "
+              f"30% ceiling on /metrics (max seen: {seen['share']})")
+        ok = False
+    records = read_metrics(mpath)
+    booked = {str(e.get("alert")) for e in records
+              if e.get("ft_event") == "alert"}
+    if "data_wait_share" not in booked:
+        print(f"FAIL: no 'data_wait_share' alert ft_event in '{mpath}' "
+              f"(booked: {sorted(booked)})")
+        ok = False
+    summ = stepattr_mod.summarize(records)
+    if summ is None or summ["dominant"] != "data_wait":
+        print(f"FAIL: attribution must name data_wait dominant, got "
+              f"{summ and summ['dominant']} (shares: "
+              f"{summ and summ['shares_pct']})")
+        ok = False
+    elif summ["recon_err_pct_p50"] > 0.5:
+        print(f"FAIL: identity recon err {summ['recon_err_pct_p50']:.3f}% "
+              f"of step p50 breaches the 0.5% fence")
+        ok = False
+
+    # the jax-free CLI names the same bottleneck from the JSONL alone
+    scripts_dir = os.path.dirname(os.path.abspath(__file__))
+    roof = subprocess.run(
+        [sys.executable, os.path.join(scripts_dir, "obs_roofline.py"),
+         "--metrics-jsonl", mpath, "--json"],
+        capture_output=True, text=True)
+    try:
+        doc = _json.loads(roof.stdout)
+    except ValueError:
+        doc = {}
+    if roof.returncode != 0 or doc.get("dominant") != "data_wait":
+        print(f"FAIL: obs_roofline --json rc {roof.returncode}, dominant "
+              f"{doc.get('dominant')}; stderr: {roof.stderr.strip()}")
+        ok = False
+    rep = subprocess.run(
+        [sys.executable, os.path.join(scripts_dir, "obs_report.py"),
+         "--metrics-jsonl", mpath],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if ("== attribution ==" not in rep.stdout
+            or "dominant: data_wait" not in rep.stdout):
+        print(f"FAIL: obs_report did not fold the attribution section "
+              f"(rc {rep.returncode})")
+        ok = False
+    if not ok:
+        return 1
+    print(f"final loss {loss:.4f}; data-wait share p95 "
+          f"{summ['data_wait_share_p95']:.1f}% (max scraped "
+          f"{seen['share']:.1f}%), recon err "
+          f"{summ['recon_err_pct_p50']:.3f}% of step p50, alert booked "
+          f"live")
+    print("drill slow-loader: OK")
     return 0
 
 
@@ -1300,6 +1465,20 @@ def _selftest() -> int:
         assert h.fired, "HangAt must fire at its step"
         h.on_collective(None, 3)    # latched: second visit is a no-op
         assert h.fired
+
+        # 10. SlowLoader stalls only via the batch hook (inside the
+        #     step-attribution data_wait window), honors --every, and
+        #     passes the batch through untouched — no jax with ranks=None.
+        from pytorch_distributed_tpu.ft.chaos import SlowLoader
+
+        sl = SlowLoader(0.0, every=2)
+        sl.on_step(None, 0)         # wrong hook: must not count
+        assert sl.injected == 0
+        sentinel = object()
+        assert sl.on_batch(0, sentinel) is sentinel
+        assert sl.on_batch(1, sentinel) is sentinel  # skipped by every=2
+        assert sl.on_batch(2, sentinel) is sentinel
+        assert sl.injected == 2, sl.injected
     print("chaoskit selftest: OK")
     return 0
 
@@ -1325,7 +1504,7 @@ def main(argv=None) -> int:
     d.add_argument("kind",
                    choices=("shrink", "grow", "hang", "alert", "serve",
                             "trace", "desync", "replica-kill",
-                            "router-restart"),
+                            "router-restart", "slow-loader"),
                    help="shrink: lose a rank and continue; grow: lose "
                         "then re-admit it; hang: stall a rank inside a "
                         "collective and let the watchdog catch it; "
@@ -1344,7 +1523,11 @@ def main(argv=None) -> int:
                         "bit-exact vs an unkilled run; router-restart: "
                         "SIGKILL the fleet router itself — client "
                         "replays against the restarted router must land "
-                        "exactly once via the replicas' rid caches")
+                        "exactly once via the replicas' rid caches; "
+                        "slow-loader: an injected loader stall under "
+                        "--step-attr must be attributed to data_wait "
+                        "(not the device) and fire the data_wait_share "
+                        "alert live")
     d.add_argument("--world", type=int, default=4,
                    help="starting data-parallel world size")
     d.add_argument("--steps", type=int, default=12)

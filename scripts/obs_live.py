@@ -72,6 +72,7 @@ alerts = _load_obs("alerts")
 export = _load_obs("export")
 heartbeat = _load_obs("heartbeat")
 metrics = _load_obs("metrics")
+stepattr = _load_obs("stepattr")
 
 
 # --------------------------------------------------------------- aggregation
@@ -128,9 +129,29 @@ def pseudo_record(samples, rank: int):
         v = export.sample_value(samples, gname, rank=rank, **labels)
         if v is not None:
             rec.setdefault(field, float(v))
+    # training step-attribution gauges (ptd_attr_*, ISSUE 20) fold back
+    # the same way, so the aggregator evaluates data_wait_share rules
+    # from a scrape and the dashboard names each rank's bottleneck
+    for field, (gname, labels) in export._ATTR_FIELDS.items():
+        v = export.sample_value(samples, gname, rank=rank, **labels)
+        if v is not None:
+            rec.setdefault(field, float(v))
     age = export.sample_value(samples, "ptd_record_age_seconds", rank=rank)
     rec["t"] = time.time() - float(age or 0.0)
     return rec if "step_time" in rec else None
+
+
+def bottleneck_of(rec):
+    """Dominant step-time attribution class of a record — the largest of
+    the ``attr_<component>_ms`` fields (None without ``--step-attr``)."""
+    comps = {}
+    for c in stepattr.COMPONENTS:
+        v = rec.get(f"attr_{c}_ms")
+        if v is not None:
+            comps[c] = float(v)
+    if not comps:
+        return None
+    return max(comps, key=comps.get)
 
 
 def fleet_from_samples(samples):
@@ -257,6 +278,11 @@ class FleetMonitor:
                 "redo_p99_ms": (rec.get("preempt_redo_ms_p99")
                                 if rec else None),
                 "traces": rec.get("trace_completed") if rec else None,
+                "bottleneck": bottleneck_of(rec) if rec else None,
+                "data_wait_share": (rec.get("data_wait_share")
+                                    if rec else None),
+                "host_sync_ms": (rec.get("attr_host_sync_ms")
+                                 if rec else None),
             }
         beats = {}
         if self.hb_dir:
@@ -299,7 +325,8 @@ class FleetMonitor:
                 + (f"  mem {mem / 2**20:.1f} MiB" if mem else ""))
         lines.append(f"{'rank':>4}  {'state':<5}  {'step':>6}  "
                      f"{'p50(ms)':>8}  {'tok/s':>8}  {'mfu':>5}  "
-                     f"{'mem(MiB)':>8}  {'rec-age':>7}  {'beat-age':>8}")
+                     f"{'mem(MiB)':>8}  {'rec-age':>7}  {'beat-age':>8}  "
+                     f"{'bottleneck':<12}")
 
         def _fmt(v, spec, dash="-"):
             return format(v, spec) if isinstance(v, (int, float)) else dash
@@ -314,7 +341,19 @@ class FleetMonitor:
                 f"{_fmt(r.get('mfu'), '.2f'):>5}  "
                 f"{_fmt((r.get('mem_bytes') or 0) / 2**20 if r.get('mem_bytes') else None, '.1f'):>8}  "
                 f"{_fmt(r.get('rec_age_s'), '.1f'):>7}  "
-                f"{_fmt(r.get('beat_age_s'), '.1f'):>8}")
+                f"{_fmt(r.get('beat_age_s'), '.1f'):>8}  "
+                f"{(r.get('bottleneck') or '-'):<12}")
+        tattr = [r for _k, r in sorted(self.rows.items(), key=lambda kv:
+                                       str(kv[0]))
+                 if r.get("bottleneck") is not None]
+        if tattr:
+            lines.append("-- step attribution (where did my step go) --")
+            for r in tattr:
+                lines.append(
+                    f"  rank {_fmt(r.get('rank'), 'd', '?')}: "
+                    f"bottleneck {r['bottleneck']};  data-wait "
+                    f"{_fmt(r.get('data_wait_share'), '.1f')}% of step;  "
+                    f"host-sync {_fmt(r.get('host_sync_ms'), '.2f')}ms")
         attr = [r for _k, r in sorted(self.rows.items(), key=lambda kv:
                                       str(kv[0]))
                 if r.get("q_share_p99") is not None
@@ -433,7 +472,12 @@ def _selftest() -> int:
                     "loss": 2.5, "serving": 1.0,
                     "queue_wait_share_p99": 61.5,
                     "preempt_redo_ms_p99": 209.6,
-                    "trace_completed": 24.0})
+                    "trace_completed": 24.0,
+                    "attr_compute_ms": 9.0, "attr_exposed_comm_ms": 1.5,
+                    "attr_host_sync_ms": 0.8, "attr_data_wait_ms": 7.7,
+                    "attr_other_ms": 1.0, "attr_device_ms": 10.5,
+                    "attr_comm_ms": 3.0, "attr_recon_err_ms": 0.01,
+                    "data_wait_share": 38.5})
         exp.update({"ft_event": "alert", "t": time.time(), "process": 3,
                     "alert": "x", "rule": "hang", "severity": "page"})
         exp.start()
@@ -454,19 +498,35 @@ def _selftest() -> int:
             # and the dashboard names the attribution per rank
             assert abs(rec["queue_wait_share_p99"] - 61.5) < 1e-9, rec
             assert abs(rec["preempt_redo_ms_p99"] - 209.6) < 1e-9, rec
+            # ptd_attr_* training-attribution gauges fold back too, the
+            # bottleneck column names the dominant class, and the
+            # data_wait_share rule fires from a scrape like from a record
+            assert abs(rec["attr_compute_ms"] - 9.0) < 1e-9, rec
+            assert abs(rec["data_wait_share"] - 38.5) < 1e-9, rec
+            assert bottleneck_of(rec) == "compute", rec
             mon_s = FleetMonitor([url], rules=[
                 alerts.Rule("queue_wait_share", "qw", "warn",
                             {"max_pct": 50.0}),
                 alerts.Rule("preempt_redo", "redo", "warn",
-                            {"max_ms": 100.0})])
+                            {"max_ms": 100.0}),
+                alerts.Rule("data_wait_share", "dw", "warn",
+                            {"max_pct": 25.0})])
             fired_s = mon_s.cycle()
-            assert {a.name for a in fired_s} == {"qw", "redo"}, fired_s
+            assert {a.name for a in fired_s} == {"qw", "redo", "dw"}, \
+                fired_s
             assert mon_s.any_firing()
             dash_s = mon_s.dashboard()
             for needle in ("-- serving attribution", "61.5% of TTFT",
-                           "preempt-redo p99 209.6ms", "traces 24"):
+                           "preempt-redo p99 209.6ms", "traces 24",
+                           "-- step attribution (where did my step go)",
+                           "bottleneck compute",
+                           "data-wait 38.5% of step",
+                           "host-sync 0.80ms"):
                 assert needle in dash_s, \
                     f"dashboard missing {needle!r}\n{dash_s}"
+            assert any("UP" in ln and ln.rstrip().endswith("compute")
+                       for ln in dash_s.splitlines()), \
+                f"bottleneck column missing from the rank row\n{dash_s}"
         finally:
             exp.stop()
 

@@ -702,6 +702,55 @@ def summarize_traces(records: List[dict]) -> List[str]:
     return lines
 
 
+def attr_stats(records: List[dict]) -> Optional[Dict]:
+    """Step-time attribution summary over a ``--step-attr`` run's
+    ``attr_*`` record fields (obs/stepattr.py), with the roofline bolted
+    on when the run booked its ``stepattr_phases`` event.  None when
+    attribution was off (every other run keeps its report unchanged)."""
+    from pytorch_distributed_tpu.obs import stepattr
+
+    summ = stepattr.summarize(records)
+    if summ is None:
+        return None
+    summ = dict(summ)
+    ev = stepattr.phase_event(records)
+    if ev is not None:
+        summ["roofline"] = stepattr.roofline(summ, ev)
+    return summ
+
+
+def summarize_attribution(records: List[dict]) -> List[str]:
+    """The ``== attribution ==`` fold (ISSUE 20): the exact identity
+    step_time == compute + exposed_comm + host_sync + data_wait + other,
+    the two diff-fenced tails, and the roofline's fix-first ranking."""
+    s = attr_stats(records)
+    if s is None:
+        return []
+    from pytorch_distributed_tpu.obs.stepattr import format_summary_line
+
+    lines = [
+        "== attribution ==",
+        "  " + format_summary_line(s),
+        f"  identity recon    err max {s['recon_err_ms_max']:.3f}ms "
+        f"({s['recon_err_pct_p50']:.2f}% of step p50) over "
+        f"{s['steps']} step(s)",
+        f"  data_wait_share   p50 {s['data_wait_share_p50']:.1f}%  "
+        f"p95 {s['data_wait_share_p95']:.1f}%",
+        f"  host_sync         p50 {s['host_sync_ms_p50']:.2f}ms  "
+        f"p95 {s['host_sync_ms_p95']:.2f}ms",
+    ]
+    if s.get("overlap_measured") is not None:
+        lines.append(f"  comm overlap      measured "
+                     f"{s['overlap_measured']:.2f} "
+                     f"(exposure source: {s['exposure_source']})")
+    roof = s.get("roofline")
+    if roof:
+        lines.append("  fix first: " + ", ".join(
+            f"{p['phase']} {p['headroom_ms']:.1f}ms ({p['label']})"
+            for p in roof["fix_first"][:3]))
+    return lines
+
+
 _FLEET_COUNTERS = ("requests_routed", "requests_completed",
                    "requests_failed", "retries", "hedges", "hedges_won",
                    "hedges_lost", "duplicates_suppressed",
@@ -914,6 +963,7 @@ def report(args) -> str:
         sections += summarize_serving(records)
         sections += summarize_traces(records)
         sections += summarize_fleet(records)
+        sections += summarize_attribution(records)
     else:
         if getattr(args, "comm_ledger", None):
             sections += summarize_comms([], args.comm_ledger,
@@ -992,6 +1042,9 @@ def report_json(args) -> Dict:
         flt = fleet_stats(records)
         if flt is not None:
             out["fleet"] = flt
+        att = attr_stats(records)
+        if att is not None:
+            out["attribution"] = att
     staleness = bench_staleness_info(args)
     if staleness is not None:
         out["bench_staleness"] = staleness
@@ -1050,11 +1103,14 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
     times = sorted(r["step_time"] for r in steps)
     thr = [r["throughput"] for r in steps if "throughput" in r]
     mfu = [r["mfu"] for r in steps if "mfu" in r]
+    from pytorch_distributed_tpu.obs import stepattr as stepattr_mod
+
     gp = compute_goodput(records)
     cs = comm_stats(records)
     srv = serving_stats(records)
     trc = trace_stats(records)
     flt = fleet_stats(records)
+    att_s = stepattr_mod.summarize(records)
 
     def attr(field):
         # prefer the step-record stamp (windowed, what the run saw live);
@@ -1088,6 +1144,12 @@ def run_stats(records: List[dict]) -> Dict[str, Optional[float]]:
         # router, so single-replica and training diffs are untouched
         "retry_rate": flt["retry_rate_pct"] if flt else None,
         "hedge_win_rate": flt["hedge_win_rate_pct"] if flt else None,
+        # step-attribution fences (obs/stepattr.py) — None without
+        # --step-attr, so unattributed diffs are untouched
+        "data_wait_share_p95": (att_s["data_wait_share_p95"]
+                                if att_s else None),
+        "host_sync_ms_p95": (att_s["host_sync_ms_p95"]
+                             if att_s else None),
     }
 
 
@@ -1133,6 +1195,14 @@ _DIFF_METRICS = (
     # baseline books 0% retries, so relative rows would divide by zero.
     ("retry_rate", True, True),
     ("hedge_win_rate", False, True),
+    # step-attribution fences (obs/stepattr.py, --step-attr): both
+    # absolute — the share is percentage points, and a clean baseline
+    # books host_sync_ms_p95 near zero so a relative row would hide a
+    # planted host-sync regression behind the zero-baseline guard.
+    # These catch composition regressions that the aggregate step-time
+    # row can mask: a loader that got slower while compute got faster.
+    ("data_wait_share_p95", True, True),
+    ("host_sync_ms_p95", True, True),
 )
 
 
@@ -1210,8 +1280,9 @@ def diff_report(a_records: List[dict], b_records: List[dict],
             if name == "alerts":  # a count, not a percentage
                 dtxt = f"{row['delta_pp']:+.0f}"
                 fa, fb = f"{va:.0f}", f"{vb:.0f}"
-            elif name.endswith("_ms") or name.endswith("_ms_p99"):
-                # absolute but milliseconds (preempt_redo_ms_p99)
+            elif name.endswith(("_ms", "_ms_p99", "_ms_p95")):
+                # absolute but milliseconds (preempt_redo_ms_p99,
+                # host_sync_ms_p95)
                 dtxt = f"{row['delta_pp']:+.1f}ms"
                 fa, fb = f"{va:.1f}ms", f"{vb:.1f}ms"
             else:
@@ -1871,6 +1942,86 @@ def _selftest() -> int:
         assert rc_t == 1, (
             "selftest: planted preemption storm must exit 1")
         assert "preempt_redo_ms_p99" in buf_t.getvalue(), buf_t.getvalue()
+
+        # ---- attribution plane (ISSUE 20): section, json twin, diff ----
+        from pytorch_distributed_tpu.obs import stepattr as sa_mod
+
+        def write_attr_run(path, comp, sync_ms, data_ms, other):
+            # identical 100ms step times: only the composition differs,
+            # so the NEW attribution rows (and only they) may flip
+            with MetricsLogger(path, flush_every=50) as log:
+                prof = sa_mod.phase_profile(
+                    {"forward": 1e9, "backward": 2e9, "update": 1e7},
+                    {"forward": 1e7, "backward": 2e7, "update": 1e8},
+                    comm_bytes=1e6, peak_flops=1e12, hbm_bw=1e11,
+                    link_bw=1e10, n_devices=1)
+                log.log_event("stepattr_phases",
+                              **sa_mod.phase_event_fields(prof))
+                for i in range(10):
+                    log.log_step(i, step_time=0.100, n_items=32, extra={
+                        "attr_compute_ms": comp,
+                        "attr_exposed_comm_ms": 8.0,
+                        "attr_host_sync_ms": sync_ms,
+                        "attr_data_wait_ms": data_ms,
+                        "attr_other_ms": other,
+                        "attr_device_ms": comp + 8.0,
+                        "attr_comm_ms": 20.0,
+                        "attr_recon_err_ms": 0.02,
+                        "data_wait_share": data_ms})
+        attr_base = os.path.join(d, "sa_base.jsonl")
+        attr_bad = os.path.join(d, "sa_starved.jsonl")
+        write_attr_run(attr_base, comp=62.0, sync_ms=3.0, data_ms=8.0,
+                       other=19.0)
+        write_attr_run(attr_bad, comp=42.0, sync_ms=12.0, data_ms=30.0,
+                       other=8.0)
+        ns_at = argparse.Namespace(
+            metrics_jsonl=attr_base, hb_dir=None, telemetry_csv=None,
+            now=now, max_step_lag=3, max_beat_age=60.0, bench_lkg=None,
+            bench_events=None, bench_max_stale_days=14.0, plan=None,
+            flight_dir=None)
+        at_out = report(ns_at)
+        for needle in ("== attribution ==", "dominant: compute",
+                       "identity recon", "% of step p50",
+                       "data_wait_share   p50 8.0%  p95 8.0%",
+                       "host_sync         p50 3.00ms  p95 3.00ms",
+                       "comm overlap      measured 0.60",
+                       "fix first: backward"):
+            assert needle in at_out, (
+                f"selftest: {needle!r} missing from:\n{at_out}")
+        js_at = report_json(ns_at)
+        assert js_at["attribution"]["dominant"] == "compute", js_at
+        assert js_at["attribution"]["recon_err_pct_p50"] <= 0.5, js_at
+        roofl = js_at["attribution"]["roofline"]
+        at_labels = {p["phase"]: p["label"] for p in roofl["phases"]}
+        assert at_labels["update"] == "hbm-bound", at_labels
+        assert at_labels["grad_sync"] == "comm-bound", at_labels
+        json.dumps(js_at)
+        # runs without --step-attr must not grow the section or rows
+        assert "== attribution ==" not in srv_out, srv_out
+        assert by_srv["data_wait_share_p95"]["verdict"] == "missing", ds
+        assert by_srv["host_sync_ms_p95"]["verdict"] == "missing", ds
+        # planted input starvation: identical step times, but data-wait
+        # share climbs 22pp and host-sync p95 climbs 9ms -> both new
+        # rows (and only they) REGRESS, in both text and exit code
+        aa_recs, _ = load_metrics(attr_base)
+        ab_recs, _ = load_metrics(attr_bad)
+        dat = diff_data(aa_recs, ab_recs)
+        by_at = {r["metric"]: r for r in dat["metrics"]}
+        assert by_at["data_wait_share_p95"]["verdict"] == "REGRESS", dat
+        assert by_at["host_sync_ms_p95"]["verdict"] == "REGRESS", dat
+        assert by_at["step_time_p50"]["verdict"] == "PASS", dat
+        # the improvement direction passes both rows
+        by_rat = {r["metric"]: r
+                  for r in diff_data(ab_recs, aa_recs)["metrics"]}
+        assert by_rat["data_wait_share_p95"]["verdict"] == "PASS", by_rat
+        assert by_rat["host_sync_ms_p95"]["verdict"] == "PASS", by_rat
+        buf_at = io.StringIO()
+        with contextlib.redirect_stdout(buf_at):
+            rc_at = run_diff(attr_base, attr_bad, 10.0, 5.0)
+        assert rc_at == 1, (
+            "selftest: planted input starvation must exit 1")
+        assert "data_wait_share_p95" in buf_at.getvalue(), buf_at.getvalue()
+        assert "host_sync_ms_p95" in buf_at.getvalue(), buf_at.getvalue()
 
         # ---- fleet plane (ISSUE 19): section, json twin, diff rows ----
         def write_fleet(path, retries, hedges_won):

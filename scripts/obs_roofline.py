@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""obs_roofline — per-step training-time attribution + roofline (ISSUE 20).
+
+Reads the ``attr_*`` fields a ``--step-attr`` run stamps into the metrics
+JSONL (recorder in obs/stepattr.py) plus the one-time ``stepattr_phases``
+ft_event, and answers *where did my step go* exactly:
+
+    step_time == compute + exposed_comm + host_sync + data_wait + other
+
+    # human report: the identity, shares, and the fix-first table
+    obs_roofline.py --metrics-jsonl /tmp/train.jsonl
+
+    # machine form (summary + roofline)
+    obs_roofline.py --metrics-jsonl /tmp/train.jsonl --json
+
+    # per-component Perfetto counter tracks over the run's step clock
+    obs_roofline.py --metrics-jsonl /tmp/train.jsonl --perfetto /tmp/attr.json
+
+    # the measured profile for the planner loop (autoplan --attr-from)
+    obs_roofline.py --metrics-jsonl /tmp/train.jsonl --attr-out /tmp/attr.json
+
+The roofline needs no hardware tables: the trainer embeds per-phase
+FLOPs/HBM bytes and the chip peaks in the ``stepattr_phases`` event, so
+each phase is labeled compute-bound / hbm-bound / comm-bound / host-bound
+from the event alone.
+
+Runs with **no jax in the process** — obs/stepattr.py is loaded by file
+path, never through the package ``__init__`` (which imports jax for the
+shard_map bridge); ``--selftest`` asserts it, like obs_trace.py, and
+round-trips the checked-in fixture ``tests/data/stepattr_fixture.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OBS = os.path.join(_REPO, "pytorch_distributed_tpu", "obs")
+FIXTURE = os.path.join(_REPO, "tests", "data", "stepattr_fixture.jsonl")
+
+
+def _load_obs(name: str):
+    """Load ``pytorch_distributed_tpu/obs/<name>.py`` by path under the
+    same ``_ptd_obs_<name>`` alias obs/alerts.py uses, so the sibling
+    modules share one instance and jax never enters the process."""
+    import importlib.util
+
+    full = f"pytorch_distributed_tpu.obs.{name}"
+    if full in sys.modules:
+        return sys.modules[full]
+    alias = f"_ptd_obs_{name}"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(
+        alias, os.path.join(_OBS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+stepattr = _load_obs("stepattr")
+metrics = _load_obs("metrics")
+
+
+# ------------------------------------------------------------------ analysis
+
+def analyze(path: str, top_k: int = 5):
+    """Parse the JSONL and return ``(records, summary, roofline)`` —
+    summary None without ``--step-attr`` records, roofline None without a
+    ``stepattr_phases`` event to anchor it."""
+    records = metrics.read_metrics(path)
+    summ = stepattr.summarize(records)
+    roof = None
+    if summ is not None:
+        ev = stepattr.phase_event(records)
+        if ev is not None:
+            roof = stepattr.roofline(summ, ev, top_k=top_k)
+    return records, summ, roof
+
+
+def render(summ, roof) -> str:
+    lines = ["== step attribution =="]
+    if summ is None:
+        lines.append("no attr_* step records (run a trainer with "
+                     "--step-attr)")
+        return "\n".join(lines)
+    lines.append(
+        f"steps {summ['steps']}  "
+        f"recon err max {summ['recon_err_ms_max']:.3f}ms "
+        f"({summ['recon_err_pct_p50']:.2f}% of step p50)")
+    lines.append(stepattr.format_summary_line(summ))
+    lines.append(
+        f"data_wait_share p50 {summ['data_wait_share_p50']:.1f}%  "
+        f"p95 {summ['data_wait_share_p95']:.1f}%  "
+        f"host_sync p95 {summ['host_sync_ms_p95']:.2f}ms")
+    ov = summ.get("overlap_measured")
+    if ov is not None:
+        lines.append(f"comm overlap measured {ov:.2f} "
+                     f"(exposure source: {summ['exposure_source']})")
+    if roof is None:
+        lines.append("no stepattr_phases event — roofline unavailable "
+                     "(the trainer books it once per --step-attr run)")
+        return "\n".join(lines)
+    lines.append("== roofline ==")
+    lines.append(f"ridge {roof['ridge_flops_per_byte']:.1f} flops/byte")
+    for p in roof["phases"]:
+        util = ""
+        if "flops_util_pct" in p:
+            util = (f"flops {p['flops_util_pct']:.1f}% of peak, "
+                    f"hbm {p['hbm_util_pct']:.1f}%")
+        elif "link_util_pct" in p:
+            util = f"link {p['link_util_pct']:.1f}%"
+        lines.append(f"  {p['phase']:<12} {p['ms']:8.2f}ms  "
+                     f"{p['label']:<14} {util}")
+    lines.append("fix first (headroom = ms a perfectly-utilized phase "
+                 "gives back):")
+    for i, p in enumerate(roof["fix_first"], 1):
+        lines.append(f"  {i}. {p['phase']:<12} {p['headroom_ms']:8.2f}ms  "
+                     f"({p['label']})")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ selftest
+
+def _selftest() -> int:
+    assert "jax" not in sys.modules, \
+        "obs_roofline selftest must run jax-free (import-time hygiene)"
+    assert os.path.exists(FIXTURE), f"missing fixture {FIXTURE}"
+
+    records, summ, roof = analyze(FIXTURE)
+    assert summ is not None and summ["steps"] >= 8, summ
+    # the identity reconciles on the checked-in artifact, inside the
+    # runtime fence (<= 0.5% of step p50)
+    assert summ["recon_err_pct_p50"] <= 0.5, summ["recon_err_pct_p50"]
+    # shares sum back to ~100% of step p50 (the identity, in share form)
+    assert abs(sum(summ["shares_pct"].values()) - 100.0) < 1.5, \
+        summ["shares_pct"]
+    assert summ["dominant"] == "compute", summ["dominant"]
+    assert roof is not None, "fixture lost its stepattr_phases event"
+    labels = {p["phase"]: p["label"] for p in roof["phases"]}
+    # the fixture's phase ledger pins one of each class: fwd/bwd clear
+    # the ridge, the optimizer streams state, grad_sync is the wire
+    assert labels["forward"] == "compute-bound", labels
+    assert labels["backward"] == "compute-bound", labels
+    assert labels["update"] == "hbm-bound", labels
+    assert labels["grad_sync"] == "comm-bound", labels
+    assert labels["data_wait"] == "host-bound", labels
+    assert roof["fix_first"], roof
+    out = render(summ, roof)
+    for needle in ("== step attribution ==", "== roofline ==",
+                   "dominant: compute", "fix first", "ridge",
+                   "recon err max"):
+        assert needle in out, f"missing {needle!r} in:\n{out}"
+
+    # counter tracks: one track per component + the share track
+    evs = stepattr.chrome_counter_events(records)
+    names = {e["name"] for e in evs if e.get("ph") == "C"}
+    for c in stepattr.COMPONENTS:
+        assert f"attr · {c}_ms" in names, names
+    assert "data_wait_share" in names, names
+
+    # runtime round-trip in a tempdir: StepAttr windows -> MetricsLogger
+    # -> summarize names the planted bottleneck, write/load_attr carries
+    # it to the planner form
+    import tempfile
+    import time as _time
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "train.jsonl")
+        sa = stepattr.StepAttr(comm_bytes_per_step=1e6,
+                               link_bytes_per_s=1e10)
+        with metrics.MetricsLogger(path, flush_every=1) as log:
+            for step in range(6):
+                with sa.data_wait():
+                    _time.sleep(0.012)  # the planted loader stall
+                with sa.device():
+                    _time.sleep(0.002)
+                t0 = _time.perf_counter()
+                with sa.host_sync():
+                    pass
+                dt = 0.016 + (_time.perf_counter() - t0)
+                log.log_step(step, step_time=dt, n_items=8, lr=1e-3,
+                             scalars={}, extra=sa.fields(dt))
+        rt = metrics.read_metrics(path)
+        s2 = stepattr.summarize(rt)
+        assert s2 is not None and s2["dominant"] == "data_wait", s2
+        assert s2["recon_err_pct_p50"] <= 0.5, s2
+        apath = os.path.join(d, "attr.json")
+        prof = stepattr.write_attr(apath, s2)
+        back = stepattr.load_attr(apath)
+        assert back["kind"] == "stepattr_profile", back
+        assert back["bottleneck"] == "data_wait", back
+        assert back["attr_source"] == apath, back
+        assert abs(back["step_ms_p50"] - prof["step_ms_p50"]) < 1e-9
+        # a non-profile JSON is rejected loudly
+        bogus = os.path.join(d, "bogus.json")
+        with open(bogus, "w") as f:
+            json.dump({"overlap": 0.5}, f)
+        try:
+            stepattr.load_attr(bogus)
+            raise AssertionError("load_attr accepted a non-profile JSON")
+        except ValueError:
+            pass
+
+    assert "jax" not in sys.modules
+    print("obs_roofline selftest: OK")
+    return 0
+
+
+# ---------------------------------------------------------------------- main
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-step training-time attribution + roofline")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="metrics JSONL from a --step-attr run")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable summary + roofline")
+    ap.add_argument("--top-k", type=int, default=5,
+                    help="fix-first table depth")
+    ap.add_argument("--perfetto", default=None, metavar="OUT",
+                    help="write per-component counter tracks as a "
+                         "Chrome-trace JSON")
+    ap.add_argument("--attr-out", default=None, metavar="ATTR",
+                    help="write the measured profile for "
+                         "autoplan --attr-from")
+    ap.add_argument("--selftest", action="store_true",
+                    help="fixture round-trip + jax-free assertion")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return _selftest()
+    if not args.metrics_jsonl:
+        ap.error("--metrics-jsonl is required (or --selftest)")
+    records, summ, roof = analyze(args.metrics_jsonl, top_k=args.top_k)
+    if args.perfetto:
+        trace = {"traceEvents": stepattr.chrome_counter_events(records),
+                 "displayTimeUnit": "ms"}
+        with open(args.perfetto, "w") as f:
+            json.dump(trace, f)
+        print(f"wrote {args.perfetto} "
+              f"({len(trace['traceEvents'])} events)")
+    if args.attr_out:
+        if summ is None:
+            print("no attr_* step records — nothing to write",
+                  file=sys.stderr)
+            return 2
+        prof = stepattr.write_attr(args.attr_out, summ)
+        print(f"wrote {args.attr_out} (bottleneck: {prof['bottleneck']}, "
+              f"overlap: {prof['overlap']})")
+    if args.as_json:
+        out = dict(summ) if summ else {}
+        if roof is not None:
+            out["roofline"] = roof
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        print(render(summ, roof))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -346,6 +346,62 @@ def test_fleet_flags_parse_to_their_own_dests():
     assert (args.hedge, args.max_retries, args.seed) == (False, 2, 0)
 
 
+def test_stepattr_flags_parse_to_their_own_dests():
+    """ISSUE-20 flags: ``--step-attr`` lands in its own dest on both
+    trainer surfaces, defaults to off, and collides with nothing (the
+    parametrized _lint tests above cover the collision half)."""
+    cfg = config_mod.parse_config(["--step-attr"])
+    assert cfg.step_attr is True
+    cfg = config_mod.parse_config([])
+    assert cfg.step_attr is False
+    args = lm_pretrain.build_parser().parse_args(
+        ["--step-attr", "--precision", "bf16"])
+    assert args.step_attr is True
+    assert args.precision == "bf16"  # the PR-9 symptom, pinned
+    args = lm_pretrain.build_parser().parse_args([])
+    assert args.step_attr is False
+
+
+def test_autoplan_gains_attr_from():
+    """ISSUE-20 satellite: ``autoplan --attr-from`` is a real flag, is
+    exclusive with the other overlap provenances, and is consumed before
+    any planning (a missing profile fails loudly, not silently)."""
+    apm = _load_script("autoplan.py", "autoplan_attr_flags")
+    with pytest.raises(SystemExit):  # one overlap provenance per plan
+        apm.main(["lm-tiny", "--attr-from", "/tmp/a.json",
+                  "--overlap-from", "/tmp/t.json"])
+    with pytest.raises(FileNotFoundError):
+        apm.main(["lm-tiny", "--chips", "4",
+                  "--attr-from", "/nonexistent/attr.json"])
+
+
+def test_chaoskit_drill_gains_the_slow_loader_kind():
+    """ISSUE-20 satellite: ``chaoskit drill slow-loader`` is a real
+    choice sharing the seeded contract flags."""
+    ck = _load_script("chaoskit.py", "chaoskit_sl_flags")
+
+    class _Exit(Exception):
+        pass
+
+    got = {}
+
+    def fake_drill(args):
+        got["args"] = args
+        raise _Exit()
+
+    orig = ck.cmd_drill
+    ck.cmd_drill = fake_drill
+    try:
+        with pytest.raises(_Exit):
+            ck.main(["drill", "slow-loader", "--seed", "7",
+                     "--steps", "10"])
+    finally:
+        ck.cmd_drill = orig
+    parsed = got["args"]
+    assert (parsed.kind, parsed.seed, parsed.steps) == \
+        ("slow-loader", 7, 10)
+
+
 def test_trace_and_checkpoint_flags_parse_to_their_own_dests():
     """ISSUE-17 flags: serve_lm's ``--req-trace``/``--trace-sample``
     tracing pair and ``--checkpoint`` land in their own dests, default
